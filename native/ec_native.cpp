@@ -1,0 +1,198 @@
+// Native acceleration for seaweedfs_tpu's host-side paths.
+//
+// Two components:
+//  1. CRC32C (Castagnoli) — the needle checksum the reference computes with
+//     Go's hash/crc32 Castagnoli table (reference:
+//     /root/reference/weed/storage/needle/crc.go:12-33).  SSE4.2 hardware
+//     CRC when available, slicing-by-8 tables otherwise.
+//  2. GF(2^8) matrix application — the CPU Reed-Solomon codec equivalent to
+//     klauspost/reedsolomon's SIMD kernels (AVX2 PSHUFB on 16-entry nibble
+//     product tables), used as the CPU fallback backend and as the
+//     apples-to-apples AVX2 baseline that bench.py compares the TPU against.
+//
+// Built as a plain shared library; Python binds via ctypes (no pybind11 in
+// this image).
+
+#include <cstdint>
+#include <cstring>
+#include <cstddef>
+
+#if defined(__x86_64__)
+#include <immintrin.h>
+#include <nmmintrin.h>
+#endif
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// CRC32C
+// ---------------------------------------------------------------------------
+
+static uint32_t crc32c_table[8][256];
+static bool crc32c_table_init_done = false;
+
+static void crc32c_table_init() {
+    const uint32_t poly = 0x82F63B78u;  // reflected Castagnoli
+    for (uint32_t i = 0; i < 256; i++) {
+        uint32_t crc = i;
+        for (int j = 0; j < 8; j++)
+            crc = (crc >> 1) ^ ((crc & 1) ? poly : 0);
+        crc32c_table[0][i] = crc;
+    }
+    for (uint32_t i = 0; i < 256; i++) {
+        uint32_t crc = crc32c_table[0][i];
+        for (int s = 1; s < 8; s++) {
+            crc = crc32c_table[0][crc & 0xFF] ^ (crc >> 8);
+            crc32c_table[s][i] = crc;
+        }
+    }
+    crc32c_table_init_done = true;
+}
+
+static uint32_t crc32c_sw(uint32_t crc, const uint8_t* data, size_t len) {
+    if (!crc32c_table_init_done) crc32c_table_init();
+    crc = ~crc;
+    while (len >= 8) {
+        uint64_t word;
+        memcpy(&word, data, 8);
+        word ^= (uint64_t)crc;
+        crc = crc32c_table[7][word & 0xFF] ^
+              crc32c_table[6][(word >> 8) & 0xFF] ^
+              crc32c_table[5][(word >> 16) & 0xFF] ^
+              crc32c_table[4][(word >> 24) & 0xFF] ^
+              crc32c_table[3][(word >> 32) & 0xFF] ^
+              crc32c_table[2][(word >> 40) & 0xFF] ^
+              crc32c_table[1][(word >> 48) & 0xFF] ^
+              crc32c_table[0][(word >> 56) & 0xFF];
+        data += 8;
+        len -= 8;
+    }
+    while (len--) crc = crc32c_table[0][(crc ^ *data++) & 0xFF] ^ (crc >> 8);
+    return ~crc;
+}
+
+#if defined(__x86_64__)
+__attribute__((target("sse4.2")))
+static uint32_t crc32c_hw(uint32_t crc, const uint8_t* data, size_t len) {
+    uint64_t c = ~crc;
+    while (len >= 8) {
+        uint64_t word;
+        memcpy(&word, data, 8);
+        c = _mm_crc32_u64(c, word);
+        data += 8;
+        len -= 8;
+    }
+    while (len--) c = _mm_crc32_u8((uint32_t)c, *data++);
+    return ~(uint32_t)c;
+}
+#endif
+
+uint32_t sw_crc32c(uint32_t crc, const uint8_t* data, size_t len) {
+#if defined(__x86_64__)
+    if (__builtin_cpu_supports("sse4.2")) return crc32c_hw(crc, data, len);
+#endif
+    return crc32c_sw(crc, data, len);
+}
+
+// ---------------------------------------------------------------------------
+// GF(2^8) — field 0x11D, matching klauspost/reedsolomon & Backblaze
+// ---------------------------------------------------------------------------
+
+static uint8_t gf_mul_table[256][256];
+static bool gf_init_done = false;
+
+static void gf_init() {
+    uint8_t exp_t[510];
+    int log_t[256] = {0};
+    int x = 1;
+    for (int i = 0; i < 255; i++) {
+        exp_t[i] = (uint8_t)x;
+        log_t[x] = i;
+        x <<= 1;
+        if (x & 0x100) x ^= 0x11D;
+    }
+    for (int i = 255; i < 510; i++) exp_t[i] = exp_t[i - 255];
+    for (int a = 0; a < 256; a++)
+        for (int b = 0; b < 256; b++)
+            gf_mul_table[a][b] = (a && b) ? exp_t[log_t[a] + log_t[b]] : 0;
+    gf_init_done = true;
+}
+
+static void gf_apply_row_scalar(const uint8_t* coeffs, int d,
+                                const uint8_t* data, size_t len,
+                                uint8_t* out) {
+    memset(out, 0, len);
+    for (int j = 0; j < d; j++) {
+        const uint8_t* table = gf_mul_table[coeffs[j]];
+        const uint8_t* in = data + (size_t)j * len;
+        for (size_t k = 0; k < len; k++) out[k] ^= table[in[k]];
+    }
+}
+
+#if defined(__x86_64__)
+// klauspost-style AVX2 kernel: per coefficient, 16-entry low/high nibble
+// product tables applied with VPSHUFB, XOR-accumulated across input shards.
+__attribute__((target("avx2")))
+static void gf_apply_row_avx2(const uint8_t* coeffs, int d,
+                              const uint8_t* data, size_t len,
+                              uint8_t* out) {
+    size_t vec_len = len & ~(size_t)31;
+    __m256i low_mask = _mm256_set1_epi8(0x0F);
+    memset(out, 0, len);
+    for (int j = 0; j < d; j++) {
+        uint8_t c = coeffs[j];
+        const uint8_t* table = gf_mul_table[c];
+        alignas(32) uint8_t lo[32], hi[32];
+        for (int t = 0; t < 16; t++) {
+            lo[t] = lo[t + 16] = table[t];
+            hi[t] = hi[t + 16] = table[t << 4];
+        }
+        __m256i vlo = _mm256_load_si256((const __m256i*)lo);
+        __m256i vhi = _mm256_load_si256((const __m256i*)hi);
+        const uint8_t* in = data + (size_t)j * len;
+        for (size_t k = 0; k < vec_len; k += 32) {
+            __m256i v = _mm256_loadu_si256((const __m256i*)(in + k));
+            __m256i vl = _mm256_and_si256(v, low_mask);
+            __m256i vh = _mm256_and_si256(_mm256_srli_epi64(v, 4), low_mask);
+            __m256i prod = _mm256_xor_si256(_mm256_shuffle_epi8(vlo, vl),
+                                            _mm256_shuffle_epi8(vhi, vh));
+            __m256i acc = _mm256_loadu_si256((const __m256i*)(out + k));
+            _mm256_storeu_si256((__m256i*)(out + k),
+                                _mm256_xor_si256(acc, prod));
+        }
+        for (size_t k = vec_len; k < len; k++) out[k] ^= table[in[k]];
+    }
+}
+#endif
+
+// out[i*len .. ] = XOR_j gf_mul(matrix[i*d+j], data[j*len ..])
+void sw_gf_apply_matrix(const uint8_t* matrix, int p, int d,
+                        const uint8_t* data, size_t len, uint8_t* out) {
+    if (!gf_init_done) gf_init();
+#if defined(__x86_64__)
+    bool avx2 = __builtin_cpu_supports("avx2");
+#else
+    bool avx2 = false;
+#endif
+    for (int i = 0; i < p; i++) {
+        const uint8_t* coeffs = matrix + (size_t)i * d;
+        uint8_t* row_out = out + (size_t)i * len;
+#if defined(__x86_64__)
+        if (avx2) {
+            gf_apply_row_avx2(coeffs, d, data, len, row_out);
+            continue;
+        }
+#endif
+        gf_apply_row_scalar(coeffs, d, data, len, row_out);
+    }
+}
+
+int sw_has_avx2() {
+#if defined(__x86_64__)
+    return __builtin_cpu_supports("avx2") ? 1 : 0;
+#else
+    return 0;
+#endif
+}
+
+}  // extern "C"

@@ -1,0 +1,66 @@
+"""Backend-selectable Reed-Solomon codec — the `reedsolomon.Encoder` seam.
+
+The reference's storage engine calls exactly three codec methods
+(Encode / Reconstruct / ReconstructData; SURVEY.md §2) behind
+`reedsolomon.New(10, 4)`.  `new_encoder(...)` is the equivalent factory,
+selected by backend the way the north-star design selects `-ec.backend=tpu`:
+
+  * "tpu"   — JAX kernels (Pallas MXU on TPU, SWAR on CPU), rs_jax.py
+  * "cpu"   — native AVX2 C++ (klauspost-equivalent), this module
+  * "numpy" — pure NumPy reference, rs_numpy.py
+  * "auto"  — tpu when a TPU is attached, else cpu-native, else numpy
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+from . import native
+from ..util.platform import on_tpu
+from .rs_numpy import NumpyEncoder, ReconstructError, RSCodecBase  # noqa: F401
+
+
+class NativeEncoder(RSCodecBase):
+    """CPU codec backed by the AVX2 C++ kernels in native/ec_native.cpp."""
+
+    def __init__(self, data_shards: int = 10, parity_shards: int = 4):
+        super().__init__(data_shards, parity_shards)
+        self._lib = native.lib()
+        if self._lib is None:
+            raise RuntimeError("native library unavailable")
+
+    def _apply(self, matrix: np.ndarray, inputs: np.ndarray) -> np.ndarray:
+        p, d = matrix.shape
+        length = inputs.shape[1]
+        matrix = np.ascontiguousarray(matrix, dtype=np.uint8)
+        inputs = np.ascontiguousarray(inputs, dtype=np.uint8)
+        out = np.zeros((p, length), dtype=np.uint8)
+        self._lib.sw_gf_apply_matrix(
+            matrix.ctypes.data_as(ctypes.c_char_p), p, d,
+            inputs.ctypes.data_as(ctypes.c_char_p), length,
+            out.ctypes.data_as(ctypes.c_char_p),
+        )
+        return out
+
+
+def new_encoder(data_shards: int = 10, parity_shards: int = 4,
+                backend: str = "auto"):
+    if backend == "auto":
+        if on_tpu():
+            backend = "tpu"
+        elif native.lib() is not None:
+            backend = "cpu"
+        else:
+            backend = "numpy"
+    if backend == "tpu":
+        from .rs_jax import JaxEncoder
+
+        method = "pallas" if on_tpu() else "swar"
+        return JaxEncoder(data_shards, parity_shards, method=method)
+    if backend == "cpu":
+        return NativeEncoder(data_shards, parity_shards)
+    if backend == "numpy":
+        return NumpyEncoder(data_shards, parity_shards)
+    raise ValueError(f"unknown backend {backend!r}")

@@ -1,0 +1,80 @@
+"""CRC32C (Castagnoli) — the needle checksum.
+
+The reference computes needle checksums with Go's hash/crc32 Castagnoli
+table and stores the raw uint32 (write path) while accepting the legacy
+rotated `Value()` form on read (/root/reference/weed/storage/needle/
+crc.go:12-33, needle_read.go:73-80).  `value()` reproduces that legacy
+transform for read-compat.
+
+Dispatch: native SSE4.2/table C++ (ops/native.py) with a pure-Python
+slicing-by-8 fallback.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import native
+
+_POLY = 0x82F63B78  # reflected Castagnoli
+
+
+def _make_tables() -> np.ndarray:
+    tables = np.zeros((8, 256), dtype=np.uint32)
+    for i in range(256):
+        crc = i
+        for _ in range(8):
+            crc = (crc >> 1) ^ (_POLY if crc & 1 else 0)
+        tables[0, i] = crc
+    for s in range(1, 8):
+        for i in range(256):
+            crc = int(tables[s - 1, i])
+            tables[s, i] = tables[0, crc & 0xFF] ^ (crc >> 8)
+    return tables
+
+
+_TABLES: np.ndarray | None = None
+
+
+def _crc32c_py(crc: int, data: bytes) -> int:
+    global _TABLES
+    if _TABLES is None:
+        _TABLES = _make_tables()
+    t = _TABLES
+    crc = ~crc & 0xFFFFFFFF
+    mv = memoryview(data)
+    n8 = len(mv) - (len(mv) % 8)
+    for k in range(0, n8, 8):
+        word = int.from_bytes(mv[k : k + 8], "little") ^ crc
+        crc = (
+            int(t[7, word & 0xFF])
+            ^ int(t[6, (word >> 8) & 0xFF])
+            ^ int(t[5, (word >> 16) & 0xFF])
+            ^ int(t[4, (word >> 24) & 0xFF])
+            ^ int(t[3, (word >> 32) & 0xFF])
+            ^ int(t[2, (word >> 40) & 0xFF])
+            ^ int(t[1, (word >> 48) & 0xFF])
+            ^ int(t[0, (word >> 56) & 0xFF])
+        )
+    for b in mv[n8:]:
+        crc = int(t[0, (crc ^ b) & 0xFF]) ^ (crc >> 8)
+    return ~crc & 0xFFFFFFFF
+
+
+def crc32c(data, crc: int = 0) -> int:
+    """CRC32C of `data` (bytes-like or uint8 ndarray), seeded with `crc`."""
+    if isinstance(data, np.ndarray):
+        data = data.tobytes()
+    elif not isinstance(data, (bytes, bytearray)):
+        data = bytes(data)
+    cdll = native.lib()
+    if cdll is not None:
+        return cdll.sw_crc32c(crc, bytes(data), len(data))
+    return _crc32c_py(crc, bytes(data))
+
+
+def value(crc: int) -> int:
+    """Legacy CRC.Value(): rotate + magic, kept for read-compat with old data."""
+    crc &= 0xFFFFFFFF
+    rotated = ((crc >> 15) | (crc << 17)) & 0xFFFFFFFF
+    return (rotated + 0xA282EAD8) & 0xFFFFFFFF

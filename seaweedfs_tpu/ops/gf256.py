@@ -1,0 +1,193 @@
+"""GF(2^8) arithmetic and matrix algebra for Reed-Solomon coding.
+
+Field: GF(2^8) with the generating polynomial x^8+x^4+x^3+x^2+1 (0x11D) and
+generator element 2 — the same field used by klauspost/reedsolomon (the codec
+the reference delegates to at /root/reference/weed/storage/erasure_coding/
+ec_encoder.go:198) and by Backblaze's JavaReedSolomon, which it is
+wire-compatible with.  Parity produced with matrices built here is therefore
+bit-identical to the reference's shards.
+
+Everything in this module is host-side (NumPy); the TPU kernels in
+rs_jax.py / rs_pallas.py consume the small matrices produced here.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+FIELD_SIZE = 256
+GENERATING_POLYNOMIAL = 0x11D  # x^8 + x^4 + x^3 + x^2 + 1
+GENERATOR = 2
+
+
+def _generate_tables() -> tuple[np.ndarray, np.ndarray]:
+    """Build exp/log tables for the field.
+
+    exp is doubled (510 entries) so mul can skip the mod-255 reduction.
+    """
+    exp = np.zeros(510, dtype=np.uint8)
+    log = np.zeros(256, dtype=np.int32)
+    x = 1
+    for i in range(255):
+        exp[i] = x
+        log[x] = i
+        x <<= 1
+        if x & 0x100:
+            x ^= GENERATING_POLYNOMIAL
+    exp[255:510] = exp[0:255]
+    log[0] = 0  # log(0) undefined; callers must special-case zero
+    return exp, log
+
+
+EXP_TABLE, LOG_TABLE = _generate_tables()
+
+
+def gf_mul(a: int, b: int) -> int:
+    if a == 0 or b == 0:
+        return 0
+    return int(EXP_TABLE[LOG_TABLE[a] + LOG_TABLE[b]])
+
+
+def gf_div(a: int, b: int) -> int:
+    if b == 0:
+        raise ZeroDivisionError("GF(2^8) division by zero")
+    if a == 0:
+        return 0
+    return int(EXP_TABLE[(LOG_TABLE[a] - LOG_TABLE[b]) % 255])
+
+
+def gf_inverse(a: int) -> int:
+    if a == 0:
+        raise ZeroDivisionError("0 has no inverse in GF(2^8)")
+    return int(EXP_TABLE[(255 - LOG_TABLE[a]) % 255])
+
+
+def gf_exp(a: int, n: int) -> int:
+    """a**n in the field — matches klauspost's galExp (n==0 -> 1, a==0 -> 0)."""
+    if n == 0:
+        return 1
+    if a == 0:
+        return 0
+    return int(EXP_TABLE[(LOG_TABLE[a] * n) % 255])
+
+
+@functools.lru_cache(maxsize=1)
+def mul_table() -> np.ndarray:
+    """Full 256x256 multiplication table (64 KB), used by the NumPy codec."""
+    log_a = LOG_TABLE[:, None]
+    log_b = LOG_TABLE[None, :]
+    table = EXP_TABLE[(log_a + log_b) % 255].astype(np.uint8)
+    table[0, :] = 0
+    table[:, 0] = 0
+    return table
+
+
+@functools.lru_cache(maxsize=1)
+def nibble_tables() -> tuple[np.ndarray, np.ndarray]:
+    """(low, high) nibble product tables: low[c, x] = c*x, high[c, x] = c*(x<<4).
+
+    mul(c, d) == low[c, d & 0xF] ^ high[c, d >> 4].  Shape (256, 16) each.
+    This is the same decomposition klauspost's SIMD kernels use (PSHUFB on
+    16-entry tables); our Pallas kernels use the bit-matrix form instead but
+    the tables are handy for host-side vectorised math.
+    """
+    mt = mul_table()
+    low = mt[:, np.arange(16)]
+    high = mt[:, np.arange(16) << 4]
+    return low, high
+
+
+# ---------------------------------------------------------------------------
+# Matrix algebra over GF(2^8) (small host-side matrices, NumPy uint8)
+# ---------------------------------------------------------------------------
+
+
+def gf_matmul(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Matrix product over GF(2^8). a: (m, k) uint8, b: (k, n) uint8."""
+    mt = mul_table()
+    # products[m, k, n] then XOR-reduce over k
+    products = mt[a[:, :, None], b[None, :, :]]
+    return np.bitwise_xor.reduce(products, axis=1)
+
+
+def gf_identity(n: int) -> np.ndarray:
+    return np.eye(n, dtype=np.uint8)
+
+
+def gf_invert(m: np.ndarray) -> np.ndarray:
+    """Invert a square matrix over GF(2^8) by Gauss-Jordan elimination."""
+    n = m.shape[0]
+    if m.shape[1] != n:
+        raise ValueError(f"cannot invert non-square matrix {m.shape}")
+    work = np.concatenate([m.astype(np.uint8), gf_identity(n)], axis=1)
+    mt = mul_table()
+    for r in range(n):
+        if work[r, r] == 0:
+            for below in range(r + 1, n):
+                if work[below, r] != 0:
+                    work[[r, below]] = work[[below, r]]
+                    break
+            else:
+                raise np.linalg.LinAlgError("matrix is singular over GF(2^8)")
+        inv_pivot = gf_inverse(int(work[r, r]))
+        work[r] = mt[inv_pivot, work[r]]
+        for other in range(n):
+            if other != r and work[other, r] != 0:
+                work[other] ^= mt[int(work[other, r]), work[r]]
+    return work[:, n:].copy()
+
+
+def vandermonde(rows: int, cols: int) -> np.ndarray:
+    """vm[r, c] = r**c in GF(2^8) — klauspost/Backblaze construction."""
+    vm = np.zeros((rows, cols), dtype=np.uint8)
+    for r in range(rows):
+        for c in range(cols):
+            vm[r, c] = gf_exp(r, c)
+    return vm
+
+
+@functools.lru_cache(maxsize=32)
+def build_matrix(data_shards: int, total_shards: int) -> np.ndarray:
+    """Systematic encoding matrix, identical to klauspost's buildMatrix.
+
+    Vandermonde (total x data), normalised so the top (data x data) block is
+    the identity: matrix = vm @ inv(vm[:data]).  Rows 0..data-1 reproduce the
+    data unchanged; rows data..total-1 generate parity.
+    """
+    vm = vandermonde(total_shards, data_shards)
+    top_inv = gf_invert(vm[:data_shards])
+    m = gf_matmul(vm, top_inv)
+    m.setflags(write=False)
+    return m
+
+
+def parity_matrix(data_shards: int, total_shards: int) -> np.ndarray:
+    """The parity rows of the systematic encoding matrix ((total-data) x data)."""
+    return build_matrix(data_shards, total_shards)[data_shards:]
+
+
+# ---------------------------------------------------------------------------
+# GF(2) bit-matrix form: every GF(2^8) linear map is linear over GF(2).
+# Used by the TPU MXU kernel (XOR == addition mod 2 == int matmul + mod 2).
+# ---------------------------------------------------------------------------
+
+
+def coeff_bit_matrix(coeffs: np.ndarray) -> np.ndarray:
+    """Expand a (p, d) GF(2^8) coefficient matrix to a (p*8, d*8) GF(2) matrix.
+
+    out_bits = B @ in_bits (mod 2), where byte j of the input contributes bits
+    [j*8, j*8+8) (bit b = (byte >> b) & 1) and likewise for outputs.
+    B[i*8+r, j*8+s] = bit r of gf_mul(coeffs[i, j], 1 << s).
+    """
+    p, d = coeffs.shape
+    bits = np.zeros((p * 8, d * 8), dtype=np.uint8)
+    for i in range(p):
+        for j in range(d):
+            c = int(coeffs[i, j])
+            for s in range(8):
+                prod = gf_mul(c, 1 << s)
+                for r in range(8):
+                    bits[i * 8 + r, j * 8 + s] = (prod >> r) & 1
+    return bits

@@ -1,0 +1,51 @@
+"""ctypes bindings for the native C++ library (native/ec_native.cpp).
+
+Builds the shared library on first import if missing (make in native/);
+callers must tolerate `lib() is None` when no toolchain is available.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import functools
+import os
+import subprocess
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+)
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libseaweedec.so")
+
+
+@functools.lru_cache(maxsize=1)
+def lib() -> ctypes.CDLL | None:
+    if not os.path.exists(_LIB_PATH):
+        try:
+            subprocess.run(
+                ["make", "-s"], cwd=_NATIVE_DIR, check=True,
+                capture_output=True, timeout=120,
+            )
+        except Exception:
+            return None
+    try:
+        cdll = ctypes.CDLL(_LIB_PATH)
+    except OSError:
+        return None
+    cdll.sw_crc32c.restype = ctypes.c_uint32
+    cdll.sw_crc32c.argtypes = [
+        ctypes.c_uint32, ctypes.c_char_p, ctypes.c_size_t,
+    ]
+    cdll.sw_gf_apply_matrix.restype = None
+    cdll.sw_gf_apply_matrix.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+        ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
+    ]
+    cdll.sw_has_avx2.restype = ctypes.c_int
+    cdll.sw_has_avx2.argtypes = []
+    return cdll
+
+
+def has_avx2() -> bool:
+    cdll = lib()
+    return bool(cdll and cdll.sw_has_avx2())

@@ -1,0 +1,164 @@
+"""Reed-Solomon codec base + pure-NumPy reference backend.
+
+Mirrors the `reedsolomon.Encoder` interface the reference storage engine
+consumes (Encode / Verify / Reconstruct / ReconstructData — the three methods
+called from /root/reference/weed/storage/erasure_coding/ec_encoder.go:198,235
+and /root/reference/weed/storage/store_ec.go:331).  All backends (NumPy here,
+JAX in rs_jax.py, native C++ in codec.py) share the control flow in
+`RSCodecBase` and differ only in `_apply`, the hot GF matrix kernel — so
+fixes to the bookkeeping cannot diverge between backends.
+
+Shard convention (same as klauspost): `shards` is a list of length
+total_shards; each element is a byte buffer of equal length, or None when the
+shard is missing.  Shards 0..data-1 are systematic data, the rest parity.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import gf256
+
+
+class ReconstructError(Exception):
+    pass
+
+
+def gf_apply_matrix(matrix: np.ndarray, inputs: np.ndarray) -> np.ndarray:
+    """out[i] = XOR_j mul(matrix[i, j], inputs[j]) over byte vectors.
+
+    matrix: (m, k) uint8; inputs: (k, L) uint8 -> (m, L) uint8.
+    """
+    mt = gf256.mul_table()
+    m, k = matrix.shape
+    out = np.zeros((m, inputs.shape[1]), dtype=np.uint8)
+    for j in range(k):
+        rows = mt[matrix[:, j]]  # (m, 256) lookup rows
+        out ^= np.take_along_axis(
+            rows, np.broadcast_to(inputs[j], (m, inputs.shape[1])), axis=1
+        )
+    return out
+
+
+class RSCodecBase:
+    """RS(data, parity) codec over GF(2^8), klauspost-compatible semantics."""
+
+    def __init__(self, data_shards: int = 10, parity_shards: int = 4):
+        if data_shards <= 0 or parity_shards <= 0:
+            raise ValueError("shard counts must be positive")
+        if data_shards + parity_shards > 256:
+            raise ValueError("too many shards for GF(2^8)")
+        self.data_shards = data_shards
+        self.parity_shards = parity_shards
+        self.total_shards = data_shards + parity_shards
+        self.matrix = gf256.build_matrix(data_shards, self.total_shards)
+
+    # -- the one backend-specific hook --------------------------------------
+    def _apply(self, matrix: np.ndarray, inputs: np.ndarray) -> np.ndarray:
+        """out[i] = XOR_j gf_mul(matrix[i,j], inputs[j]); returns host uint8."""
+        raise NotImplementedError
+
+    # -- Encode ------------------------------------------------------------
+    def encode(self, shards: list) -> list:
+        """Fill parity shards from data shards; returns the full shard list."""
+        arrs = self._as_arrays(shards)
+        self._check_shape(arrs, need_all_data=True)
+        data = np.stack(arrs[: self.data_shards])
+        parity = self._apply(self.matrix[self.data_shards :], data)
+        return list(data) + [parity[i] for i in range(self.parity_shards)]
+
+    def verify(self, shards: list) -> bool:
+        arrs = self._as_arrays(shards)
+        self._check_shape(arrs, need_all=True)
+        data = np.stack(arrs[: self.data_shards])
+        parity = self._apply(self.matrix[self.data_shards :], data)
+        for i in range(self.parity_shards):
+            if not np.array_equal(parity[i], arrs[self.data_shards + i]):
+                return False
+        return True
+
+    # -- Reconstruct -------------------------------------------------------
+    def reconstruct(self, shards: list) -> list:
+        """Fill every missing (None) shard in place; returns the shard list."""
+        return self._reconstruct(shards, data_only=False)
+
+    def reconstruct_data(self, shards: list) -> list:
+        """Fill only missing data shards (parity stays None), like
+        klauspost's ReconstructData used by the EC read path."""
+        return self._reconstruct(shards, data_only=True)
+
+    def _reconstruct(self, shards: list, data_only: bool) -> list:
+        arrs = self._as_arrays(shards)
+        self._check_shape(arrs)
+        present = [i for i, s in enumerate(arrs) if s is not None]
+        if len(present) == self.total_shards:
+            return arrs
+        if len(present) < self.data_shards:
+            raise ReconstructError(
+                f"too few shards: {len(present)} < {self.data_shards}"
+            )
+
+        # Decode matrix: rows of the encoding matrix for the first data_shards
+        # present shards (klauspost picks the same subset), inverted.
+        sub_rows = present[: self.data_shards]
+        inv = gf256.gf_invert(self.matrix[sub_rows])
+        inputs = np.stack([arrs[i] for i in sub_rows])
+
+        missing_data = [i for i in range(self.data_shards) if arrs[i] is None]
+        if missing_data:
+            regenerated = self._apply(inv[missing_data], inputs)
+            for out_i, i in enumerate(missing_data):
+                arrs[i] = regenerated[out_i]
+
+        if not data_only:
+            missing_parity = [
+                i
+                for i in range(self.data_shards, self.total_shards)
+                if arrs[i] is None
+            ]
+            if missing_parity:
+                data = np.stack(arrs[: self.data_shards])
+                regenerated = self._apply(self.matrix[missing_parity], data)
+                for out_i, i in enumerate(missing_parity):
+                    arrs[i] = regenerated[out_i]
+        return arrs
+
+    # -- helpers -----------------------------------------------------------
+    @staticmethod
+    def _as_arrays(shards: list) -> list:
+        out = []
+        for s in shards:
+            if s is None:
+                out.append(None)
+            elif isinstance(s, np.ndarray):
+                out.append(s.astype(np.uint8, copy=False))
+            else:
+                out.append(np.frombuffer(s, dtype=np.uint8))
+        return out
+
+    def _check_shape(
+        self, arrs: list, need_all: bool = False, need_all_data: bool = False
+    ):
+        if len(arrs) != self.total_shards:
+            raise ValueError(
+                f"expected {self.total_shards} shards, got {len(arrs)}"
+            )
+        length = None
+        for i, s in enumerate(arrs):
+            if s is None:
+                if need_all or (need_all_data and i < self.data_shards):
+                    raise ValueError(f"shard {i} missing")
+                continue
+            if length is None:
+                length = len(s)
+            elif len(s) != length:
+                raise ValueError("shards have differing lengths")
+        if length is None:
+            raise ValueError("no shards present")
+
+
+class NumpyEncoder(RSCodecBase):
+    """Pure-NumPy reference backend (table-lookup GF math)."""
+
+    def _apply(self, matrix: np.ndarray, inputs: np.ndarray) -> np.ndarray:
+        return gf_apply_matrix(matrix, inputs)

@@ -1,0 +1,16 @@
+"""Small platform probes shared across modules."""
+
+from __future__ import annotations
+
+import functools
+
+
+@functools.lru_cache(maxsize=1)
+def on_tpu() -> bool:
+    """True when the default JAX backend is a real TPU."""
+    try:
+        import jax
+
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False

@@ -1,0 +1,135 @@
+"""Pin GF(2^8) field conventions and matrix algebra.
+
+The field must match klauspost/reedsolomon (and Backblaze JavaReedSolomon):
+polynomial 0x11D, generator 2 — otherwise parity is not bit-identical to the
+reference's shards (SURVEY.md §2.2 requirement)."""
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.ops import gf256
+
+
+def slow_mul(a: int, b: int) -> int:
+    """Independent carry-less multiply mod 0x11D (no tables)."""
+    result = 0
+    while b:
+        if b & 1:
+            result ^= a
+        a <<= 1
+        if a & 0x100:
+            a ^= gf256.GENERATING_POLYNOMIAL
+        b >>= 1
+    return result
+
+
+class TestField:
+    def test_known_log_values(self):
+        # Classic table values for poly 0x11D, generator 2 — pins the field.
+        assert gf256.LOG_TABLE[2] == 1
+        assert gf256.LOG_TABLE[3] == 25
+        assert gf256.LOG_TABLE[5] == 50
+        assert gf256.LOG_TABLE[7] == 198
+        assert gf256.EXP_TABLE[8] == 29  # 2^8 reduced by the polynomial
+
+    def test_mul_matches_slow_mul(self):
+        rng = np.random.default_rng(0)
+        for a, b in rng.integers(0, 256, size=(500, 2)):
+            assert gf256.gf_mul(int(a), int(b)) == slow_mul(int(a), int(b))
+
+    def test_mul_table_complete(self):
+        mt = gf256.mul_table()
+        for a in [0, 1, 2, 5, 29, 255]:
+            for b in [0, 1, 3, 128, 255]:
+                assert mt[a, b] == slow_mul(a, b)
+        assert np.array_equal(mt, mt.T)  # commutative
+
+    def test_inverse(self):
+        for a in range(1, 256):
+            assert gf256.gf_mul(a, gf256.gf_inverse(a)) == 1
+        with pytest.raises(ZeroDivisionError):
+            gf256.gf_inverse(0)
+
+    def test_div(self):
+        rng = np.random.default_rng(1)
+        for a, b in rng.integers(0, 256, size=(200, 2)):
+            if b == 0:
+                continue
+            q = gf256.gf_div(int(a), int(b))
+            assert gf256.gf_mul(q, int(b)) == int(a)
+
+    def test_exp_conventions(self):
+        assert gf256.gf_exp(0, 0) == 1  # klauspost galExp: n==0 -> 1
+        assert gf256.gf_exp(0, 5) == 0
+        assert gf256.gf_exp(3, 1) == 3
+        assert gf256.gf_exp(2, 8) == 29
+
+    def test_nibble_tables(self):
+        low, high = gf256.nibble_tables()
+        rng = np.random.default_rng(2)
+        for c, d in rng.integers(0, 256, size=(200, 2)):
+            expect = gf256.gf_mul(int(c), int(d))
+            got = int(low[c, d & 0xF]) ^ int(high[c, d >> 4])
+            assert got == expect
+
+
+class TestMatrix:
+    def test_invert_roundtrip(self):
+        rng = np.random.default_rng(3)
+        for _ in range(20):
+            m = rng.integers(0, 256, size=(6, 6)).astype(np.uint8)
+            try:
+                inv = gf256.gf_invert(m)
+            except np.linalg.LinAlgError:
+                continue
+            assert np.array_equal(
+                gf256.gf_matmul(m, inv), gf256.gf_identity(6)
+            )
+
+    def test_vandermonde(self):
+        vm = gf256.vandermonde(14, 10)
+        assert vm[0, 0] == 1 and vm[0, 1] == 0  # 0^0=1 (galExp), 0^1=0
+        assert vm[1, 5] == 1  # 1^n = 1
+        assert vm[2, 1] == 2 and vm[2, 8] == 29
+
+    def test_build_matrix_systematic(self):
+        m = gf256.build_matrix(10, 14)
+        assert m.shape == (14, 10)
+        assert np.array_equal(m[:10], gf256.gf_identity(10))
+
+    def test_build_matrix_mds(self):
+        # Any 10 of the 14 rows must be invertible (MDS property).
+        import itertools
+
+        m = gf256.build_matrix(10, 14)
+        rng = np.random.default_rng(4)
+        combos = list(itertools.combinations(range(14), 10))
+        sample = rng.choice(len(combos), size=60, replace=False)
+        for idx in sample:
+            rows = m[list(combos[idx])]
+            gf256.gf_invert(rows)  # raises if singular
+
+    def test_coeff_bit_matrix(self):
+        coeffs = gf256.parity_matrix(10, 14)
+        bits = gf256.coeff_bit_matrix(coeffs)
+        assert bits.shape == (32, 80)
+        rng = np.random.default_rng(5)
+        data = rng.integers(0, 256, size=10).astype(np.uint8)
+        # direct GF evaluation
+        expect = np.zeros(4, dtype=np.uint8)
+        for i in range(4):
+            acc = 0
+            for j in range(10):
+                acc ^= gf256.gf_mul(int(coeffs[i, j]), int(data[j]))
+            expect[i] = acc
+        # bit-matrix evaluation
+        in_bits = np.zeros(80, dtype=np.uint8)
+        for j in range(10):
+            for s in range(8):
+                in_bits[j * 8 + s] = (data[j] >> s) & 1
+        out_bits = (bits.astype(np.int32) @ in_bits.astype(np.int32)) & 1
+        got = np.zeros(4, dtype=np.uint8)
+        for i in range(4):
+            for r in range(8):
+                got[i] |= np.uint8(out_bits[i * 8 + r] << r)
+        assert np.array_equal(got, expect)

@@ -1,0 +1,116 @@
+"""Reed-Solomon codec semantics (NumPy reference implementation).
+
+Mirrors the contract of the reference's reedsolomon.Encoder usage
+(/root/reference/weed/storage/erasure_coding/ec_encoder.go and
+store_ec.go): Encode fills parity, Reconstruct fills all missing shards,
+ReconstructData fills only data shards; any 10 of 14 shards recover data."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.ops import gf256
+from seaweedfs_tpu.ops.rs_numpy import NumpyEncoder, ReconstructError
+
+
+@pytest.fixture(scope="module")
+def enc():
+    return NumpyEncoder(10, 4)
+
+
+def make_shards(enc, length=1024, seed=0):
+    rng = np.random.default_rng(seed)
+    data = [
+        rng.integers(0, 256, size=length).astype(np.uint8)
+        for _ in range(enc.data_shards)
+    ]
+    return enc.encode(data + [None] * enc.parity_shards)
+
+
+class TestEncode:
+    def test_systematic(self, enc):
+        shards = make_shards(enc)
+        assert len(shards) == 14
+        # data shards pass through unchanged
+        rng = np.random.default_rng(0)
+        expect0 = rng.integers(0, 256, size=1024).astype(np.uint8)
+        assert np.array_equal(shards[0], expect0)
+
+    def test_verify(self, enc):
+        shards = make_shards(enc)
+        assert enc.verify(shards)
+        shards[12] = shards[12].copy()
+        shards[12][5] ^= 1
+        assert not enc.verify(shards)
+
+    def test_zero_data_zero_parity(self, enc):
+        shards = enc.encode(
+            [np.zeros(64, dtype=np.uint8)] * 10 + [None] * 4
+        )
+        for p in shards[10:]:
+            assert not p.any()
+
+    def test_linearity(self, enc):
+        # RS is linear: encode(a ^ b) == encode(a) ^ encode(b)
+        a = make_shards(enc, seed=1)
+        b = make_shards(enc, seed=2)
+        xored_data = [x ^ y for x, y in zip(a[:10], b[:10])]
+        c = enc.encode(xored_data + [None] * 4)
+        for i in range(10, 14):
+            assert np.array_equal(c[i], a[i] ^ b[i])
+
+
+class TestReconstruct:
+    def test_any_four_missing(self, enc):
+        shards = make_shards(enc, length=257)
+        rng = np.random.default_rng(7)
+        combos = list(itertools.combinations(range(14), 4))
+        for idx in rng.choice(len(combos), size=40, replace=False):
+            missing = combos[idx]
+            damaged = [
+                None if i in missing else shards[i] for i in range(14)
+            ]
+            restored = enc.reconstruct(damaged)
+            for i in range(14):
+                assert np.array_equal(restored[i], shards[i]), f"shard {i}"
+
+    def test_reconstruct_data_leaves_parity_missing(self, enc):
+        shards = make_shards(enc)
+        damaged = list(shards)
+        damaged[3] = None
+        damaged[12] = None
+        restored = enc.reconstruct_data(damaged)
+        assert np.array_equal(restored[3], shards[3])
+        assert restored[12] is None
+
+    def test_too_few_shards(self, enc):
+        shards = make_shards(enc)
+        damaged = [None] * 5 + list(shards[5:])
+        assert len(damaged) == 14
+        with pytest.raises(ReconstructError):
+            enc.reconstruct(damaged)
+
+    def test_no_missing_is_noop(self, enc):
+        shards = make_shards(enc)
+        restored = enc.reconstruct(list(shards))
+        for i in range(14):
+            assert np.array_equal(restored[i], shards[i])
+
+
+class TestOtherGeometries:
+    @pytest.mark.parametrize("d,p", [(4, 2), (6, 3), (17, 3)])
+    def test_roundtrip(self, d, p):
+        enc = NumpyEncoder(d, p)
+        rng = np.random.default_rng(11)
+        data = [
+            rng.integers(0, 256, size=100).astype(np.uint8) for _ in range(d)
+        ]
+        shards = enc.encode(data + [None] * p)
+        assert enc.verify(shards)
+        damaged = list(shards)
+        for i in range(p):
+            damaged[i * 2 % (d + p)] = None
+        restored = enc.reconstruct(damaged)
+        for i in range(d + p):
+            assert np.array_equal(restored[i], shards[i])
