@@ -1,0 +1,150 @@
+"""Benchmark: RS(10,4) encode throughput, TPU kernels vs AVX2 CPU baseline.
+
+Metric: GiB/s of volume data encoded (data-shard bytes in; parity adds 0.4x
+on top).  Baseline: the native AVX2 nibble-shuffle codec in
+native/ec_native.cpp — the same algorithm class as klauspost/reedsolomon's
+SIMD kernels the reference calls (BASELINE.md: no published EC number, so
+the baseline is measured on this machine).
+
+Methodology: the axon relay makes block_until_ready unreliable and adds
+10s-of-ms round-trip latency, so each measurement jits a chain of K
+serialised encodes (1-element data dependency between steps) and reports
+the slope between two chain lengths — dispatch and relay latency cancel.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "GiB/s", "vs_baseline": N, ...}
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+GIB = float(1 << 30)
+
+
+def bench_cpu_baseline(length: int = 64 << 20, reps: int = 3) -> float:
+    """AVX2 C++ encode GiB/s on (10, length)."""
+    from seaweedfs_tpu.ops.codec import NativeEncoder
+
+    try:
+        enc = NativeEncoder(10, 4)
+    except RuntimeError:
+        return 0.0
+    rng = np.random.default_rng(0)
+    data = rng.integers(0, 256, size=(10, length), dtype=np.uint8)
+    matrix = np.asarray(enc.matrix[10:])
+    best = 0.0
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        enc._apply(matrix, data)
+        dt = time.perf_counter() - t0
+        best = max(best, data.nbytes / GIB / dt)
+    return best
+
+
+def _make_kernel(method: str, block: int | None):
+    from seaweedfs_tpu.ops import gf256, rs_pallas
+    from seaweedfs_tpu.ops.rs_jax import (_apply_mxu, _bit_matrix_cached,
+                                          _matrix_key, apply_matrix_swar)
+
+    matrix = gf256.parity_matrix(10, 14)
+    if method == "mxu":
+        bm = _bit_matrix_cached(*_matrix_key(matrix))
+        return lambda x: _apply_mxu(bm, x)
+    if method == "pallas":
+        return lambda x: rs_pallas.apply_matrix_pallas(
+            matrix, x, **({"block": block} if block else {}))
+    if method == "swar":
+        return lambda x: apply_matrix_swar(matrix, x)
+    raise ValueError(method)
+
+
+def bench_tpu(method: str, length: int, block: int | None = None,
+              chains: tuple[int, int] = (2, 10), reps: int = 3) -> float:
+    """Slope-based device throughput in GiB/s for one kernel variant."""
+    import jax
+    import jax.numpy as jnp
+
+    kernel = _make_kernel(method, block)
+
+    @jax.jit
+    def gen(key):
+        return jax.random.randint(key, (10, length), 0, 256, dtype=jnp.uint8)
+
+    data = gen(jax.random.PRNGKey(0))
+    np.asarray(data[0, :8])  # force materialization
+
+    def chain(k):
+        @jax.jit
+        def f(x):
+            acc, out = x, None
+            for _ in range(k):
+                out = kernel(acc)
+                acc = acc.at[0, 0].set(out[0, 0])  # serialising dependency
+            return out[0, :8]
+        return f
+
+    times = {}
+    for k in chains:
+        f = chain(k)
+        np.asarray(f(data))  # compile + warm
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            np.asarray(f(data))
+            best = min(best, time.perf_counter() - t0)
+        times[k] = best
+    per_encode = (times[chains[1]] - times[chains[0]]) / (
+        chains[1] - chains[0])
+    if per_encode <= 0:
+        return 0.0
+    return (10 * length) / GIB / per_encode
+
+
+def main():
+    import jax
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+
+    cpu_gibps = bench_cpu_baseline()
+
+    candidates: dict[str, float] = {}
+    probe_len = (64 << 20) if on_tpu else (8 << 20)
+    for method, block in (("pallas", 8192), ("pallas", 32768),
+                          ("mxu", None)):
+        name = f"{method}{block or ''}"
+        try:
+            candidates[name] = bench_tpu(method, probe_len, block=block,
+                                         chains=(2, 6), reps=2)
+        except Exception as e:
+            print(f"note: {name} failed: {e}", file=sys.stderr)
+
+    final, best_name = 0.0, "none"
+    if candidates:
+        best_name = max(candidates, key=candidates.get)
+        method = "pallas" if best_name.startswith("pallas") else best_name
+        block = (int(best_name[len("pallas"):])
+                 if best_name.startswith("pallas") else None)
+        length = (256 << 20) if on_tpu else (8 << 20)
+        final = bench_tpu(method, length, block=block)
+
+    vs_baseline = final / cpu_gibps if cpu_gibps > 0 else 0.0
+    print(json.dumps({
+        "metric": "rs10_4_encode_throughput",
+        "value": round(final, 3),
+        "unit": "GiB/s",
+        "vs_baseline": round(vs_baseline, 3),
+        "platform": platform,
+        "kernel": best_name,
+        "cpu_avx2_baseline_gibps": round(cpu_gibps, 3),
+        "probe": {k: round(v, 3) for k, v in candidates.items()},
+    }))
+
+
+if __name__ == "__main__":
+    main()

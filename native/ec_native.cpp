@@ -28,29 +28,35 @@ extern "C" {
 // CRC32C
 // ---------------------------------------------------------------------------
 
-static uint32_t crc32c_table[8][256];
-static bool crc32c_table_init_done = false;
-
-static void crc32c_table_init() {
-    const uint32_t poly = 0x82F63B78u;  // reflected Castagnoli
-    for (uint32_t i = 0; i < 256; i++) {
-        uint32_t crc = i;
-        for (int j = 0; j < 8; j++)
-            crc = (crc >> 1) ^ ((crc & 1) ? poly : 0);
-        crc32c_table[0][i] = crc;
-    }
-    for (uint32_t i = 0; i < 256; i++) {
-        uint32_t crc = crc32c_table[0][i];
-        for (int s = 1; s < 8; s++) {
-            crc = crc32c_table[0][crc & 0xFF] ^ (crc >> 8);
-            crc32c_table[s][i] = crc;
+// Thread-safe lazy init via C++11 magic statics (ctypes calls drop the GIL,
+// so first use can race across Python threads).
+struct Crc32cTables {
+    uint32_t t[8][256];
+    Crc32cTables() {
+        const uint32_t poly = 0x82F63B78u;  // reflected Castagnoli
+        for (uint32_t i = 0; i < 256; i++) {
+            uint32_t crc = i;
+            for (int j = 0; j < 8; j++)
+                crc = (crc >> 1) ^ ((crc & 1) ? poly : 0);
+            t[0][i] = crc;
+        }
+        for (uint32_t i = 0; i < 256; i++) {
+            uint32_t crc = t[0][i];
+            for (int s = 1; s < 8; s++) {
+                crc = t[0][crc & 0xFF] ^ (crc >> 8);
+                t[s][i] = crc;
+            }
         }
     }
-    crc32c_table_init_done = true;
+};
+
+static const uint32_t (*crc32c_tables())[256] {
+    static const Crc32cTables tables;
+    return tables.t;
 }
 
 static uint32_t crc32c_sw(uint32_t crc, const uint8_t* data, size_t len) {
-    if (!crc32c_table_init_done) crc32c_table_init();
+    const uint32_t (*crc32c_table)[256] = crc32c_tables();
     crc = ~crc;
     while (len >= 8) {
         uint64_t word;
@@ -98,29 +104,34 @@ uint32_t sw_crc32c(uint32_t crc, const uint8_t* data, size_t len) {
 // GF(2^8) — field 0x11D, matching klauspost/reedsolomon & Backblaze
 // ---------------------------------------------------------------------------
 
-static uint8_t gf_mul_table[256][256];
-static bool gf_init_done = false;
-
-static void gf_init() {
-    uint8_t exp_t[510];
-    int log_t[256] = {0};
-    int x = 1;
-    for (int i = 0; i < 255; i++) {
-        exp_t[i] = (uint8_t)x;
-        log_t[x] = i;
-        x <<= 1;
-        if (x & 0x100) x ^= 0x11D;
+struct GfTables {
+    uint8_t mul[256][256];
+    GfTables() {
+        uint8_t exp_t[510];
+        int log_t[256] = {0};
+        int x = 1;
+        for (int i = 0; i < 255; i++) {
+            exp_t[i] = (uint8_t)x;
+            log_t[x] = i;
+            x <<= 1;
+            if (x & 0x100) x ^= 0x11D;
+        }
+        for (int i = 255; i < 510; i++) exp_t[i] = exp_t[i - 255];
+        for (int a = 0; a < 256; a++)
+            for (int b = 0; b < 256; b++)
+                mul[a][b] = (a && b) ? exp_t[log_t[a] + log_t[b]] : 0;
     }
-    for (int i = 255; i < 510; i++) exp_t[i] = exp_t[i - 255];
-    for (int a = 0; a < 256; a++)
-        for (int b = 0; b < 256; b++)
-            gf_mul_table[a][b] = (a && b) ? exp_t[log_t[a] + log_t[b]] : 0;
-    gf_init_done = true;
+};
+
+static const uint8_t (*gf_mul_tables())[256] {
+    static const GfTables tables;
+    return tables.mul;
 }
 
 static void gf_apply_row_scalar(const uint8_t* coeffs, int d,
                                 const uint8_t* data, size_t len,
                                 uint8_t* out) {
+    const uint8_t (*gf_mul_table)[256] = gf_mul_tables();
     memset(out, 0, len);
     for (int j = 0; j < d; j++) {
         const uint8_t* table = gf_mul_table[coeffs[j]];
@@ -137,6 +148,7 @@ static void gf_apply_row_avx2(const uint8_t* coeffs, int d,
                               const uint8_t* data, size_t len,
                               uint8_t* out) {
     size_t vec_len = len & ~(size_t)31;
+    const uint8_t (*gf_mul_table)[256] = gf_mul_tables();
     __m256i low_mask = _mm256_set1_epi8(0x0F);
     memset(out, 0, len);
     for (int j = 0; j < d; j++) {
@@ -168,7 +180,7 @@ static void gf_apply_row_avx2(const uint8_t* coeffs, int d,
 // out[i*len .. ] = XOR_j gf_mul(matrix[i*d+j], data[j*len ..])
 void sw_gf_apply_matrix(const uint8_t* matrix, int p, int d,
                         const uint8_t* data, size_t len, uint8_t* out) {
-    if (!gf_init_done) gf_init();
+    (void)gf_mul_tables();  // ensure tables exist before dispatch
 #if defined(__x86_64__)
     bool avx2 = __builtin_cpu_supports("avx2");
 #else

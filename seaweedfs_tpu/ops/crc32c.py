@@ -63,11 +63,17 @@ def _crc32c_py(crc: int, data: bytes) -> int:
 
 def crc32c(data, crc: int = 0) -> int:
     """CRC32C of `data` (bytes-like or uint8 ndarray), seeded with `crc`."""
-    if isinstance(data, np.ndarray):
-        data = data.tobytes()
-    elif not isinstance(data, (bytes, bytearray)):
-        data = bytes(data)
     cdll = native.lib()
+    if isinstance(data, np.ndarray):
+        data = np.ascontiguousarray(data.reshape(-1).view(np.uint8))
+        if cdll is not None:
+            import ctypes
+
+            return cdll.sw_crc32c(
+                crc, data.ctypes.data_as(ctypes.c_char_p), data.nbytes)
+        return _crc32c_py(crc, data.tobytes())
+    if not isinstance(data, (bytes, bytearray)):
+        data = bytes(data)
     if cdll is not None:
         return cdll.sw_crc32c(crc, bytes(data), len(data))
     return _crc32c_py(crc, bytes(data))

@@ -20,13 +20,16 @@ _LIB_PATH = os.path.join(_NATIVE_DIR, "libseaweedec.so")
 
 @functools.lru_cache(maxsize=1)
 def lib() -> ctypes.CDLL | None:
-    if not os.path.exists(_LIB_PATH):
-        try:
-            subprocess.run(
-                ["make", "-s"], cwd=_NATIVE_DIR, check=True,
-                capture_output=True, timeout=120,
-            )
-        except Exception:
+    # run make unconditionally: it is a no-op when the .so is fresh and
+    # rebuilds after ec_native.cpp edits (a missing toolchain only matters
+    # when there is no prebuilt library at all)
+    try:
+        subprocess.run(
+            ["make", "-s"], cwd=_NATIVE_DIR, check=True,
+            capture_output=True, timeout=120,
+        )
+    except Exception:
+        if not os.path.exists(_LIB_PATH):
             return None
     try:
         cdll = ctypes.CDLL(_LIB_PATH)

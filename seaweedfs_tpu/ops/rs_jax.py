@@ -33,21 +33,21 @@ from .rs_numpy import RSCodecBase
 _SPREAD = 0x01010101  # one set bit per packed byte
 
 
+# The caches hold host (NumPy) arrays: caching jnp arrays would capture a
+# tracer if the first call happened under a jit trace.
 @functools.lru_cache(maxsize=64)
-def _bit_constants_cached(matrix_bytes: bytes, p: int, d: int) -> jax.Array:
+def _bit_constants_cached(matrix_bytes: bytes, p: int, d: int) -> np.ndarray:
     """K[i, j, b] = gf_mul(matrix[i, j], 1 << b), shape (p, d, 8) int32."""
     matrix = np.frombuffer(matrix_bytes, dtype=np.uint8).reshape(p, d)
     mt = gf256.mul_table()
     powers = (1 << np.arange(8)).astype(np.uint8)
-    return jnp.asarray(
-        mt[matrix[:, :, None], powers[None, None, :]].astype(np.int32)
-    )
+    return mt[matrix[:, :, None], powers[None, None, :]].astype(np.int32)
 
 
 @functools.lru_cache(maxsize=64)
-def _bit_matrix_cached(matrix_bytes: bytes, p: int, d: int) -> jax.Array:
+def _bit_matrix_cached(matrix_bytes: bytes, p: int, d: int) -> np.ndarray:
     matrix = np.frombuffer(matrix_bytes, dtype=np.uint8).reshape(p, d)
-    return jnp.asarray(gf256.coeff_bit_matrix(matrix).astype(np.int8))
+    return gf256.coeff_bit_matrix(matrix).astype(np.int8)
 
 
 def _matrix_key(matrix: np.ndarray) -> tuple[bytes, int, int]:
@@ -76,7 +76,7 @@ def apply_matrix_swar(matrix: np.ndarray, data: jax.Array) -> jax.Array:
     pad = (-length) % 4
     if pad:
         data = jnp.pad(data, ((0, 0), (0, pad)))
-    consts = _bit_constants_cached(*_matrix_key(matrix))
+    consts = jnp.asarray(_bit_constants_cached(*_matrix_key(matrix)))
     data32 = jax.lax.bitcast_convert_type(
         data.reshape(d, (length + pad) // 4, 4), jnp.int32
     )
@@ -103,7 +103,7 @@ def _apply_mxu(bit_matrix: jax.Array, data: jax.Array) -> jax.Array:
 
 
 def apply_matrix_mxu(matrix: np.ndarray, data: jax.Array) -> jax.Array:
-    bm = _bit_matrix_cached(*_matrix_key(matrix))
+    bm = jnp.asarray(_bit_matrix_cached(*_matrix_key(matrix)))
     return _apply_mxu(bm, data)
 
 

@@ -81,7 +81,7 @@ def apply_matrix_pallas(matrix: np.ndarray, data, block: int = DEFAULT_BLOCK,
     from .rs_jax import _bit_matrix_cached, _matrix_key
 
     p, d = matrix.shape
-    bm = _bit_matrix_cached(*_matrix_key(matrix))
+    bm = jnp.asarray(_bit_matrix_cached(*_matrix_key(matrix)))
     data = jnp.asarray(data, dtype=jnp.uint8)
     if interpret is None:
         interpret = not on_tpu()

@@ -1,0 +1,352 @@
+"""EC volume runtime: shard handles, sorted-index search, EC reads, deletes.
+
+Parity with ec_volume.go / ec_shard.go / ec_volume_delete.go / store_ec.go:
+  * .ecx binary search over 16-byte sorted entries (SearchNeedleFromSortedIndex,
+    ec_volume.go:230-255)
+  * read ladder per interval: local shard pread, else remote fetch (hook),
+    else reconstruct the interval from >=10 other shards
+    (readOneEcShardInterval/recoverOneRemoteEcShardInterval,
+    store_ec.go:188-218,328-382)
+  * delete = tombstone the size field inside .ecx in place + append the id to
+    the .ecj journal (ec_volume_delete.go:13-50); RebuildEcxFile replays the
+    journal (ec_volume_delete.go:53-98)
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+from typing import Callable, Optional
+
+import numpy as np
+
+from ...ops import codec as codec_mod
+from .. import types as t
+from ..needle import Needle, get_actual_size
+from . import (DATA_SHARDS_COUNT, LARGE_BLOCK_SIZE, SMALL_BLOCK_SIZE,
+               TOTAL_SHARDS_COUNT, to_ext)
+from .locate import Interval, locate_data
+
+
+class EcError(Exception):
+    pass
+
+
+class EcNotFoundError(EcError):
+    pass
+
+
+class EcDeletedError(EcError):
+    pass
+
+
+class ShardBits:
+    """uint32 bitmask of shard ids (ec_volume_info.go:65-117)."""
+
+    def __init__(self, bits: int = 0):
+        self.bits = bits & 0xFFFFFFFF
+
+    def add(self, shard_id: int) -> "ShardBits":
+        return ShardBits(self.bits | (1 << shard_id))
+
+    def remove(self, shard_id: int) -> "ShardBits":
+        return ShardBits(self.bits & ~(1 << shard_id))
+
+    def has(self, shard_id: int) -> bool:
+        return bool(self.bits & (1 << shard_id))
+
+    def shard_ids(self) -> list[int]:
+        return [i for i in range(TOTAL_SHARDS_COUNT) if self.has(i)]
+
+    def count(self) -> int:
+        return bin(self.bits).count("1")
+
+    def minus(self, other: "ShardBits") -> "ShardBits":
+        return ShardBits(self.bits & ~other.bits)
+
+    def plus(self, other: "ShardBits") -> "ShardBits":
+        return ShardBits(self.bits | other.bits)
+
+    def __eq__(self, other):
+        return isinstance(other, ShardBits) and self.bits == other.bits
+
+    def __repr__(self):
+        return f"ShardBits({self.shard_ids()})"
+
+
+class EcVolumeShard:
+    """One open .ecNN file (ec_shard.go:17-97)."""
+
+    def __init__(self, directory: str, collection: str, vid: int,
+                 shard_id: int):
+        self.dir = directory
+        self.collection = collection
+        self.volume_id = vid
+        self.shard_id = shard_id
+        self._f = open(self.file_name(), "rb")
+        self.ecd_file_size = os.path.getsize(self.file_name())
+
+    def base_file_name(self) -> str:
+        base = (f"{self.collection}_{self.volume_id}" if self.collection
+                else str(self.volume_id))
+        return os.path.join(self.dir, base)
+
+    def file_name(self) -> str:
+        return self.base_file_name() + to_ext(self.shard_id)
+
+    def read_at(self, size: int, offset: int) -> bytes:
+        return os.pread(self._f.fileno(), size, offset)
+
+    def close(self):
+        if self._f:
+            self._f.close()
+            self._f = None
+
+    def destroy(self):
+        self.close()
+        os.remove(self.file_name())
+
+
+# Remote fetch hook: (shard_id, offset, size) -> bytes | None
+ShardReader = Callable[[int, int, int], Optional[bytes]]
+
+
+def search_sorted_index(fileno: int, n_entries: int,
+                        needle_id: int) -> Optional[int]:
+    """Binary search 16-byte sorted entries by pread; returns entry index
+    (SearchNeedleFromSortedIndex, ec_volume.go:230-255)."""
+    from .. import idx as idx_mod
+
+    lo, hi = 0, n_entries
+    while lo < hi:
+        mid = (lo + hi) // 2
+        buf = os.pread(fileno, t.NEEDLE_MAP_ENTRY_SIZE,
+                       mid * t.NEEDLE_MAP_ENTRY_SIZE)
+        key, _, _ = idx_mod.unpack_entry(buf)
+        if key == needle_id:
+            return mid
+        if key < needle_id:
+            lo = mid + 1
+        else:
+            hi = mid
+    return None
+
+
+class EcVolume:
+    """A mounted EC volume: local shard subset + .ecx/.ecj handles."""
+
+    def __init__(self, directory: str, collection: str, vid: int,
+                 version: int = 3, encoder=None,
+                 large_block_size: int = LARGE_BLOCK_SIZE,
+                 small_block_size: int = SMALL_BLOCK_SIZE):
+        self.dir = directory
+        self.collection = collection
+        self.volume_id = vid
+        self.version = version
+        self.large_block_size = large_block_size
+        self.small_block_size = small_block_size
+        self.shards: dict[int, EcVolumeShard] = {}
+        self.shard_locations: dict[int, list[str]] = {}  # shard id -> addrs
+        self.remote_reader: Optional[ShardReader] = None
+        self._encoder = encoder or codec_mod.new_encoder(
+            DATA_SHARDS_COUNT, TOTAL_SHARDS_COUNT - DATA_SHARDS_COUNT)
+        self._ecx_lock = threading.Lock()
+        self._ecj_lock = threading.Lock()
+        base = self.base_file_name()
+        self._ecx = open(base + ".ecx", "r+b")
+        self.ecx_file_size = os.path.getsize(base + ".ecx")
+        self._ecj = open(base + ".ecj", "a+b")
+        self.ecj_file_size = os.path.getsize(base + ".ecj")
+
+    def base_file_name(self) -> str:
+        base = (f"{self.collection}_{self.volume_id}" if self.collection
+                else str(self.volume_id))
+        return os.path.join(self.dir, base)
+
+    # -- shard management ----------------------------------------------------
+    def add_shard(self, shard: EcVolumeShard) -> bool:
+        if shard.shard_id in self.shards:
+            return False
+        self.shards[shard.shard_id] = shard
+        return True
+
+    def delete_shard(self, shard_id: int) -> Optional[EcVolumeShard]:
+        return self.shards.pop(shard_id, None)
+
+    def shard_bits(self) -> ShardBits:
+        bits = ShardBits()
+        for sid in self.shards:
+            bits = bits.add(sid)
+        return bits
+
+    @property
+    def shard_size(self) -> int:
+        if not self.shards:
+            return 0
+        return next(iter(self.shards.values())).ecd_file_size
+
+    # -- sorted-index search -------------------------------------------------
+    def find_needle_from_ecx(self, needle_id: int) -> tuple[int, int]:
+        """Binary search the sorted .ecx -> (offset, size); raises
+        EcNotFoundError when absent."""
+        entry_pos = self._search_ecx(needle_id)
+        if entry_pos is None:
+            raise EcNotFoundError(f"needle {needle_id:x} not found")
+        _, offset, size = self._read_ecx_entry(entry_pos)
+        return offset, size
+
+    def _read_ecx_entry(self, pos: int) -> tuple[int, int, int]:
+        buf = os.pread(self._ecx.fileno(), t.NEEDLE_MAP_ENTRY_SIZE,
+                       pos * t.NEEDLE_MAP_ENTRY_SIZE)
+        from .. import idx as idx_mod
+
+        return idx_mod.unpack_entry(buf)
+
+    def _search_ecx(self, needle_id: int) -> Optional[int]:
+        return search_sorted_index(
+            self._ecx.fileno(),
+            self.ecx_file_size // t.NEEDLE_MAP_ENTRY_SIZE, needle_id)
+
+    # -- needle read (store_ec.go ReadEcShardNeedle:125-163) ------------------
+    def locate_needle(self, needle_id: int
+                      ) -> tuple[int, int, list[Interval]]:
+        offset, size = self.find_needle_from_ecx(needle_id)
+        if t.size_is_deleted(size):
+            raise EcDeletedError(f"needle {needle_id:x} deleted")
+        intervals = locate_data(
+            self.large_block_size, self.small_block_size,
+            DATA_SHARDS_COUNT * self.shard_size,
+            offset, get_actual_size(size, self.version))
+        return offset, size, intervals
+
+    def read_needle(self, needle_id: int,
+                    cookie: Optional[int] = None) -> Needle:
+        offset, size, intervals = self.locate_needle(needle_id)
+        parts = [self._read_interval(iv) for iv in intervals]
+        blob = b"".join(parts)
+        n = Needle()
+        n.read_bytes(blob, offset, size, self.version)
+        if cookie is not None and n.cookie != cookie:
+            raise EcError(f"cookie mismatch for needle {needle_id:x}")
+        return n
+
+    def _read_interval(self, iv: Interval) -> bytes:
+        shard_id, inner_offset = iv.to_shard_id_and_offset(
+            self.large_block_size, self.small_block_size)
+        return self.read_shard_span(shard_id, inner_offset, iv.size)
+
+    def read_shard_span(self, shard_id: int, offset: int, size: int) -> bytes:
+        """Read ladder: local shard -> remote hook -> reconstruct."""
+        shard = self.shards.get(shard_id)
+        if shard is not None:
+            data = shard.read_at(size, offset)
+            if len(data) == size:
+                return data
+            raise EcError(
+                f"short read shard {shard_id} at {offset}+{size}")
+        if self.remote_reader is not None:
+            data = self.remote_reader(shard_id, offset, size)
+            if data is not None:
+                if len(data) != size:
+                    raise EcError(f"short remote read shard {shard_id}")
+                return data
+        return self._recover_span(shard_id, offset, size)
+
+    def _recover_span(self, target_shard: int, offset: int,
+                      size: int) -> bytes:
+        """On-the-fly reconstruction of one missing shard's span from >=10
+        other shards (recoverOneRemoteEcShardInterval, store_ec.go:328-382)."""
+        shards: list[Optional[np.ndarray]] = [None] * TOTAL_SHARDS_COUNT
+        have = 0
+        for sid in range(TOTAL_SHARDS_COUNT):
+            if sid == target_shard or have >= DATA_SHARDS_COUNT:
+                continue
+            data = None
+            shard = self.shards.get(sid)
+            if shard is not None:
+                data = shard.read_at(size, offset)
+                if len(data) != size:
+                    data = None
+            elif self.remote_reader is not None:
+                data = self.remote_reader(sid, offset, size)
+                if data is not None and len(data) != size:
+                    data = None
+            if data is not None:
+                shards[sid] = np.frombuffer(data, dtype=np.uint8)
+                have += 1
+        if have < DATA_SHARDS_COUNT:
+            raise EcError(
+                f"need {DATA_SHARDS_COUNT} shards to recover shard "
+                f"{target_shard}, only {have} available")
+        restored = self._encoder.reconstruct(shards)
+        return np.ascontiguousarray(restored[target_shard]).tobytes()
+
+    # -- delete (ec_volume_delete.go) -----------------------------------------
+    def delete_needle(self, needle_id: int):
+        """Tombstone the .ecx entry in place + journal the id in .ecj."""
+        with self._ecx_lock:
+            pos = self._search_ecx(needle_id)
+            if pos is None:
+                return
+            self._mark_ecx_deleted(pos)
+        with self._ecj_lock:
+            self._ecj.seek(0, 2)
+            self._ecj.write(struct.pack(">Q", needle_id))
+            self._ecj.flush()
+            self.ecj_file_size += t.NEEDLE_ID_SIZE
+
+    def _mark_ecx_deleted(self, pos: int):
+        size_off = (pos * t.NEEDLE_MAP_ENTRY_SIZE
+                    + t.NEEDLE_ID_SIZE + t.OFFSET_SIZE)
+        os.pwrite(self._ecx.fileno(),
+                  struct.pack(">i", t.TOMBSTONE_FILE_SIZE), size_off)
+
+    # -- lifecycle ------------------------------------------------------------
+    def close(self):
+        for shard in self.shards.values():
+            shard.close()
+        self.shards.clear()
+        if self._ecx:
+            self._ecx.close()
+            self._ecx = None
+        if self._ecj:
+            self._ecj.close()
+            self._ecj = None
+
+    def destroy(self):
+        base = self.base_file_name()
+        for shard in list(self.shards.values()):
+            shard.destroy()
+        self.shards.clear()
+        self.close()
+        for ext in (".ecx", ".ecj", ".vif"):
+            try:
+                os.remove(base + ext)
+            except FileNotFoundError:
+                pass
+
+
+def rebuild_ecx_file(base_file_name: str):
+    """Replay .ecj tombstones into .ecx then remove the journal
+    (RebuildEcxFile, ec_volume_delete.go:53-98)."""
+    if not os.path.exists(base_file_name + ".ecj"):
+        return
+    with open(base_file_name + ".ecx", "r+b") as ecx:
+        ecx_size = os.path.getsize(base_file_name + ".ecx")
+        n_entries = ecx_size // t.NEEDLE_MAP_ENTRY_SIZE
+
+        with open(base_file_name + ".ecj", "rb") as ecj:
+            while True:
+                buf = ecj.read(t.NEEDLE_ID_SIZE)
+                if len(buf) != t.NEEDLE_ID_SIZE:
+                    break
+                pos = search_sorted_index(
+                    ecx.fileno(), n_entries, struct.unpack(">Q", buf)[0])
+                if pos is not None:
+                    size_off = (pos * t.NEEDLE_MAP_ENTRY_SIZE
+                                + t.NEEDLE_ID_SIZE + t.OFFSET_SIZE)
+                    os.pwrite(ecx.fileno(),
+                              struct.pack(">i", t.TOMBSTONE_FILE_SIZE),
+                              size_off)
+    os.remove(base_file_name + ".ecj")

@@ -1,0 +1,443 @@
+"""Volume: one append-only .dat + .idx pair with an in-RAM needle index.
+
+Semantics parity with the reference's weed/storage/volume*.go:
+  * write: dedup identical re-writes (volume_write.go isFileUnchanged:34-53),
+    cookie check against existing needle (doWriteRequest:143-160), append-only
+    with monotonic needle-map updates
+  * delete: append a zero-data tombstone needle, record TombstoneFileSize in
+    the index (doDeleteRequest:211-231)
+  * read: index lookup -> one pread -> CRC verify (volume_read.go:19-60)
+  * vacuum: Compact2 copy-live-by-index into .cpd/.cpx with bumped compaction
+    revision, then CommitCompact with makeupDiff replaying writes that raced
+    the copy (volume_vacuum.go:67,102,190)
+  * load: superblock read + index/dat integrity check that truncates a
+    corrupt tail (volume_checking.go:17-60)
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Optional
+
+from . import idx as idx_mod
+from . import types as t
+from .backend import DiskFile
+from .needle import (CURRENT_VERSION, Needle, NeedleError, get_actual_size,
+                     read_needle_header)
+from .needle_map import NeedleMap
+from .super_block import SUPER_BLOCK_SIZE, ReplicaPlacement, SuperBlock
+from .ttl import EMPTY_TTL, TTL
+
+
+class VolumeError(Exception):
+    pass
+
+
+class NotFoundError(VolumeError):
+    pass
+
+
+class DeletedError(VolumeError):
+    pass
+
+
+class CookieMismatchError(VolumeError):
+    pass
+
+
+class Volume:
+    def __init__(self, directory: str, collection: str, vid: int,
+                 replica_placement: Optional[ReplicaPlacement] = None,
+                 ttl: TTL = EMPTY_TTL, preallocate: int = 0):
+        self.dir = directory
+        self.collection = collection
+        self.id = vid
+        self.lock = threading.RLock()
+        self.data: Optional[DiskFile] = None
+        self.nm: Optional[NeedleMap] = None
+        self.last_append_at_ns = 0
+        self.last_modified_ts = 0
+        self.is_compacting = False
+        self.last_compact_index_offset = 0
+        self.last_compact_revision = 0
+        self.read_only = False
+        self._load(create_if_missing=True,
+                   replica_placement=replica_placement or ReplicaPlacement(),
+                   ttl=ttl)
+
+    # -- naming --------------------------------------------------------------
+    def file_name(self, ext: str = "") -> str:
+        base = (f"{self.collection}_{self.id}" if self.collection
+                else str(self.id))
+        return os.path.join(self.dir, base + ext)
+
+    @property
+    def version(self) -> int:
+        return self.super_block.version
+
+    @property
+    def ttl(self) -> TTL:
+        return self.super_block.ttl
+
+    # -- load/create ---------------------------------------------------------
+    def _load(self, create_if_missing: bool, replica_placement=None,
+              ttl: TTL = EMPTY_TTL):
+        dat = self.file_name(".dat")
+        exists = os.path.exists(dat)
+        if not exists:
+            if not create_if_missing:
+                raise VolumeError(f"volume data file {dat} does not exist")
+            self.data = DiskFile(dat, create=True)
+            self.super_block = SuperBlock(
+                version=CURRENT_VERSION,
+                replica_placement=replica_placement or ReplicaPlacement(),
+                ttl=ttl,
+            )
+            self.data.write_at(self.super_block.to_bytes(), 0)
+        else:
+            self.data = DiskFile(dat)
+            with open(dat, "rb") as f:
+                self.super_block = SuperBlock.from_file(f)
+        idx_path = self.file_name(".idx")
+        if exists:
+            self.last_append_at_ns = self._check_integrity(idx_path)
+        self.nm = NeedleMap(idx_path)
+
+    def _check_integrity(self, idx_path: str) -> int:
+        """Verify index<->dat consistency; truncate corrupt tails.
+        Mirrors CheckAndFixVolumeDataIntegrity (volume_checking.go:17-46)."""
+        if not os.path.exists(idx_path):
+            if self.data.size() > self.super_block.block_size:
+                raise VolumeError(f"idx file {idx_path} does not exist")
+            return 0
+        index_size = os.path.getsize(idx_path)
+        if index_size % t.NEEDLE_MAP_ENTRY_SIZE != 0:
+            index_size -= index_size % t.NEEDLE_MAP_ENTRY_SIZE
+            with open(idx_path, "r+b") as f:
+                f.truncate(index_size)
+        if index_size == 0:
+            return 0
+        healthy = index_size
+        last_ns = 0
+        with open(idx_path, "rb") as f:
+            for i in range(1, 11):
+                off = index_size - i * t.NEEDLE_MAP_ENTRY_SIZE
+                if off < 0:
+                    break
+                f.seek(off)
+                nid, a_off, size = idx_mod.unpack_entry(
+                    f.read(t.NEEDLE_MAP_ENTRY_SIZE))
+                try:
+                    last_ns = self._verify_entry(nid, a_off, size)
+                    break
+                except EOFError:
+                    healthy = off
+                    continue
+                except VolumeError:
+                    break
+        if healthy < index_size:
+            with open(idx_path, "r+b") as f:
+                f.truncate(healthy)
+        return last_ns
+
+    def _verify_entry(self, nid: int, offset: int, size: int) -> int:
+        if offset == 0:
+            return 0
+        if size < 0:
+            # deletion entry: tombstone needle sits at EOF
+            disk = get_actual_size(0, self.version)
+            blob = self.data.read_at(disk, self.data.size() - disk)
+            if len(blob) < disk:
+                raise EOFError
+            n = Needle()
+            n.read_bytes(blob, self.data.size() - disk, 0, self.version)
+            if n.id != nid:
+                raise VolumeError(
+                    f"index key {nid:x} != needle id {n.id:x}")
+            return n.append_at_ns
+        header = self.data.read_at(t.NEEDLE_HEADER_SIZE, offset)
+        if len(header) < t.NEEDLE_HEADER_SIZE:
+            raise EOFError
+        n, _ = read_needle_header(header)
+        if n.size != size:
+            raise VolumeError("size mismatch")
+        ts_off = (offset + t.NEEDLE_HEADER_SIZE + size
+                  + t.NEEDLE_CHECKSUM_SIZE)
+        ts = self.data.read_at(t.TIMESTAMP_SIZE, ts_off)
+        if len(ts) < t.TIMESTAMP_SIZE:
+            raise EOFError
+        append_at_ns = int.from_bytes(ts, "big")
+        tail = offset + get_actual_size(size, self.version)
+        if self.data.size() > tail:
+            self.data.truncate(tail)
+        return append_at_ns
+
+    # -- write ---------------------------------------------------------------
+    def _is_file_unchanged(self, n: Needle) -> bool:
+        if self.ttl:
+            return False
+        nv = self.nm.get(n.id)
+        if nv is None or nv.offset == 0 or not t.size_is_valid(nv.size):
+            return False
+        old = Needle()
+        try:
+            blob = self.data.read_at(
+                get_actual_size(nv.size, self.version), nv.offset)
+            old.read_bytes(blob, nv.offset, nv.size, self.version)
+        except (NeedleError, Exception):
+            return False
+        return (old.cookie == n.cookie and old.checksum == n.checksum
+                and old.data == n.data)
+
+    def write_needle(self, n: Needle, check_cookie: bool = True
+                     ) -> tuple[int, int, bool]:
+        """Append a needle; returns (offset, size, is_unchanged)."""
+        with self.lock:
+            if self.read_only:
+                raise VolumeError(f"volume {self.id} is read only")
+            actual = get_actual_size(len(n.data), self.version)
+            if self.nm.content_size() + actual > t.MAX_POSSIBLE_VOLUME_SIZE:
+                raise VolumeError(
+                    f"volume size limit {t.MAX_POSSIBLE_VOLUME_SIZE} exceeded")
+            if not n.has_ttl and self.ttl:
+                n.ttl = self.ttl
+                n._set_flag(0x10)
+            if self._is_file_unchanged(n):
+                return 0, len(n.data), True
+            nv = self.nm.get(n.id)
+            if nv is not None:
+                header = self.data.read_at(t.NEEDLE_HEADER_SIZE, nv.offset)
+                existing, _ = read_needle_header(header)
+                if n.cookie == 0 and not check_cookie:
+                    n.cookie = existing.cookie
+                if existing.cookie != n.cookie:
+                    raise CookieMismatchError(
+                        f"mismatching cookie {n.cookie:x}")
+            n.append_at_ns = time.time_ns()
+            blob = n.to_bytes(self.version)
+            offset = self.data.append(blob)
+            self.last_append_at_ns = n.append_at_ns
+            if nv is None or nv.offset < offset:
+                self.nm.put(n.id, offset, n.size)
+            if n.last_modified > self.last_modified_ts:
+                self.last_modified_ts = n.last_modified
+            return offset, n.size, False
+
+    def delete_needle(self, n: Needle) -> int:
+        """Tombstone-append; returns the freed size (0 if absent)."""
+        with self.lock:
+            if self.read_only:
+                raise VolumeError(f"volume {self.id} is read only")
+            nv = self.nm.get(n.id)
+            if nv is None or not t.size_is_valid(nv.size):
+                return 0
+            size = nv.size
+            n.data = b""
+            n.append_at_ns = time.time_ns()
+            blob = n.to_bytes(self.version)
+            offset = self.data.append(blob)
+            self.last_append_at_ns = n.append_at_ns
+            self.nm.delete(n.id, offset)
+            return size
+
+    # -- read ----------------------------------------------------------------
+    def read_needle(self, nid: int, cookie: Optional[int] = None) -> Needle:
+        with self.lock:
+            nv = self.nm.get(nid)
+            if nv is None or nv.offset == 0:
+                raise NotFoundError(f"needle {nid:x} not found")
+            if t.size_is_deleted(nv.size):
+                raise DeletedError(f"needle {nid:x} already deleted")
+            blob = self.data.read_at(
+                get_actual_size(nv.size, self.version), nv.offset)
+            n = Needle()
+            n.read_bytes(blob, nv.offset, nv.size, self.version)
+            if cookie is not None and n.cookie != cookie:
+                raise CookieMismatchError(
+                    f"cookie mismatch for needle {nid:x}")
+            if n.has_ttl and self.ttl and n.last_modified:
+                expiry = n.last_modified + self.ttl.minutes() * 60
+                if time.time() >= expiry:
+                    raise NotFoundError(f"needle {nid:x} expired")
+            return n
+
+    def read_needle_blob(self, offset: int, size: int) -> bytes:
+        return self.data.read_at(get_actual_size(size, self.version), offset)
+
+    # -- scan (export/fsck support; volume_read.go:213-232) ------------------
+    def scan(self):
+        """Yield (needle, offset) for every record in the .dat, in file order."""
+        pos = self.super_block.block_size
+        end = self.data.size()
+        while pos < end:
+            header = self.data.read_at(t.NEEDLE_HEADER_SIZE, pos)
+            if len(header) < t.NEEDLE_HEADER_SIZE:
+                break
+            n, _ = read_needle_header(header)
+            body_len = (get_actual_size(n.size, self.version)
+                        - t.NEEDLE_HEADER_SIZE)
+            body = self.data.read_at(body_len, pos + t.NEEDLE_HEADER_SIZE)
+            n.read_needle_body(body, self.version)
+            yield n, pos
+            pos += t.NEEDLE_HEADER_SIZE + body_len
+
+    # -- stats ---------------------------------------------------------------
+    def content_size(self) -> int:
+        return self.nm.content_size()
+
+    def deleted_size(self) -> int:
+        return self.nm.deleted_size()
+
+    def file_count(self) -> int:
+        return self.nm.file_count
+
+    def deleted_count(self) -> int:
+        return self.nm.deleted_count
+
+    def max_file_key(self) -> int:
+        return self.nm.max_file_key()
+
+    def garbage_level(self) -> float:
+        if self.content_size() == 0:
+            return 0.0
+        return self.deleted_size() / self.content_size()
+
+    def file_stat(self) -> tuple[int, int]:
+        """(dat size, idx size)"""
+        idx_path = self.file_name(".idx")
+        return (self.data.size(),
+                os.path.getsize(idx_path) if os.path.exists(idx_path) else 0)
+
+    def index_file_size(self) -> int:
+        return self.file_stat()[1]
+
+    # -- vacuum --------------------------------------------------------------
+    def compact(self):
+        """Copy live needles (by index) into .cpd/.cpx with a bumped
+        compaction revision (Compact2, volume_vacuum.go:67-100)."""
+        with self.lock:
+            self.is_compacting = True
+            # flush buffered idx appends before snapshotting the watermark,
+            # or makeupDiff would replay the whole index
+            self.nm.flush()
+            self.data.sync()
+            self.last_compact_index_offset = self.index_file_size()
+            self.last_compact_revision = self.super_block.compaction_revision
+            # snapshot the live map: writes may race the copy (makeupDiff
+            # replays them at commit) and would otherwise mutate the dict
+            # mid-iteration
+            snapshot = [(nid, nv.offset, nv.size)
+                        for nid, nv in self.nm.items_ascending()]
+        try:
+            self._copy_data_based_on_index(snapshot)
+        finally:
+            self.is_compacting = False
+
+    def _copy_data_based_on_index(self, snapshot):
+        new_sb = SuperBlock(
+            version=self.super_block.version,
+            replica_placement=self.super_block.replica_placement,
+            ttl=self.super_block.ttl,
+            compaction_revision=self.super_block.compaction_revision + 1,
+            extra=self.super_block.extra,
+        )
+        now = time.time()
+        with DiskFile(self.file_name(".cpd"), create=True) as dst, \
+                open(self.file_name(".cpx"), "wb") as new_idx:
+            dst.truncate(0)
+            dst.write_at(new_sb.to_bytes(), 0)
+            new_offset = new_sb.block_size
+            for nid, offset, size in snapshot:
+                if offset == 0 or t.size_is_deleted(size):
+                    continue
+                blob = self.read_needle_blob(offset, size)
+                n = Needle()
+                n.read_bytes(blob, offset, size, self.version)
+                if (n.has_ttl and self.ttl and n.last_modified
+                        and now >= n.last_modified + self.ttl.minutes() * 60):
+                    continue
+                dst.write_at(blob, new_offset)
+                new_idx.write(idx_mod.pack_entry(nid, new_offset, n.size))
+                new_offset += len(blob)
+
+    def commit_compact(self):
+        """Swap in .cpd/.cpx, replaying any writes that raced the copy
+        (CommitCompact + makeupDiff, volume_vacuum.go:102-190)."""
+        with self.lock:
+            self.nm.flush()
+            try:
+                self._makeup_diff()
+            except VolumeError:
+                os.remove(self.file_name(".cpd"))
+                os.remove(self.file_name(".cpx"))
+                raise
+            self.nm.close()
+            self.data.close()
+            os.replace(self.file_name(".cpd"), self.file_name(".dat"))
+            os.replace(self.file_name(".cpx"), self.file_name(".idx"))
+            self._load(create_if_missing=False)
+
+    def _makeup_diff(self):
+        idx_path = self.file_name(".idx")
+        index_size = os.path.getsize(idx_path)
+        if index_size <= self.last_compact_index_offset:
+            return
+        # newest-first unique entries appended after the compaction snapshot
+        updated: dict[int, tuple[int, int]] = {}
+        with open(idx_path, "rb") as f:
+            off = index_size - t.NEEDLE_MAP_ENTRY_SIZE
+            while off >= self.last_compact_index_offset:
+                f.seek(off)
+                nid, a_off, size = idx_mod.unpack_entry(
+                    f.read(t.NEEDLE_MAP_ENTRY_SIZE))
+                updated.setdefault(nid, (a_off, size))
+                off -= t.NEEDLE_MAP_ENTRY_SIZE
+        if not updated:
+            return
+        with open(self.file_name(".cpd"), "rb") as f:
+            new_sb = SuperBlock.from_file(f)
+        if new_sb.compaction_revision != self.last_compact_revision + 1:
+            raise VolumeError(
+                f"compact revision {new_sb.compaction_revision} != "
+                f"{self.last_compact_revision + 1}")
+        with DiskFile(self.file_name(".cpd")) as dst, \
+                open(self.file_name(".cpx"), "ab") as new_idx:
+            for nid, (a_off, size) in updated.items():
+                offset = dst.size()
+                if offset % t.NEEDLE_PADDING_SIZE != 0:
+                    offset += (t.NEEDLE_PADDING_SIZE
+                               - offset % t.NEEDLE_PADDING_SIZE)
+                if a_off != 0 and t.size_is_valid(size):
+                    blob = self.read_needle_blob(a_off, size)
+                    dst.write_at(blob, offset)
+                    new_idx.write(idx_mod.pack_entry(nid, offset, size))
+                else:
+                    tomb = Needle(id=nid, cookie=0x12345678,
+                                  append_at_ns=time.time_ns())
+                    dst.write_at(tomb.to_bytes(self.version), offset)
+                    new_idx.write(idx_mod.pack_entry(
+                        nid, 0, t.TOMBSTONE_FILE_SIZE))
+
+    # -- lifecycle -----------------------------------------------------------
+    def sync(self):
+        with self.lock:
+            self.nm.flush()
+            self.data.sync()
+
+    def close(self):
+        with self.lock:
+            if self.nm is not None:
+                self.nm.close()
+            if self.data is not None:
+                self.data.close()
+
+    def destroy(self):
+        with self.lock:
+            self.close()
+            for ext in (".dat", ".idx", ".vif", ".cpd", ".cpx", ".note"):
+                try:
+                    os.remove(self.file_name(ext))
+                except FileNotFoundError:
+                    pass

@@ -1,0 +1,344 @@
+"""EC lifecycle: encode, locate, read, reconstruct, delete, decode.
+
+Mirrors the reference's ec_test.go round-trip methodology: encode a real
+volume with small block sizes, then assert every needle's bytes read from
+the shard set equal the bytes in the original .dat — including when read
+through reconstruction from random 10-shard subsets."""
+
+import os
+import random
+import shutil
+
+import numpy as np
+import pytest
+
+from conftest import reference_fixture
+from seaweedfs_tpu.storage import idx as idx_mod
+from seaweedfs_tpu.storage import types as t
+from seaweedfs_tpu.storage.erasure_coding import (DATA_SHARDS_COUNT,
+                                                  TOTAL_SHARDS_COUNT, to_ext)
+from seaweedfs_tpu.storage.erasure_coding import decoder as dec
+from seaweedfs_tpu.storage.erasure_coding import encoder as enc
+from seaweedfs_tpu.storage.erasure_coding.ec_volume import (EcDeletedError,
+                                                            EcNotFoundError,
+                                                            EcVolume,
+                                                            EcVolumeShard,
+                                                            ShardBits,
+                                                            rebuild_ecx_file)
+from seaweedfs_tpu.storage.erasure_coding.locate import Interval, locate_data
+from seaweedfs_tpu.storage.needle import get_actual_size
+from seaweedfs_tpu.storage.needle_map import load_needle_map_from_idx
+from seaweedfs_tpu.storage.volume import Volume
+from seaweedfs_tpu.storage.needle import Needle
+
+LARGE, SMALL = 10000, 100  # ec_test.go:16-19 uses the same scaled-down sizes
+
+
+def make_volume(tmp_path, vid=1, count=50, data_size=300):
+    v = Volume(str(tmp_path), "", vid)
+    rng = np.random.default_rng(vid)
+    for i in range(1, count + 1):
+        n = Needle.create(rng.integers(0, 256, data_size).astype(
+            np.uint8).tobytes(), name=f"f{i}".encode())
+        n.id, n.cookie = i, 0x1000 + i
+        v.write_needle(n)
+    v.sync()
+    return v
+
+
+@pytest.fixture
+def encoded(tmp_path):
+    """A volume encoded to shards with scaled-down block sizes."""
+    v = make_volume(tmp_path, vid=1)
+    base = v.file_name()
+    v.close()
+    enc.write_ec_files(base, large_block_size=LARGE, small_block_size=SMALL)
+    enc.write_sorted_file_from_idx(base)
+    return base, str(tmp_path)
+
+
+class TestLocate:
+    def test_single_byte_after_large_rows(self):
+        # pinned from TestLocateData (ec_test.go:188-196)
+        intervals = locate_data(LARGE, SMALL, DATA_SHARDS_COUNT * LARGE + 1,
+                                DATA_SHARDS_COUNT * LARGE, 1)
+        assert len(intervals) == 1
+        iv = intervals[0]
+        assert (iv.block_index, iv.inner_block_offset, iv.size,
+                iv.is_large_block, iv.large_block_rows_count) == (0, 0, 1,
+                                                                  False, 1)
+
+    def test_span_crossing_large_to_small(self):
+        dat_size = DATA_SHARDS_COUNT * LARGE + 1
+        offset = DATA_SHARDS_COUNT * LARGE // 2 + 100
+        size = dat_size - offset
+        intervals = locate_data(LARGE, SMALL, dat_size, offset, size)
+        assert sum(iv.size for iv in intervals) == size
+        # spans both tiers
+        assert any(iv.is_large_block for iv in intervals)
+        assert any(not iv.is_large_block for iv in intervals)
+
+    def test_interval_to_shard_id(self):
+        iv = Interval(block_index=13, inner_block_offset=7, size=1,
+                      is_large_block=True, large_block_rows_count=2)
+        sid, off = iv.to_shard_id_and_offset(LARGE, SMALL)
+        assert sid == 3 and off == LARGE + 7
+        iv2 = Interval(block_index=25, inner_block_offset=3, size=1,
+                       is_large_block=False, large_block_rows_count=2)
+        sid2, off2 = iv2.to_shard_id_and_offset(LARGE, SMALL)
+        assert sid2 == 5 and off2 == 2 * LARGE + 2 * SMALL + 3
+
+    def test_offsets_reassemble_dat(self):
+        """Striping is a bijection: every .dat byte maps to exactly one
+        (shard, offset)."""
+        dat_size = DATA_SHARDS_COUNT * LARGE * 1 + 777
+        seen = set()
+        pos = 0
+        while pos < dat_size:
+            span = min(997, dat_size - pos)
+            for iv in locate_data(LARGE, SMALL, dat_size, pos, span):
+                sid, off = iv.to_shard_id_and_offset(LARGE, SMALL)
+                for k in range(iv.size):
+                    key = (sid, off + k)
+                    assert key not in seen
+                    seen.add(key)
+            pos += span
+        assert len(seen) == dat_size
+
+
+class TestEncode:
+    def test_shard_files_created_with_equal_size(self, encoded):
+        base, _ = encoded
+        sizes = {os.path.getsize(base + to_ext(i))
+                 for i in range(TOTAL_SHARDS_COUNT)}
+        assert len(sizes) == 1
+        dat_size = os.path.getsize(base + ".dat")
+        n_small_rows = -(-dat_size // (SMALL * DATA_SHARDS_COUNT))
+        assert sizes.pop() == n_small_rows * SMALL
+
+    def test_data_shards_are_systematic_copy(self, encoded):
+        """Interleaved concat of .ec00-.ec09 must reproduce the .dat."""
+        base, _ = encoded
+        dat = open(base + ".dat", "rb").read()
+        reassembled = bytearray()
+        shard_files = [open(base + to_ext(i), "rb").read()
+                       for i in range(DATA_SHARDS_COUNT)]
+        pos = 0
+        while len(reassembled) < len(dat):
+            for s in shard_files:
+                reassembled += s[pos:pos + SMALL]
+            pos += SMALL
+        assert bytes(reassembled[:len(dat)]) == dat
+
+    def test_every_needle_readable_from_shards(self, encoded):
+        base, d = encoded
+        ev = EcVolume(d, "", 1, large_block_size=LARGE,
+                      small_block_size=SMALL)
+        for i in range(TOTAL_SHARDS_COUNT):
+            ev.add_shard(EcVolumeShard(d, "", 1, i))
+        nm = load_needle_map_from_idx(base + ".idx")
+        dat = open(base + ".dat", "rb").read()
+        checked = 0
+        for nid, nv in nm.items_ascending():
+            if nv.size < 0:
+                continue
+            n = ev.read_needle(nid)
+            assert n.id == nid
+            # byte-identical to the original .dat record
+            blob = dat[nv.offset:nv.offset + get_actual_size(nv.size, 3)]
+            parts = [ev._read_interval(iv)
+                     for iv in ev.locate_needle(nid)[2]]
+            assert b"".join(parts)[:len(blob)] == blob
+            checked += 1
+        assert checked > 0
+        ev.close()
+
+    def test_read_with_four_shards_missing(self, encoded):
+        """ec_test.go readFromOtherEcFiles analogue: reads must succeed via
+        reconstruction with any 4 shards gone."""
+        base, d = encoded
+        rng = random.Random(7)
+        missing = set(rng.sample(range(TOTAL_SHARDS_COUNT), 4))
+        ev = EcVolume(d, "", 1, large_block_size=LARGE,
+                      small_block_size=SMALL)
+        for i in range(TOTAL_SHARDS_COUNT):
+            if i not in missing:
+                ev.add_shard(EcVolumeShard(d, "", 1, i))
+        nm = load_needle_map_from_idx(base + ".idx")
+        for nid, nv in list(nm.items_ascending())[:10]:
+            if nv.size < 0:
+                continue
+            n = ev.read_needle(nid)
+            assert n.id == nid  # CRC verified inside read
+        ev.close()
+
+    def test_too_many_missing_fails(self, encoded):
+        base, d = encoded
+        ev = EcVolume(d, "", 1, large_block_size=LARGE,
+                      small_block_size=SMALL)
+        for i in range(DATA_SHARDS_COUNT - 1):  # only 9 shards
+            ev.add_shard(EcVolumeShard(d, "", 1, i))
+        # spans on the present shards still read fine...
+        assert len(ev.read_shard_span(0, 0, 50)) == 50
+        # ...but a missing shard cannot be recovered from only 9 survivors
+        with pytest.raises(Exception, match="shards"):
+            ev.read_shard_span(9, 0, 50)
+        ev.close()
+
+
+class TestRebuild:
+    def test_rebuild_missing_shards(self, encoded):
+        base, d = encoded
+        golden = {i: open(base + to_ext(i), "rb").read()
+                  for i in range(TOTAL_SHARDS_COUNT)}
+        for i in (2, 7, 11, 13):
+            os.remove(base + to_ext(i))
+        generated = enc.rebuild_ec_files(base)
+        assert sorted(generated) == [2, 7, 11, 13]
+        for i in range(TOTAL_SHARDS_COUNT):
+            assert open(base + to_ext(i), "rb").read() == golden[i], i
+
+    def test_rebuild_noop_when_complete(self, encoded):
+        base, _ = encoded
+        assert enc.rebuild_ec_files(base) == []
+
+
+class TestEcxEcj:
+    def test_ecx_sorted_and_live_only(self, encoded):
+        base, _ = encoded
+        prev = -1
+        count = 0
+        with open(base + ".ecx", "rb") as f:
+            while True:
+                e = f.read(16)
+                if not e:
+                    break
+                nid, off, size = idx_mod.unpack_entry(e)
+                assert nid > prev
+                assert t.size_is_valid(size)
+                prev = nid
+                count += 1
+        assert count == 50
+
+    def test_delete_marks_ecx_and_journals(self, encoded):
+        base, d = encoded
+        ev = EcVolume(d, "", 1, large_block_size=LARGE,
+                      small_block_size=SMALL)
+        for i in range(TOTAL_SHARDS_COUNT):
+            ev.add_shard(EcVolumeShard(d, "", 1, i))
+        ev.read_needle(5)
+        ev.delete_needle(5)
+        with pytest.raises(EcDeletedError):
+            ev.read_needle(5)
+        assert os.path.getsize(base + ".ecj") == 8
+        # absent id deletion is a no-op
+        ev.delete_needle(99999)
+        assert os.path.getsize(base + ".ecj") == 8
+        ev.close()
+
+    def test_rebuild_ecx_replays_journal(self, encoded):
+        base, d = encoded
+        ev = EcVolume(d, "", 1, large_block_size=LARGE,
+                      small_block_size=SMALL)
+        ev.delete_needle(3)
+        ev.close()
+        # wipe the in-place tombstone, keeping only the journal
+        enc.write_sorted_file_from_idx(base)
+        rebuild_ecx_file(base)
+        assert not os.path.exists(base + ".ecj")
+        ev2 = EcVolume(d, "", 1, large_block_size=LARGE,
+                       small_block_size=SMALL)
+        with pytest.raises(EcDeletedError):
+            ev2.locate_needle(3)
+        ev2.close()
+
+    def test_missing_needle(self, encoded):
+        base, d = encoded
+        ev = EcVolume(d, "", 1, large_block_size=LARGE,
+                      small_block_size=SMALL)
+        with pytest.raises(EcNotFoundError):
+            ev.read_needle(777777)
+        ev.close()
+
+
+class TestDecode:
+    def test_decode_back_to_volume(self, encoded):
+        """ec.decode path: shards -> .dat/.idx -> regular volume reads."""
+        base, d = encoded
+        golden_dat = open(base + ".dat", "rb").read()
+        os.remove(base + ".dat")
+        os.remove(base + ".idx")
+        dat_size = dec.find_dat_file_size(base, base)
+        dec.write_dat_file(base, dat_size, large_block_size=LARGE,
+                           small_block_size=SMALL)
+        dec.write_idx_file_from_ec_index(base)
+        assert open(base + ".dat", "rb").read() == golden_dat[:dat_size]
+        v = Volume(d, "", 1)
+        assert v.file_count() == 50
+        for i in (1, 25, 50):
+            assert v.read_needle(i).id == i
+        v.close()
+
+    def test_decode_with_journal_deletions(self, encoded):
+        base, d = encoded
+        ev = EcVolume(d, "", 1, large_block_size=LARGE,
+                      small_block_size=SMALL)
+        ev.delete_needle(10)
+        ev.close()
+        os.remove(base + ".dat")
+        os.remove(base + ".idx")
+        dat_size = dec.find_dat_file_size(base, base)
+        dec.write_dat_file(base, dat_size, large_block_size=LARGE,
+                           small_block_size=SMALL)
+        dec.write_idx_file_from_ec_index(base)
+        v = Volume(d, "", 1)
+        from seaweedfs_tpu.storage.volume import DeletedError, NotFoundError
+        # the tombstoned ecx entry replays as a deletion (doLoading treats
+        # TombstoneFileSize as delete), so the key is absent after decode
+        with pytest.raises((DeletedError, NotFoundError)):
+            v.read_needle(10)
+        assert v.read_needle(11).id == 11
+        v.close()
+
+
+class TestShardBits:
+    def test_ops(self):
+        b = ShardBits().add(0).add(13).add(5)
+        assert b.shard_ids() == [0, 5, 13]
+        assert b.count() == 3
+        assert b.has(5) and not b.has(6)
+        assert b.remove(5).shard_ids() == [0, 13]
+        assert b.add(0).count() == 3  # idempotent
+        assert b.minus(ShardBits().add(0)).shard_ids() == [5, 13]
+        assert b.plus(ShardBits().add(1)).shard_ids() == [0, 1, 5, 13]
+
+
+@pytest.mark.skipif(reference_fixture("weed/storage/erasure_coding/1.dat")
+                    is None, reason="reference fixture not mounted")
+class TestReferenceFixtureRoundTrip:
+    def test_reference_volume_ec_roundtrip(self, tmp_path):
+        """The reference's own test data through our full EC path."""
+        shutil.copy(reference_fixture("weed/storage/erasure_coding/1.dat"),
+                    tmp_path / "1.dat")
+        shutil.copy(reference_fixture("weed/storage/erasure_coding/1.idx"),
+                    tmp_path / "1.idx")
+        base = str(tmp_path / "1")
+        enc.write_ec_files(base, large_block_size=LARGE,
+                           small_block_size=SMALL)
+        enc.write_sorted_file_from_idx(base)
+        ev = EcVolume(str(tmp_path), "", 1, large_block_size=LARGE,
+                      small_block_size=SMALL)
+        missing = {1, 4, 12}
+        for i in range(TOTAL_SHARDS_COUNT):
+            if i not in missing:
+                ev.add_shard(EcVolumeShard(str(tmp_path), "", 1, i))
+        nm = load_needle_map_from_idx(base + ".idx")
+        read = 0
+        for nid, nv in nm.items_ascending():
+            if nv.size < 0:
+                continue
+            n = ev.read_needle(nid)  # CRC-verifies real data
+            assert n.id == nid
+            read += 1
+        assert read > 0
+        ev.close()
