@@ -1,0 +1,199 @@
+"""Master server: assign/lookup HTTP API + heartbeat ingest + vacuum drive.
+
+Parity with weed/server/master_server.go + master_server_handlers*.go:
+  /dir/assign, /dir/lookup, /dir/status, /vol/grow, /vol/vacuum,
+  /cluster/status, plus the heartbeat endpoint volume servers post to
+  (the reference's bidirectional gRPC stream becomes periodic POSTs) and
+  the EC shard lookup (LookupEcVolume).
+Single-master; the reference's Raft FSM replicates only MaxVolumeId
+(raft_server.go:78) so a single-node deployment is semantically complete.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Optional
+
+from ..rpc.http_rpc import RpcError, RpcServer, call
+from ..storage import types as t
+from ..storage.super_block import ReplicaPlacement
+from ..storage.ttl import TTL
+from . import volume_growth
+from .topology import Topology
+from .volume_growth import VolumeGrowOption
+
+
+class MasterServer:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 volume_size_limit_mb: int = 1024,
+                 default_replication: str = "000",
+                 pulse_seconds: float = 5.0,
+                 garbage_threshold: float = 0.3):
+        self.topo = Topology(
+            volume_size_limit=volume_size_limit_mb * 1024 * 1024,
+            pulse_seconds=pulse_seconds)
+        self.default_replication = default_replication
+        self.garbage_threshold = garbage_threshold
+        self.server = RpcServer(host, port)
+        self._register_routes()
+        self._reaper: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._grow_lock = threading.Lock()
+
+    @property
+    def address(self) -> str:
+        return self.server.address
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self):
+        self.server.start()
+        self._reaper = threading.Thread(target=self._reap_loop, daemon=True)
+        self._reaper.start()
+
+    def stop(self):
+        self._stop.set()
+        self.server.stop()
+
+    def _reap_loop(self):
+        while not self._stop.wait(self.topo.pulse_seconds):
+            self.topo.reap_dead_nodes()
+
+    # -- routes --------------------------------------------------------------
+    def _register_routes(self):
+        s = self.server
+        s.add("POST", "/api/heartbeat", self._handle_heartbeat)
+        s.add("GET", "/dir/assign", self._handle_assign)
+        s.add("POST", "/dir/assign", self._handle_assign)
+        s.add("GET", "/dir/lookup", self._handle_lookup)
+        s.add("GET", "/dir/status", lambda r: self.topo.to_dict())
+        s.add("GET", "/cluster/status", self._handle_cluster_status)
+        s.add("POST", "/vol/grow", self._handle_grow)
+        s.add("POST", "/vol/vacuum", self._handle_vacuum)
+        s.add("GET", "/vol/status", lambda r: self.topo.to_dict())
+        s.add("GET", "/ec/lookup", self._handle_ec_lookup)
+
+    # -- heartbeat (master_grpc_server.go:60-170) ----------------------------
+    def _handle_heartbeat(self, req):
+        hb = req.json()
+        self.topo.process_heartbeat(hb)
+        return {
+            "volume_size_limit": self.topo.volume_size_limit,
+            "leader": True,
+        }
+
+    # -- assign (master_server_handlers.go:102-165) --------------------------
+    def _handle_assign(self, req):
+        count = int(req.param("count", "1"))
+        collection = req.param("collection", "") or ""
+        replication = req.param("replication") or self.default_replication
+        ttl_s = req.param("ttl", "") or ""
+        rp = ReplicaPlacement.parse(replication)
+        ttl = TTL.parse(ttl_s)
+
+        rp_byte, ttl_u32 = rp.to_byte(), ttl.to_uint32()
+        if self.topo.writable_count(collection, rp_byte, ttl_u32) == 0:
+            self._grow(collection, rp, ttl, only_if_needed=True)
+        picked = self.topo.pick_for_write(collection, rp_byte, ttl_u32)
+        if picked is None:
+            raise RpcError("no writable volumes", 404)
+        vid, locations = picked
+        key, _ = self.topo.assign_file_id(count)
+        cookie = random.getrandbits(32)
+        fid = t.format_file_id(vid, key, cookie)
+        return {
+            "fid": fid,
+            "url": locations[0]["url"],
+            "publicUrl": locations[0]["publicUrl"],
+            "count": count,
+        }
+
+    def _grow(self, collection: str, rp: ReplicaPlacement, ttl: TTL,
+              target_count: Optional[int] = None,
+              only_if_needed: bool = False):
+        with self._grow_lock:
+            if only_if_needed and self.topo.writable_count(
+                    collection, rp.to_byte(), ttl.to_uint32()) > 0:
+                return 0  # another request already grew the layout
+            option = VolumeGrowOption(collection=collection,
+                                      replica_placement=rp, ttl=ttl)
+            count = target_count or volume_growth.find_volume_count(
+                rp.copy_count())
+            grown = 0
+            for _ in range(count):
+                try:
+                    vid, servers = volume_growth.grow_one_volume(
+                        self.topo, option,
+                        lambda server, vid: call(
+                            server.url, "/admin/assign_volume",
+                            {"volume": vid, "collection": collection,
+                             "replication": str(rp), "ttl": str(ttl)}))
+                    grown += 1
+                except (ValueError, RpcError):
+                    break
+            return grown
+
+    def _handle_grow(self, req):
+        collection = req.param("collection", "") or ""
+        replication = req.param("replication") or self.default_replication
+        count = req.param("count")
+        rp = ReplicaPlacement.parse(replication)
+        ttl = TTL.parse(req.param("ttl", "") or "")
+        grown = self._grow(collection, rp, ttl,
+                           target_count=int(count) if count else None)
+        if grown == 0:
+            raise RpcError("cannot grow any volume", 500)
+        return {"count": grown}
+
+    # -- lookup (master_server_handlers.go:34-80) ----------------------------
+    def _handle_lookup(self, req):
+        vid_s = req.param("volumeId")
+        if vid_s is None:
+            file_id = req.param("fileId")
+            if not file_id:
+                raise RpcError("volumeId or fileId required", 400)
+            vid_s = file_id.split(",")[0]
+        vid = int(vid_s.split(",")[0])
+        collection = req.param("collection", "") or ""
+        locations = self.topo.lookup(vid, collection)
+        if not locations:
+            raise RpcError(f"volume id {vid} not found", 404)
+        return {"volumeId": str(vid), "locations": locations}
+
+    def _handle_ec_lookup(self, req):
+        vid = int(req.param("volumeId", "0"))
+        result = self.topo.lookup_ec_shards(vid)
+        if result is None:
+            raise RpcError(f"ec volume {vid} not found", 404)
+        return result
+
+    def _handle_cluster_status(self, req):
+        return {
+            "IsLeader": True,
+            "Leader": self.address,
+            "MaxVolumeId": self.topo.max_volume_id,
+        }
+
+    # -- vacuum orchestration (topology_vacuum.go) ---------------------------
+    def _handle_vacuum(self, req):
+        threshold = float(req.param("garbageThreshold",
+                                    str(self.garbage_threshold)))
+        vacuumed = []
+        with self.topo.lock:
+            nodes = list(self.topo.nodes.values())
+        for node in nodes:
+            for vid, info in list(node.volumes.items()):
+                try:
+                    check = call(node.url, f"/admin/vacuum/check",
+                                 {"volume": vid})
+                    if check.get("garbage_ratio", 0) <= threshold:
+                        continue
+                    call(node.url, "/admin/vacuum/compact", {"volume": vid},
+                         timeout=600)
+                    call(node.url, "/admin/vacuum/commit", {"volume": vid},
+                         timeout=600)
+                    vacuumed.append({"node": node.url, "volume": vid})
+                except RpcError:
+                    continue
+        return {"vacuumed": vacuumed}

@@ -1,0 +1,223 @@
+"""HTTP RPC substrate: the daemon-to-daemon communication backbone.
+
+The reference runs gRPC over HTTP/2 with streaming for heartbeats, shard
+reads and copies (weed/rpc/grpc_client_server.go:23-50).  This image has no
+grpcio, and daemon traffic here is I/O-bound rather than latency-bound
+(SURVEY.md §5.8), so the equivalent substrate is stdlib HTTP/1.1:
+JSON-bodied control calls + raw-byte responses for data streams, served by
+a threading server.  TPU-side collectives stay inside JAX (parallel/mesh.py)
+— this layer never carries tensor traffic.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import urllib.error
+import urllib.parse
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+
+
+class RpcError(Exception):
+    def __init__(self, message: str, status: int = 500):
+        super().__init__(message)
+        self.status = status
+
+
+class Request:
+    def __init__(self, handler: BaseHTTPRequestHandler, path: str,
+                 query: dict, body: bytes):
+        self.handler = handler
+        self.path = path
+        self.query = query  # dict[str, str] (first value wins)
+        self.body = body
+        self.headers = handler.headers
+
+    def json(self) -> dict:
+        if not self.body:
+            return {}
+        return json.loads(self.body)
+
+    def param(self, name: str, default: Optional[str] = None) -> Optional[str]:
+        return self.query.get(name, default)
+
+
+class Response:
+    """Return from a route: json dict, bytes, or a (status, headers, body)."""
+
+    def __init__(self, body=b"", status: int = 200,
+                 content_type: str = "application/octet-stream",
+                 headers: Optional[dict] = None):
+        self.body = body
+        self.status = status
+        self.content_type = content_type
+        self.headers = headers or {}
+
+
+Route = Callable[[Request], object]
+
+
+class RpcServer:
+    """Route-table HTTP server.  Routes are matched by (method, prefix);
+    the longest prefix wins.  A default route handles everything else
+    (object GET/POST by fid on volume servers)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.routes: dict[tuple[str, str], Route] = {}
+        self.default_route: Optional[Callable[[str, Request], object]] = None
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def _dispatch(self, method: str):
+                parsed = urllib.parse.urlsplit(self.path)
+                path = parsed.path
+                query = {k: v[0] for k, v in
+                         urllib.parse.parse_qs(parsed.query).items()}
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length) if length else b""
+                req = Request(self, path, query, body)
+                try:
+                    route = outer._match(method, path)
+                    if route is None:
+                        if outer.default_route is not None:
+                            result = outer.default_route(method, req)
+                        else:
+                            raise RpcError(f"no route {method} {path}", 404)
+                    else:
+                        result = route(req)
+                except RpcError as e:
+                    self._reply(Response(
+                        json.dumps({"error": str(e)}).encode(), e.status,
+                        "application/json"))
+                    return
+                except Exception as e:  # surface internal errors as 500 JSON
+                    self._reply(Response(
+                        json.dumps({"error": f"{type(e).__name__}: {e}"}
+                                   ).encode(), 500, "application/json"))
+                    return
+                self._reply(outer._coerce(result))
+
+            def _reply(self, resp: Response):
+                body = resp.body
+                if isinstance(body, str):
+                    body = body.encode()
+                self.send_response(resp.status)
+                self.send_header("Content-Type", resp.content_type)
+                if "Content-Length" not in resp.headers:
+                    self.send_header("Content-Length", str(len(body)))
+                for k, v in resp.headers.items():
+                    self.send_header(k, v)
+                self.end_headers()
+                if self.command != "HEAD":
+                    self.wfile.write(body)
+
+            def do_GET(self):
+                self._dispatch("GET")
+
+            def do_HEAD(self):
+                self._dispatch("HEAD")
+
+            def do_POST(self):
+                self._dispatch("POST")
+
+            def do_PUT(self):
+                self._dispatch("PUT")
+
+            def do_DELETE(self):
+                self._dispatch("DELETE")
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.httpd.daemon_threads = True
+        self.host = host
+        self.port = self.httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def _match(self, method: str, path: str) -> Optional[Route]:
+        best, best_len = None, -1
+        for (m, prefix), route in self.routes.items():
+            if m == method and path.startswith(prefix) and \
+                    len(prefix) > best_len:
+                best, best_len = route, len(prefix)
+        return best
+
+    @staticmethod
+    def _coerce(result) -> Response:
+        if isinstance(result, Response):
+            return result
+        if isinstance(result, (dict, list)):
+            return Response(json.dumps(result).encode(), 200,
+                            "application/json")
+        if isinstance(result, (bytes, bytearray)):
+            return Response(bytes(result))
+        if result is None:
+            return Response(b"", 204)
+        return Response(str(result).encode(), 200, "text/plain")
+
+    def route(self, method: str, prefix: str):
+        def deco(fn: Route):
+            self.routes[(method, prefix)] = fn
+            return fn
+        return deco
+
+    def add(self, method: str, prefix: str, fn: Route):
+        self.routes[(method, prefix)] = fn
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def start(self):
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+
+# -- client helpers ----------------------------------------------------------
+
+
+def call(addr: str, path: str, payload: Optional[dict] = None,
+         method: Optional[str] = None, timeout: float = 30.0,
+         raw: Optional[bytes] = None, headers: Optional[dict] = None):
+    """JSON RPC call; returns parsed JSON (or raw bytes for non-JSON)."""
+    url = f"http://{addr}{path}"
+    data = None
+    req_headers = dict(headers or {})
+    if raw is not None:
+        data = raw
+    elif payload is not None:
+        data = json.dumps(payload).encode()
+        req_headers["Content-Type"] = "application/json"
+    if method is None:
+        method = "POST" if data is not None else "GET"
+    req = urllib.request.Request(url, data=data, method=method,
+                                 headers=req_headers)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            body = resp.read()
+            ctype = resp.headers.get("Content-Type", "")
+    except urllib.error.HTTPError as e:
+        body = e.read()
+        try:
+            message = json.loads(body).get("error", body.decode())
+        except Exception:
+            message = body.decode(errors="replace")
+        raise RpcError(message, e.code) from None
+    except (urllib.error.URLError, socket.timeout, ConnectionError) as e:
+        raise RpcError(f"cannot reach {addr}: {e}", 503) from None
+    if "application/json" in ctype:
+        return json.loads(body) if body else {}
+    return body

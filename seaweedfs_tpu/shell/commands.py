@@ -1,0 +1,298 @@
+"""Admin shell commands: the cluster orchestration layer.
+
+Parity with weed/shell/command_ec_*.go and command_volume_*.go: ec.encode's
+6-step flow (mark readonly -> generate on source -> spread shards by free
+slots -> mount on targets -> cleanup source -> delete original volume;
+command_ec_encode.go:95-192), ec.decode's collect-to-one-server flow,
+ec.rebuild's roomiest-node rebuild, and ec.balance's spread.  Every command
+supports plan-only mode (no RPCs) the way the reference's tests pass
+applyBalancing=false (shell/command_ec_test.go).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..rpc.http_rpc import RpcError, call
+from ..storage.erasure_coding import TOTAL_SHARDS_COUNT
+
+
+@dataclass
+class CommandEnv:
+    master_address: str
+
+    def master(self, path: str, payload=None, **kw):
+        return call(self.master_address, path, payload, **kw)
+
+
+@dataclass
+class EcNode:
+    url: str
+    free_slots: int
+    shards: dict[int, list[int]] = field(default_factory=dict)  # vid -> ids
+    collections: dict[int, str] = field(default_factory=dict)  # vid -> name
+
+    def shard_count(self) -> int:
+        return sum(len(s) for s in self.shards.values())
+
+
+def collect_ec_nodes(env: CommandEnv) -> list[EcNode]:
+    """Build the EC-capable node list from the master's topology view."""
+    topo = env.master("/dir/status")
+    nodes = []
+    for dc in topo.get("datacenters", []):
+        for rack in dc.get("racks", []):
+            for n in rack.get("nodes", []):
+                nodes.append(EcNode(url=n["url"], free_slots=n["free"]))
+    # fill current shard placements
+    for vid in topo.get("ec_volumes", []):
+        try:
+            lookup = env.master(f"/ec/lookup?volumeId={vid}")
+        except RpcError:
+            continue
+        collection = lookup.get("collection", "")
+        for entry in lookup.get("shard_id_locations", []):
+            for loc in entry["locations"]:
+                for node in nodes:
+                    if node.url == loc["url"]:
+                        node.shards.setdefault(vid, []).append(
+                            entry["shard_id"])
+                        node.collections[vid] = collection
+    return nodes
+
+
+def balanced_ec_distribution(nodes: list[EcNode],
+                             shard_count: int = TOTAL_SHARDS_COUNT
+                             ) -> dict[str, list[int]]:
+    """Round-robin one shard at a time over servers with free EC slots,
+    starting at a random server (balancedEcDistribution,
+    command_ec_encode.go:253-269).  Slot budget = free volume slots in
+    shard units."""
+    import random
+
+    if not nodes:
+        raise ValueError("no ec nodes available")
+    allocation: dict[str, list[int]] = {n.url: [] for n in nodes}
+    free = {n.url: n.free_slots * TOTAL_SHARDS_COUNT for n in nodes}
+    shard_id = 0
+    index = random.randrange(len(nodes))
+    spins = 0
+    while shard_id < shard_count:
+        node = nodes[index]
+        if free[node.url] - len(allocation[node.url]) > 0:
+            allocation[node.url].append(shard_id)
+            shard_id += 1
+            spins = 0
+        else:
+            spins += 1
+            if spins > len(nodes):
+                raise ValueError("not enough free ec slots")
+        index = (index + 1) % len(nodes)
+    return {url: ids for url, ids in allocation.items() if ids}
+
+
+# -- ec.encode ---------------------------------------------------------------
+
+
+def ec_encode(env: CommandEnv, vid: int, collection: str = "",
+              plan_only: bool = False) -> dict:
+    lookup = env.master(f"/dir/lookup?volumeId={vid}")
+    locations = [loc["url"] for loc in lookup["locations"]]
+    if not locations:
+        raise RpcError(f"volume {vid} has no locations", 404)
+    source = locations[0]
+    nodes = collect_ec_nodes(env)
+    allocation = balanced_ec_distribution(nodes)
+    plan = {
+        "volume": vid,
+        "source": source,
+        "replicas": locations,
+        "allocation": allocation,
+    }
+    if plan_only:
+        return plan
+
+    # 1. freeze writes on every replica
+    for url in locations:
+        call(url, "/admin/readonly", {"volume": vid, "readonly": True})
+    # 2. generate the 14 shard files + .ecx on the source (TPU encode)
+    call(source, "/admin/ec/generate", {"volume": vid}, timeout=3600)
+    # 3/4. spread + mount
+    for url, shard_ids in allocation.items():
+        if url != source:
+            call(url, "/admin/ec/copy",
+                 {"volume": vid, "collection": collection,
+                  "shard_ids": shard_ids, "source": source,
+                  "copy_ecx_file": True}, timeout=3600)
+        call(url, "/admin/ec/mount",
+             {"volume": vid, "collection": collection,
+              "shard_ids": shard_ids})
+    # 5. cleanup: remove shard files that left the source
+    source_kept = allocation.get(source, [])
+    to_remove = [s for s in range(TOTAL_SHARDS_COUNT)
+                 if s not in source_kept]
+    if to_remove:
+        call(source, "/admin/ec/delete_shards",
+             {"volume": vid, "collection": collection,
+              "shard_ids": to_remove})
+    # 6. drop the original volume from every replica
+    for url in locations:
+        call(url, "/admin/delete_volume", {"volume": vid})
+    return plan
+
+
+# -- ec.decode ---------------------------------------------------------------
+
+
+def ec_decode(env: CommandEnv, vid: int, collection: str = "",
+              plan_only: bool = False) -> dict:
+    lookup = env.master(f"/ec/lookup?volumeId={vid}")
+    shard_locations = {
+        e["shard_id"]: [loc["url"] for loc in e["locations"]]
+        for e in lookup.get("shard_id_locations", [])
+    }
+    if not shard_locations:
+        raise RpcError(f"ec volume {vid} not found", 404)
+    # collect to the server already holding the most shards
+    counts: dict[str, int] = {}
+    for urls in shard_locations.values():
+        for url in urls:
+            counts[url] = counts.get(url, 0) + 1
+    target = max(counts, key=counts.get)
+    missing = [sid for sid, urls in shard_locations.items()
+               if target not in urls]
+    plan = {"volume": vid, "target": target, "copy_shards": missing}
+    if plan_only:
+        return plan
+
+    for sid in missing:
+        source = shard_locations[sid][0]
+        call(target, "/admin/ec/copy",
+             {"volume": vid, "collection": collection, "shard_ids": [sid],
+              "source": source, "copy_ecx_file": False}, timeout=3600)
+    call(target, "/admin/ec/to_volume",
+         {"volume": vid, "collection": collection}, timeout=3600)
+    # remove shards everywhere
+    for url in set(u for urls in shard_locations.values() for u in urls):
+        all_ids = [sid for sid, urls in shard_locations.items()
+                   if url in urls]
+        ids = all_ids if url != target else list(range(TOTAL_SHARDS_COUNT))
+        if ids:
+            try:
+                call(url, "/admin/ec/delete_shards",
+                     {"volume": vid, "collection": collection,
+                      "shard_ids": ids})
+            except RpcError:
+                pass
+    return plan
+
+
+# -- ec.rebuild --------------------------------------------------------------
+
+
+def ec_rebuild(env: CommandEnv, vid: int, collection: str = "",
+               plan_only: bool = False) -> dict:
+    lookup = env.master(f"/ec/lookup?volumeId={vid}")
+    shard_locations = {
+        e["shard_id"]: [loc["url"] for loc in e["locations"]]
+        for e in lookup.get("shard_id_locations", [])
+    }
+    present = sorted(shard_locations)
+    missing = [s for s in range(TOTAL_SHARDS_COUNT) if s not in present]
+    if not missing:
+        return {"volume": vid, "missing": [], "rebuilder": None}
+    if len(present) < TOTAL_SHARDS_COUNT - 4:
+        raise RpcError(
+            f"ec volume {vid} has only {len(present)} shards, unrepairable",
+            500)
+    nodes = collect_ec_nodes(env)
+    rebuilder = max(nodes, key=lambda n: n.free_slots)
+    plan = {"volume": vid, "missing": missing, "rebuilder": rebuilder.url}
+    if plan_only:
+        return plan
+
+    # gather surviving shards on the rebuilder
+    local = rebuilder.shards.get(vid, [])
+    for sid in present:
+        if sid in local:
+            continue
+        source = shard_locations[sid][0]
+        if source == rebuilder.url:
+            continue
+        call(rebuilder.url, "/admin/ec/copy",
+             {"volume": vid, "collection": collection, "shard_ids": [sid],
+              "source": source, "copy_ecx_file": True}, timeout=3600)
+    call(rebuilder.url, "/admin/ec/rebuild",
+         {"volume": vid, "collection": collection}, timeout=3600)
+    call(rebuilder.url, "/admin/ec/mount",
+         {"volume": vid, "collection": collection, "shard_ids": missing})
+    # drop the temporarily copied survivors from the rebuilder's disk
+    copied = [s for s in present
+              if s not in local and s not in missing]
+    if copied:
+        call(rebuilder.url, "/admin/ec/delete_shards",
+             {"volume": vid, "collection": collection,
+              "shard_ids": copied})
+    return plan
+
+
+# -- ec.balance --------------------------------------------------------------
+
+
+def ec_balance(env: CommandEnv, plan_only: bool = False) -> list[dict]:
+    """Even out shard counts across nodes (command_ec_balance.go):
+    move shards from above-average nodes to the roomiest below-average
+    ones, never co-locating a shard id that the target already holds."""
+    nodes = collect_ec_nodes(env)
+    if not nodes:
+        return []
+    moves = []
+    total = sum(n.shard_count() for n in nodes)
+    average = -(-total // len(nodes))  # ceil
+    overfull = [n for n in nodes if n.shard_count() > average]
+    for node in overfull:
+        while node.shard_count() > average:
+            vid, ids = max(node.shards.items(), key=lambda kv: len(kv[1]))
+            candidates = [n for n in nodes if n is not node
+                          and n.shard_count() < average
+                          and vid not in n.shards]
+            if not candidates:
+                break
+            target = max(candidates, key=lambda n: n.free_slots)
+            sid = ids.pop()
+            if not ids:
+                del node.shards[vid]
+            target.shards.setdefault(vid, []).append(sid)
+            moves.append({"volume": vid, "shard": sid,
+                          "collection": node.collections.get(vid, ""),
+                          "from": node.url, "to": target.url})
+    if plan_only:
+        return moves
+    for move in moves:
+        call(move["to"], "/admin/ec/copy",
+             {"volume": move["volume"], "collection": move["collection"],
+              "shard_ids": [move["shard"]],
+              "source": move["from"], "copy_ecx_file": True}, timeout=3600)
+        call(move["to"], "/admin/ec/mount",
+             {"volume": move["volume"], "collection": move["collection"],
+              "shard_ids": [move["shard"]]})
+        call(move["from"], "/admin/ec/delete_shards",
+             {"volume": move["volume"], "collection": move["collection"],
+              "shard_ids": [move["shard"]]})
+    return moves
+
+
+# -- volume.* ----------------------------------------------------------------
+
+
+def volume_list(env: CommandEnv) -> dict:
+    return env.master("/dir/status")
+
+
+def volume_vacuum(env: CommandEnv,
+                  garbage_threshold: Optional[float] = None) -> dict:
+    path = "/vol/vacuum"
+    if garbage_threshold is not None:
+        path += f"?garbageThreshold={garbage_threshold}"
+    return env.master(path, {})

@@ -1,0 +1,217 @@
+"""Store: the volume-server-wide registry of disk locations and volumes.
+
+Parity with weed/storage/store.go:55-73 + store_ec.go: owns DiskLocations,
+routes reads/writes/deletes to volumes, assembles heartbeat payloads, and
+serves EC reads with the local/remote/reconstruct ladder.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+from . import types as t
+from .disk_location import DiskLocation
+from .erasure_coding import encoder as ec_encoder
+from .erasure_coding.ec_volume import EcVolume
+from .needle import Needle
+from .super_block import ReplicaPlacement
+from .ttl import TTL
+from .volume import NotFoundError, Volume, VolumeError
+
+
+class Store:
+    def __init__(self, directories: list[str],
+                 max_volume_counts: Optional[list[int]] = None,
+                 ip: str = "127.0.0.1", port: int = 0,
+                 public_url: str = "", data_center: str = "",
+                 rack: str = "", ec_encoder_backend=None):
+        counts = max_volume_counts or [8] * len(directories)
+        self.locations = [DiskLocation(d, c)
+                          for d, c in zip(directories, counts)]
+        for loc in self.locations:
+            loc.load_existing_volumes()
+        self.ip = ip
+        self.port = port
+        self.public_url = public_url or f"{ip}:{port}"
+        self.data_center = data_center
+        self.rack = rack
+        # master's soft volume size cap, refreshed from each heartbeat
+        # response.  As in the reference, the volume server does not reject
+        # writes past it (only the 32 GB hard cap applies locally); the
+        # master stops assigning to oversized volumes instead
+        # (volume_layout.go oversized tracking).
+        self.volume_size_limit = 0
+        self.lock = threading.RLock()
+        self.ec_encoder_backend = ec_encoder_backend
+
+    @property
+    def url(self) -> str:
+        return f"{self.ip}:{self.port}"
+
+    # -- lookup ---------------------------------------------------------------
+    def find_volume(self, vid: int) -> Optional[Volume]:
+        for loc in self.locations:
+            v = loc.volumes.get(vid)
+            if v is not None:
+                return v
+        return None
+
+    def find_ec_volume(self, vid: int) -> Optional[EcVolume]:
+        for loc in self.locations:
+            ev = loc.ec_volumes.get(vid)
+            if ev is not None:
+                return ev
+        return None
+
+    def location_of(self, vid: int) -> Optional[DiskLocation]:
+        for loc in self.locations:
+            if vid in loc.volumes or vid in loc.ec_volumes:
+                return loc
+        return None
+
+    def has_volume(self, vid: int) -> bool:
+        return self.find_volume(vid) is not None
+
+    # -- volume admin (store.go AddVolume path) -------------------------------
+    def add_volume(self, vid: int, collection: str = "",
+                   replication: str = "000", ttl: str = "") -> Volume:
+        with self.lock:
+            if self.find_volume(vid) is not None:
+                raise VolumeError(f"volume {vid} already exists")
+            loc = max(self.locations, key=lambda l: l.free_slots())
+            if loc.free_slots() <= 0:
+                raise VolumeError("no free volume slots")
+            return loc.add_volume(
+                vid, collection,
+                replica_placement=ReplicaPlacement.parse(replication),
+                ttl=TTL.parse(ttl))
+
+    def delete_volume(self, vid: int):
+        with self.lock:
+            for loc in self.locations:
+                if vid in loc.volumes:
+                    loc.delete_volume(vid)
+                    return
+            raise NotFoundError(f"volume {vid} not found")
+
+    def mark_volume_readonly(self, vid: int, read_only: bool = True):
+        v = self.find_volume(vid)
+        if v is None:
+            raise NotFoundError(f"volume {vid} not found")
+        v.read_only = read_only
+
+    # -- data path ------------------------------------------------------------
+    def write_needle(self, vid: int, n: Needle,
+                     check_cookie: bool = True) -> tuple[int, bool]:
+        v = self.find_volume(vid)
+        if v is None:
+            raise NotFoundError(f"volume {vid} not found")
+        _, size, unchanged = v.write_needle(n, check_cookie=check_cookie)
+        return size, unchanged
+
+    def read_needle(self, vid: int, nid: int,
+                    cookie: Optional[int] = None) -> Needle:
+        v = self.find_volume(vid)
+        if v is not None:
+            return v.read_needle(nid, cookie=cookie)
+        ev = self.find_ec_volume(vid)
+        if ev is not None:
+            return ev.read_needle(nid, cookie=cookie)
+        raise NotFoundError(f"volume {vid} not found")
+
+    def delete_needle(self, vid: int, n: Needle) -> int:
+        v = self.find_volume(vid)
+        if v is not None:
+            return v.delete_needle(n)
+        ev = self.find_ec_volume(vid)
+        if ev is not None:
+            ev.delete_needle(n.id)
+            return 0
+        raise NotFoundError(f"volume {vid} not found")
+
+    # -- EC admin (volume_grpc_erasure_coding.go handlers) --------------------
+    def ec_generate(self, vid: int):
+        """VolumeEcShardsGenerate: encode a local volume into shard files."""
+        v = self.find_volume(vid)
+        if v is None:
+            raise NotFoundError(f"volume {vid} not found")
+        base = v.file_name()
+        v.sync()
+        ec_encoder.write_ec_files(base, encoder=self.ec_encoder_backend)
+        ec_encoder.write_sorted_file_from_idx(base)
+        ec_encoder.save_volume_info(base, version=v.version)
+
+    def ec_rebuild(self, vid: int, collection: str = "") -> list[int]:
+        """VolumeEcShardsRebuild: regenerate missing local shard files."""
+        loc = self.location_of(vid)
+        base = (loc._base_name(collection, vid) if loc
+                else self.locations[0]._base_name(collection, vid))
+        return ec_encoder.rebuild_ec_files(base,
+                                           encoder=self.ec_encoder_backend)
+
+    def ec_mount(self, collection: str, vid: int, shard_ids: list[int]):
+        loc = self.location_of(vid) or self.locations[0]
+        for sid in shard_ids:
+            loc.mount_ec_shard(collection, vid, sid)
+
+    def ec_unmount(self, vid: int, shard_ids: list[int]):
+        for loc in self.locations:
+            if vid in loc.ec_volumes:
+                for sid in shard_ids:
+                    loc.unmount_ec_shard(vid, sid)
+                return
+
+    # -- heartbeat assembly (store.go CollectHeartbeat) -----------------------
+    def collect_heartbeat(self) -> dict:
+        volumes = []
+        ec_shards = []
+        max_file_key = 0
+        max_volume_count = 0
+        for loc in self.locations:
+            max_volume_count += loc.max_volume_count
+            with loc.lock:
+                for vid, v in loc.volumes.items():
+                    max_file_key = max(max_file_key, v.max_file_key())
+                    dat_size, idx_size = v.file_stat()
+                    volumes.append({
+                        "id": vid,
+                        "collection": v.collection,
+                        "size": dat_size,
+                        "file_count": v.file_count(),
+                        "delete_count": v.deleted_count(),
+                        "deleted_byte_count": v.deleted_size(),
+                        "read_only": v.read_only,
+                        "replica_placement":
+                            v.super_block.replica_placement.to_byte(),
+                        "ttl": v.ttl.to_uint32(),
+                        "compact_revision":
+                            v.super_block.compaction_revision,
+                    })
+                for vid, ev in loc.ec_volumes.items():
+                    ec_shards.append({
+                        "id": vid,
+                        "collection": ev.collection,
+                        "ec_index_bits": ev.shard_bits().bits,
+                    })
+        return {
+            "ip": self.ip,
+            "port": self.port,
+            "public_url": self.public_url,
+            "data_center": self.data_center,
+            "rack": self.rack,
+            "max_volume_count": max_volume_count,
+            "max_file_key": max_file_key,
+            "volumes": volumes,
+            "ec_shards": ec_shards,
+        }
+
+    def status(self) -> dict:
+        hb = self.collect_heartbeat()
+        hb["free_slots"] = sum(l.free_slots() for l in self.locations)
+        hb["volume_size_limit"] = self.volume_size_limit
+        return hb
+
+    def close(self):
+        for loc in self.locations:
+            loc.close()

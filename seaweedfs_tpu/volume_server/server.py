@@ -1,0 +1,417 @@
+"""Volume server daemon: public object HTTP API + admin/EC RPC + heartbeat.
+
+Parity with weed/server/volume_server*.go:
+  * GET/HEAD/POST/DELETE /{fid} with replication fan-out guarded by
+    type=replicate (volume_server_handlers_write.go:18-137,
+    topology/store_replicate.go:24-141)
+  * admin RPCs: allocate/delete/mount/readonly/vacuum/status
+    (volume_grpc_admin.go, volume_grpc_vacuum.go)
+  * the 9 EC handlers: generate/rebuild/copy/delete/mount/unmount/
+    shard-read/blob-delete/to-volume (volume_grpc_erasure_coding.go:38-438)
+  * heartbeat client loop (volume_grpc_client_to_master.go:46-120)
+
+EC reads use the local -> remote -> reconstruct ladder; remote shard spans
+are fetched over HTTP from peers found via the master's EC lookup, cached
+with a freshness window (store_ec.go:227-268).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from ..rpc.http_rpc import Request, Response, RpcError, RpcServer, call
+from ..storage import types as t
+from ..storage.erasure_coding import TOTAL_SHARDS_COUNT, to_ext
+from ..storage.erasure_coding import decoder as ec_decoder
+from ..storage.erasure_coding.ec_volume import (EcDeletedError,
+                                                EcNotFoundError,
+                                                rebuild_ecx_file)
+from ..storage.needle import Needle
+from ..storage.store import Store
+from ..storage.volume import (CookieMismatchError, DeletedError,
+                              NotFoundError, VolumeError)
+
+EC_SHARD_CACHE_TTL = 11.0  # seconds (store_ec.go:241 first tier)
+
+
+class VolumeServer:
+    def __init__(self, directories: list[str], master_address: str,
+                 host: str = "127.0.0.1", port: int = 0,
+                 public_url: str = "", data_center: str = "",
+                 rack: str = "", max_volume_counts: Optional[list[int]] = None,
+                 pulse_seconds: float = 5.0, ec_encoder_backend=None):
+        self.server = RpcServer(host, port)
+        self.master_address = master_address
+        self.pulse_seconds = pulse_seconds
+        self.store = Store(
+            directories, max_volume_counts, ip=host,
+            port=self.server.port, public_url=public_url,
+            data_center=data_center, rack=rack,
+            ec_encoder_backend=ec_encoder_backend)
+        self._stop = threading.Event()
+        self._heartbeat_thread: Optional[threading.Thread] = None
+        self._ec_locations: dict[int, tuple[float, dict[int, list[str]]]] = {}
+        self._register_routes()
+        # EC volumes discovered on disk at startup need the remote-fetch
+        # ladder too, not just ones mounted via RPC
+        for loc in self.store.locations:
+            for vid, ev in loc.ec_volumes.items():
+                ev.remote_reader = self._make_remote_reader(vid)
+
+    @property
+    def address(self) -> str:
+        return self.server.address
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self):
+        self.server.start()
+        self._heartbeat_thread = threading.Thread(
+            target=self._heartbeat_loop, daemon=True)
+        self._heartbeat_thread.start()
+
+    def stop(self):
+        self._stop.set()
+        self.server.stop()
+        self.store.close()
+
+    def heartbeat_once(self):
+        hb = self.store.collect_heartbeat()
+        resp = call(self.master_address, "/api/heartbeat", hb,
+                    timeout=10)
+        self.store.volume_size_limit = resp.get("volume_size_limit", 0)
+        return resp
+
+    def _heartbeat_loop(self):
+        while not self._stop.is_set():
+            try:
+                self.heartbeat_once()
+            except RpcError:
+                pass
+            self._stop.wait(self.pulse_seconds)
+
+    # -- routing -------------------------------------------------------------
+    def _register_routes(self):
+        s = self.server
+        s.add("GET", "/admin/status", lambda r: self.store.status())
+        s.add("POST", "/admin/assign_volume", self._h_assign_volume)
+        s.add("POST", "/admin/delete_volume", self._h_delete_volume)
+        s.add("POST", "/admin/readonly", self._h_readonly)
+        s.add("POST", "/admin/vacuum/check", self._h_vacuum_check)
+        s.add("POST", "/admin/vacuum/compact", self._h_vacuum_compact)
+        s.add("POST", "/admin/vacuum/commit", self._h_vacuum_commit)
+        s.add("POST", "/admin/ec/generate", self._h_ec_generate)
+        s.add("POST", "/admin/ec/rebuild", self._h_ec_rebuild)
+        s.add("POST", "/admin/ec/mount", self._h_ec_mount)
+        s.add("POST", "/admin/ec/unmount", self._h_ec_unmount)
+        s.add("POST", "/admin/ec/copy", self._h_ec_copy)
+        s.add("POST", "/admin/ec/delete_shards", self._h_ec_delete_shards)
+        s.add("POST", "/admin/ec/to_volume", self._h_ec_to_volume)
+        s.add("GET", "/admin/ec/shard_file", self._h_ec_shard_file)
+        s.add("GET", "/admin/ec/shard_read", self._h_ec_shard_read)
+        s.default_route = self._handle_object
+
+    # -- public object API ---------------------------------------------------
+    def _handle_object(self, method: str, req: Request):
+        fid = req.path.lstrip("/").replace("/", ",", 1)
+        if not fid or "," not in fid:
+            raise RpcError(f"invalid fid path {req.path!r}", 400)
+        try:
+            vid, nid, cookie = t.parse_file_id(fid)
+        except ValueError as e:
+            raise RpcError(str(e), 400)
+        if method in ("GET", "HEAD"):
+            return self._read_object(vid, nid, cookie, method)
+        if method in ("POST", "PUT"):
+            return self._write_object(vid, nid, cookie, req)
+        if method == "DELETE":
+            return self._delete_object(vid, nid, cookie, req)
+        raise RpcError(f"unsupported method {method}", 405)
+
+    def _read_object(self, vid: int, nid: int, cookie: int, method: str):
+        try:
+            n = self.store.read_needle(vid, nid, cookie=cookie)
+        except (NotFoundError, EcNotFoundError):
+            raise RpcError("not found", 404)
+        except (DeletedError, EcDeletedError):
+            raise RpcError("already deleted", 404)
+        except (CookieMismatchError,) as e:
+            raise RpcError(str(e), 404)
+        headers = {"Etag": f'"{n.etag()}"'}
+        if n.has_name:
+            headers["X-File-Name"] = n.name.decode(errors="replace")
+        if n.last_modified:
+            headers["X-Last-Modified"] = str(n.last_modified)
+        content_type = (n.mime.decode(errors="replace") if n.has_mime
+                        else "application/octet-stream")
+        if method == "HEAD":
+            # entity size, not body size (the handler sends no body)
+            headers["Content-Length"] = str(len(n.data))
+            return Response(b"", 200, content_type, headers)
+        return Response(n.data, 200, content_type, headers)
+
+    def _write_object(self, vid: int, nid: int, cookie: int, req: Request):
+        is_replicate = req.param("type") == "replicate"
+        n = Needle.create(
+            req.body,
+            name=(req.headers.get("X-File-Name") or "").encode(),
+            mime=(req.headers.get("Content-Type") or "").encode(),
+            last_modified=int(time.time()),
+        )
+        n.id, n.cookie = nid, cookie
+        try:
+            size, unchanged = self.store.write_needle(vid, n)
+        except NotFoundError:
+            raise RpcError(f"volume {vid} not found", 404)
+        except CookieMismatchError as e:
+            raise RpcError(str(e), 403)
+        except VolumeError as e:
+            raise RpcError(str(e), 500)
+        if not is_replicate:
+            self._replicate(vid, f"{vid},{nid:x}{cookie:08x}", "POST",
+                            req.body, dict(req.headers.items()))
+        return {"name": (n.name or b"").decode(errors="replace"),
+                "size": size, "eTag": n.etag()}
+
+    def _delete_object(self, vid: int, nid: int, cookie: int, req: Request):
+        is_replicate = req.param("type") == "replicate"
+        n = Needle(id=nid, cookie=cookie)
+        try:
+            size = self.store.delete_needle(vid, n)
+        except NotFoundError:
+            raise RpcError(f"volume {vid} not found", 404)
+        if not is_replicate:
+            self._replicate(vid, f"{vid},{nid:x}{cookie:08x}", "DELETE",
+                            None, {})
+        return {"size": size}
+
+    def _replicate(self, vid: int, fid: str, method: str,
+                   body: Optional[bytes], headers: dict):
+        """Fan out to the other replicas (store_replicate.go:24-114);
+        any replica failure fails the request, as in the reference."""
+        try:
+            lookup = call(self.master_address, f"/dir/lookup?volumeId={vid}",
+                          timeout=10)
+        except RpcError:
+            return  # master unreachable: single-copy write stands
+        others = [loc["url"] for loc in lookup.get("locations", [])
+                  if loc["url"] != self.store.url]
+        # wire headers arrive with arbitrary capitalisation; match them
+        # case-insensitively or replicas silently lose mime/filename
+        lowered = {k.lower(): v for k, v in headers.items()}
+        headers = {canonical: lowered[canonical.lower()]
+                   for canonical in ("Content-Type", "X-File-Name")
+                   if canonical.lower() in lowered}
+        for url in others:
+            call(url, f"/{fid}?type=replicate", method=method, raw=body,
+                 headers=headers, timeout=30)
+
+    # -- admin ---------------------------------------------------------------
+    def _h_assign_volume(self, req: Request):
+        p = req.json()
+        self.store.add_volume(int(p["volume"]), p.get("collection", ""),
+                              p.get("replication", "000"),
+                              p.get("ttl", ""))
+        self._try_heartbeat()
+        return {}
+
+    def _h_delete_volume(self, req: Request):
+        self.store.delete_volume(int(req.json()["volume"]))
+        self._try_heartbeat()
+        return {}
+
+    def _h_readonly(self, req: Request):
+        p = req.json()
+        self.store.mark_volume_readonly(int(p["volume"]),
+                                        bool(p.get("readonly", True)))
+        return {}
+
+    def _volume_or_404(self, vid: int):
+        v = self.store.find_volume(vid)
+        if v is None:
+            raise RpcError(f"volume {vid} not found", 404)
+        return v
+
+    def _h_vacuum_check(self, req: Request):
+        v = self._volume_or_404(int(req.json()["volume"]))
+        return {"garbage_ratio": v.garbage_level()}
+
+    def _h_vacuum_compact(self, req: Request):
+        self._volume_or_404(int(req.json()["volume"])).compact()
+        return {}
+
+    def _h_vacuum_commit(self, req: Request):
+        self._volume_or_404(int(req.json()["volume"])).commit_compact()
+        return {}
+
+    # -- EC handlers (volume_grpc_erasure_coding.go) -------------------------
+    def _h_ec_generate(self, req: Request):
+        self.store.ec_generate(int(req.json()["volume"]))
+        return {}
+
+    def _h_ec_rebuild(self, req: Request):
+        p = req.json()
+        rebuilt = self.store.ec_rebuild(int(p["volume"]),
+                                        p.get("collection", ""))
+        return {"rebuilt_shard_ids": rebuilt}
+
+    def _h_ec_mount(self, req: Request):
+        p = req.json()
+        vid = int(p["volume"])
+        self.store.ec_mount(p.get("collection", ""), vid,
+                            [int(s) for s in p["shard_ids"]])
+        ev = self.store.find_ec_volume(vid)
+        if ev is not None and ev.remote_reader is None:
+            ev.remote_reader = self._make_remote_reader(vid)
+        self._try_heartbeat()
+        return {}
+
+    def _h_ec_unmount(self, req: Request):
+        p = req.json()
+        self.store.ec_unmount(int(p["volume"]),
+                              [int(s) for s in p["shard_ids"]])
+        self._try_heartbeat()
+        return {}
+
+    def _h_ec_copy(self, req: Request):
+        """VolumeEcShardsCopy: pull shard files from a source server."""
+        p = req.json()
+        vid = int(p["volume"])
+        collection = p.get("collection", "")
+        source = p["source"]
+        loc = self.store.locations[0]
+        base = loc._base_name(collection, vid)
+        exts = [to_ext(int(s)) for s in p.get("shard_ids", [])]
+        if p.get("copy_ecx_file", True):
+            exts += [".ecx", ".ecj", ".vif"]
+        for ext in exts:
+            try:
+                data = call(
+                    source,
+                    f"/admin/ec/shard_file?volume={vid}"
+                    f"&collection={collection}&ext={ext}", timeout=600)
+            except RpcError as e:
+                if e.status == 404 and ext in (".ecj", ".vif"):
+                    continue  # optional sidecars
+                raise
+            if isinstance(data, dict):
+                raise RpcError(f"unexpected response for {ext}", 500)
+            with open(base + ext, "wb") as f:
+                f.write(data)
+        return {}
+
+    def _h_ec_delete_shards(self, req: Request):
+        p = req.json()
+        vid = int(p["volume"])
+        collection = p.get("collection", "")
+        shard_ids = [int(s) for s in p["shard_ids"]]
+        self.store.ec_unmount(vid, shard_ids)
+        import os
+
+        for loc in self.store.locations:
+            base = loc._base_name(collection, vid)
+            for sid in shard_ids:
+                try:
+                    os.remove(base + to_ext(sid))
+                except FileNotFoundError:
+                    pass
+            # when no shards remain, drop the index sidecars too
+            if not any(os.path.exists(base + to_ext(i))
+                       for i in range(TOTAL_SHARDS_COUNT)):
+                for ext in (".ecx", ".ecj", ".vif"):
+                    try:
+                        os.remove(base + ext)
+                    except FileNotFoundError:
+                        pass
+        return {}
+
+    def _h_ec_to_volume(self, req: Request):
+        """VolumeEcShardsToVolume: decode local shards back to .dat/.idx."""
+        p = req.json()
+        vid = int(p["volume"])
+        collection = p.get("collection", "")
+        loc = self.store.location_of(vid) or self.store.locations[0]
+        base = loc._base_name(collection, vid)
+        rebuild_ecx_file(base)
+        dat_size = ec_decoder.find_dat_file_size(base, base)
+        ec_decoder.write_dat_file(base, dat_size)
+        ec_decoder.write_idx_file_from_ec_index(base)
+        # unmount EC runtime, load as a normal volume
+        ev = self.store.find_ec_volume(vid)
+        if ev is not None:
+            self.store.ec_unmount(vid, list(ev.shards))
+        loc.add_volume(vid, collection)
+        self._try_heartbeat()
+        return {}
+
+    def _h_ec_shard_file(self, req: Request):
+        import os
+
+        vid = int(req.param("volume", "0"))
+        collection = req.param("collection", "") or ""
+        ext = req.param("ext", "")
+        if not ext.startswith(".ec") and ext not in (".ecx", ".ecj", ".vif",
+                                                     ".dat", ".idx"):
+            raise RpcError(f"disallowed ext {ext}", 400)
+        for loc in self.store.locations:
+            path = loc._base_name(collection, vid) + ext
+            if os.path.exists(path):
+                with open(path, "rb") as f:
+                    return f.read()
+        raise RpcError(f"{vid}{ext} not found", 404)
+
+    def _h_ec_shard_read(self, req: Request):
+        """VolumeEcShardRead: serve a span of a locally-mounted shard."""
+        vid = int(req.param("volume", "0"))
+        shard_id = int(req.param("shard", "0"))
+        offset = int(req.param("offset", "0"))
+        size = int(req.param("size", "0"))
+        ev = self.store.find_ec_volume(vid)
+        if ev is None or shard_id not in ev.shards:
+            raise RpcError(f"shard {vid}.{shard_id} not found", 404)
+        return ev.shards[shard_id].read_at(size, offset)
+
+    # -- remote EC shard fetch (store_ec.go read ladder) ---------------------
+    def _make_remote_reader(self, vid: int):
+        def remote_reader(shard_id: int, offset: int,
+                          size: int) -> Optional[bytes]:
+            locations = self._ec_shard_locations(vid).get(shard_id, [])
+            for url in locations:
+                if url == self.store.url:
+                    continue
+                try:
+                    data = call(
+                        url,
+                        f"/admin/ec/shard_read?volume={vid}"
+                        f"&shard={shard_id}&offset={offset}&size={size}",
+                        timeout=30)
+                    if isinstance(data, (bytes, bytearray)):
+                        return bytes(data)
+                except RpcError:
+                    continue
+            return None
+        return remote_reader
+
+    def _ec_shard_locations(self, vid: int) -> dict[int, list[str]]:
+        now = time.time()
+        cached = self._ec_locations.get(vid)
+        if cached is not None and now - cached[0] < EC_SHARD_CACHE_TTL:
+            return cached[1]
+        try:
+            resp = call(self.master_address, f"/ec/lookup?volumeId={vid}",
+                        timeout=10)
+            locations = {
+                e["shard_id"]: [loc["url"] for loc in e["locations"]]
+                for e in resp.get("shard_id_locations", [])
+            }
+        except RpcError:
+            locations = cached[1] if cached else {}
+        self._ec_locations[vid] = (now, locations)
+        return locations
+
+    def _try_heartbeat(self):
+        try:
+            self.heartbeat_once()
+        except RpcError:
+            pass

@@ -1,0 +1,220 @@
+"""Master-side logic with synthetic heartbeats — no cluster needed
+(mirrors topology_test.go's approach of feeding hand-built heartbeat
+messages)."""
+
+import pytest
+
+from seaweedfs_tpu.master.sequence import MemorySequencer, SnowflakeSequencer
+from seaweedfs_tpu.master.topology import Topology
+from seaweedfs_tpu.master.volume_growth import (VolumeGrowOption,
+                                                find_empty_slots,
+                                                grow_one_volume)
+from seaweedfs_tpu.shell.commands import EcNode, balanced_ec_distribution
+from seaweedfs_tpu.storage.super_block import ReplicaPlacement
+
+
+def hb(ip, port, dc="dc1", rack="rack1", max_volumes=8, volumes=(),
+       ec_shards=(), max_file_key=0):
+    return {
+        "ip": ip, "port": port, "public_url": f"{ip}:{port}",
+        "data_center": dc, "rack": rack, "max_volume_count": max_volumes,
+        "max_file_key": max_file_key,
+        "volumes": list(volumes), "ec_shards": list(ec_shards),
+    }
+
+
+def vol(vid, collection="", size=0, rp=0, read_only=False):
+    return {"id": vid, "collection": collection, "size": size,
+            "replica_placement": rp, "read_only": read_only}
+
+
+class TestTopology:
+    def test_register_and_lookup(self):
+        topo = Topology()
+        topo.process_heartbeat(hb("10.0.0.1", 8080, volumes=[vol(1), vol(2)]))
+        topo.process_heartbeat(hb("10.0.0.2", 8080, rack="rack2",
+                                  volumes=[vol(2)]))
+        assert len(topo.lookup(1)) == 1
+        assert len(topo.lookup(2)) == 2
+        assert topo.lookup(99) == []
+        assert topo.max_volume_id == 2
+
+    def test_heartbeat_removes_stale_volumes(self):
+        topo = Topology()
+        topo.process_heartbeat(hb("10.0.0.1", 8080, volumes=[vol(1), vol(2)]))
+        topo.process_heartbeat(hb("10.0.0.1", 8080, volumes=[vol(2)]))
+        assert topo.lookup(1) == []
+        assert len(topo.lookup(2)) == 1
+
+    def test_unregister_node(self):
+        topo = Topology()
+        topo.process_heartbeat(hb("10.0.0.1", 8080, volumes=[vol(1)]))
+        topo.unregister_node("10.0.0.1:8080")
+        assert topo.lookup(1) == []
+        assert "10.0.0.1:8080" not in topo.nodes
+
+    def test_reap_dead_nodes(self):
+        topo = Topology(pulse_seconds=0.01)
+        topo.process_heartbeat(hb("10.0.0.1", 8080, volumes=[vol(1)]))
+        topo.nodes["10.0.0.1:8080"].last_seen -= 10
+        dead = topo.reap_dead_nodes()
+        assert dead == ["10.0.0.1:8080"]
+        assert topo.lookup(1) == []
+
+    def test_writable_requires_enough_replicas(self):
+        topo = Topology()
+        # replication 001 => 2 copies needed
+        topo.process_heartbeat(hb("10.0.0.1", 8080,
+                                  volumes=[vol(1, rp=1)]))
+        layout = topo._layout_for("", 1, 0)
+        assert layout.active_writable_count() == 0  # only 1 replica
+        topo.process_heartbeat(hb("10.0.0.2", 8080,
+                                  volumes=[vol(1, rp=1)]))
+        assert layout.active_writable_count() == 1
+
+    def test_oversized_not_writable(self):
+        topo = Topology(volume_size_limit=1000)
+        topo.process_heartbeat(hb("10.0.0.1", 8080,
+                                  volumes=[vol(1, size=2000)]))
+        layout = topo._layout_for("", 0, 0)
+        assert layout.active_writable_count() == 0
+
+    def test_ec_registration_and_lookup(self):
+        topo = Topology()
+        topo.process_heartbeat(hb(
+            "10.0.0.1", 8080,
+            ec_shards=[{"id": 5, "collection": "",
+                        "ec_index_bits": 0b1111100000}]))
+        topo.process_heartbeat(hb(
+            "10.0.0.2", 8080,
+            ec_shards=[{"id": 5, "collection": "",
+                        "ec_index_bits": 0b0000011111}]))
+        result = topo.lookup_ec_shards(5)
+        assert result is not None
+        by_shard = {e["shard_id"]: e["locations"]
+                    for e in result["shard_id_locations"]}
+        assert len(by_shard) == 10
+        assert by_shard[0][0]["url"] == "10.0.0.2:8080"
+        assert by_shard[9][0]["url"] == "10.0.0.1:8080"
+        # generic lookup falls back to EC locations (topology.go:128-133)
+        assert len(topo.lookup(5)) == 2
+
+    def test_sequencer_bumped_by_heartbeat(self):
+        topo = Topology()
+        topo.process_heartbeat(hb("10.0.0.1", 8080, max_file_key=500))
+        first, count = topo.assign_file_id(3)
+        assert first == 501 and count == 3
+
+
+class TestSequencers:
+    def test_memory(self):
+        seq = MemorySequencer()
+        assert seq.next_batch(1) == 1
+        assert seq.next_batch(5) == 2
+        assert seq.next_batch(1) == 7
+        seq.set_max(100)
+        assert seq.next_batch(1) == 101
+
+    def test_snowflake_monotonic_unique(self):
+        seq = SnowflakeSequencer(7)
+        ids = [seq.next_batch(1) for _ in range(100)]
+        assert len(set(ids)) == 100
+        assert ids == sorted(ids)
+
+    def test_snowflake_node_range(self):
+        with pytest.raises(ValueError):
+            SnowflakeSequencer(1024)
+
+
+class TestPlacement:
+    def _topo(self, racks_per_dc=2, nodes_per_rack=2, dcs=1, free=8):
+        topo = Topology()
+        for d in range(dcs):
+            for r in range(racks_per_dc):
+                for n in range(nodes_per_rack):
+                    topo.process_heartbeat(hb(
+                        f"10.{d}.{r}.{n}", 8080, dc=f"dc{d}",
+                        rack=f"rack{d}-{r}", max_volumes=free))
+        return topo
+
+    def test_single_copy(self):
+        topo = self._topo()
+        servers = find_empty_slots(topo, VolumeGrowOption(
+            replica_placement=ReplicaPlacement.parse("000")))
+        assert len(servers) == 1
+
+    def test_same_rack_replica(self):
+        topo = self._topo()
+        servers = find_empty_slots(topo, VolumeGrowOption(
+            replica_placement=ReplicaPlacement.parse("001")))
+        assert len(servers) == 2
+        assert servers[0].rack.id == servers[1].rack.id
+        assert servers[0].id != servers[1].id
+
+    def test_diff_rack_replica(self):
+        topo = self._topo()
+        servers = find_empty_slots(topo, VolumeGrowOption(
+            replica_placement=ReplicaPlacement.parse("010")))
+        assert len(servers) == 2
+        assert servers[0].rack.id != servers[1].rack.id
+
+    def test_diff_dc_replica(self):
+        topo = self._topo(dcs=2)
+        servers = find_empty_slots(topo, VolumeGrowOption(
+            replica_placement=ReplicaPlacement.parse("100")))
+        assert len(servers) == 2
+        assert servers[0].dc.id != servers[1].dc.id
+
+    def test_mixed_placement_210(self):
+        # 2 other DCs + 1 other rack: 4 servers total
+        topo = self._topo(dcs=3)
+        servers = find_empty_slots(topo, VolumeGrowOption(
+            replica_placement=ReplicaPlacement.parse("210")))
+        assert len(servers) == 4
+        assert len({s.dc.id for s in servers}) == 3
+
+    def test_insufficient_capacity(self):
+        topo = self._topo(racks_per_dc=1)
+        with pytest.raises(ValueError):
+            find_empty_slots(topo, VolumeGrowOption(
+                replica_placement=ReplicaPlacement.parse("010")))
+
+    def test_full_nodes_skipped(self):
+        topo = self._topo(free=0)
+        with pytest.raises(ValueError):
+            find_empty_slots(topo, VolumeGrowOption(
+                replica_placement=ReplicaPlacement.parse("000")))
+
+    def test_grow_one_volume_allocates(self):
+        topo = self._topo()
+        allocated = []
+        vid, servers = grow_one_volume(
+            topo, VolumeGrowOption(
+                replica_placement=ReplicaPlacement.parse("001")),
+            lambda server, vid: allocated.append((server.id, vid)))
+        assert vid == 1
+        assert len(allocated) == 2
+
+
+class TestBalancedEcDistribution:
+    def test_even_spread(self):
+        nodes = [EcNode(url=f"n{i}", free_slots=4) for i in range(7)]
+        allocation = balanced_ec_distribution(nodes)
+        assert sum(len(v) for v in allocation.values()) == 14
+        assert all(len(v) == 2 for v in allocation.values())
+
+    def test_full_nodes_excluded(self):
+        nodes = [EcNode(url="big", free_slots=10),
+                 EcNode(url="full", free_slots=0)]
+        allocation = balanced_ec_distribution(nodes)
+        assert len(allocation["big"]) == 14
+        assert "full" not in allocation
+
+    def test_not_enough_slots_raises(self):
+        nodes = [EcNode(url="a", free_slots=0)]
+        with pytest.raises(ValueError):
+            balanced_ec_distribution(nodes)
+
+    def test_no_nodes(self):
+        with pytest.raises(ValueError):
+            balanced_ec_distribution([])
