@@ -1,0 +1,133 @@
+"""Load benchmark: concurrent write-then-read of small files.
+
+Port of `weed benchmark` (weed/command/benchmark.go:27-90): N files of a
+given size written through master assign + volume POST at a set
+concurrency, then read back randomly, with a latency histogram and the
+same percentile report (p50..p99.9/max) as the reference README's
+published numbers (README.md:342-391).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+
+from .rpc.http_rpc import RpcError, call
+
+
+@dataclass
+class BenchResult:
+    requests: int = 0
+    errors: int = 0
+    bytes: int = 0
+    seconds: float = 0.0
+    latencies_ms: list = field(default_factory=list)
+
+    def percentile(self, p: float) -> float:
+        if not self.latencies_ms:
+            return 0.0
+        data = sorted(self.latencies_ms)
+        idx = min(len(data) - 1, int(len(data) * p / 100))
+        return data[idx]
+
+    def report(self, title: str) -> str:
+        rps = self.requests / self.seconds if self.seconds else 0
+        mbps = self.bytes / 1e6 / self.seconds if self.seconds else 0
+        lines = [
+            f"--- {title} ---",
+            f"requests: {self.requests}, errors: {self.errors}",
+            f"time: {self.seconds:.2f}s, {rps:.1f} req/s, {mbps:.2f} MB/s",
+        ]
+        for p in (50, 66, 75, 80, 90, 95, 98, 99, 99.9):
+            lines.append(f"  p{p}: {self.percentile(p):.2f} ms")
+        if self.latencies_ms:
+            lines.append(f"  max: {max(self.latencies_ms):.2f} ms")
+        return "\n".join(lines)
+
+
+def run_benchmark(master_address: str, num_files: int = 1000,
+                  file_size: int = 1024, concurrency: int = 16,
+                  delete_percent: int = 0, replication: str = "000",
+                  do_read: bool = True, quiet: bool = False):
+    payload = random.randbytes(file_size)
+    fids: list[tuple[str, str]] = []
+    fid_lock = threading.Lock()
+    write = BenchResult()
+    counter = {"n": 0}
+
+    def write_worker():
+        while True:
+            with fid_lock:
+                if counter["n"] >= num_files:
+                    return
+                counter["n"] += 1
+            t0 = time.perf_counter()
+            try:
+                a = call(master_address,
+                         f"/dir/assign?replication={replication}")
+                call(a["url"], f"/{a['fid']}", raw=payload, method="POST")
+                dt = (time.perf_counter() - t0) * 1e3
+                with fid_lock:
+                    write.requests += 1
+                    write.bytes += file_size
+                    write.latencies_ms.append(dt)
+                    fids.append((a["url"], a["fid"]))
+            except RpcError:
+                with fid_lock:
+                    write.errors += 1
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=write_worker)
+               for _ in range(concurrency)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    write.seconds = time.perf_counter() - t0
+
+    read = BenchResult()
+    if do_read and fids:
+        reads_left = {"n": len(fids)}
+
+        def read_worker():
+            while True:
+                with fid_lock:
+                    if reads_left["n"] <= 0:
+                        return
+                    reads_left["n"] -= 1
+                url, fid = random.choice(fids)
+                t0 = time.perf_counter()
+                try:
+                    data = call(url, f"/{fid}")
+                    dt = (time.perf_counter() - t0) * 1e3
+                    with fid_lock:
+                        read.requests += 1
+                        read.bytes += len(data)
+                        read.latencies_ms.append(dt)
+                except RpcError:
+                    with fid_lock:
+                        read.errors += 1
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=read_worker)
+                   for _ in range(concurrency)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        read.seconds = time.perf_counter() - t0
+
+    if delete_percent > 0:
+        for url, fid in fids[: len(fids) * delete_percent // 100]:
+            try:
+                call(url, f"/{fid}", method="DELETE")
+            except RpcError:
+                pass
+
+    if not quiet:
+        print(write.report("write"))
+        if do_read:
+            print(read.report("read"))
+    return write, read

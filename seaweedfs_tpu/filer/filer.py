@@ -1,0 +1,185 @@
+"""Filer core: path -> Entry CRUD with directory management + change log.
+
+Parity with weed/filer/filer.go:34-105: auto-creation of parent
+directories on insert, recursive delete with chunk reclamation hooks,
+rename, and the metadata change log (filer_notify.go:19-111): every
+mutation appends an EventNotification that subscribers can replay/tail
+(filer_grpc_server_sub_meta.go).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Iterator, Optional
+
+from .entry import Attr, Entry, FileChunk, new_directory_entry
+from .filer_store import FilerStore, MemoryStore, NotFoundError
+
+LOG_BUFFER_CAPACITY = 10000
+
+
+class MetaEvent:
+    __slots__ = ("ts_ns", "directory", "old_entry", "new_entry")
+
+    def __init__(self, directory: str, old_entry: Optional[dict],
+                 new_entry: Optional[dict]):
+        self.ts_ns = time.time_ns()
+        self.directory = directory
+        self.old_entry = old_entry
+        self.new_entry = new_entry
+
+    def to_dict(self) -> dict:
+        return {"ts_ns": self.ts_ns, "directory": self.directory,
+                "old_entry": self.old_entry, "new_entry": self.new_entry}
+
+
+class Filer:
+    def __init__(self, store: Optional[FilerStore] = None):
+        self.store = store or MemoryStore()
+        self.lock = threading.RLock()
+        # ring buffer of change events (util/log_buffer analogue)
+        self._log: list[MetaEvent] = []
+        self._log_lock = threading.Lock()
+        self.on_delete_chunks: Optional[Callable[[list[FileChunk]], None]] \
+            = None
+
+    # -- change log (filer_notify.go NotifyUpdateEvent) ----------------------
+    def _notify(self, directory: str, old_entry: Optional[Entry],
+                new_entry: Optional[Entry]):
+        event = MetaEvent(
+            directory,
+            old_entry.to_dict() if old_entry else None,
+            new_entry.to_dict() if new_entry else None)
+        with self._log_lock:
+            self._log.append(event)
+            if len(self._log) > LOG_BUFFER_CAPACITY:
+                self._log = self._log[-LOG_BUFFER_CAPACITY:]
+
+    def subscribe_metadata(self, since_ns: int = 0,
+                           path_prefix: str = "/") -> list[dict]:
+        """Replay change events newer than since_ns under path_prefix."""
+        with self._log_lock:
+            return [e.to_dict() for e in self._log
+                    if e.ts_ns > since_ns
+                    and (e.directory + "/").startswith(
+                        path_prefix.rstrip("/") + "/")]
+
+    # -- CRUD ----------------------------------------------------------------
+    def create_entry(self, entry: Entry):
+        with self.lock:
+            self._ensure_parents(entry.parent)
+            old = self._find_or_none(entry.full_path)
+            if old is not None and old.is_directory and not entry.is_directory:
+                raise ValueError(
+                    f"{entry.full_path} is a directory")
+            self.store.insert_entry(entry)
+            self._notify(entry.parent, old, entry)
+            if (old is not None and self.on_delete_chunks
+                    and old.chunks):
+                # overwritten file: reclaim chunks no longer referenced
+                kept = {c.fid for c in entry.chunks}
+                orphaned = [c for c in old.chunks if c.fid not in kept]
+                if orphaned:
+                    self.on_delete_chunks(orphaned)
+
+    def _ensure_parents(self, dir_path: str):
+        if dir_path in ("", "/"):
+            return
+        try:
+            existing = self.store.find_entry(dir_path)
+            if not existing.is_directory:
+                raise ValueError(f"{dir_path} is a file")
+            return
+        except NotFoundError:
+            pass
+        self._ensure_parents(dir_path.rsplit("/", 1)[0] or "/")
+        d = new_directory_entry(dir_path)
+        self.store.insert_entry(d)
+        self._notify(d.parent, None, d)
+
+    def find_entry(self, path: str) -> Entry:
+        return self.store.find_entry(self._norm(path))
+
+    def _find_or_none(self, path: str) -> Optional[Entry]:
+        try:
+            return self.store.find_entry(path)
+        except NotFoundError:
+            return None
+
+    def update_entry(self, entry: Entry):
+        with self.lock:
+            old = self._find_or_none(entry.full_path)
+            self.store.update_entry(entry)
+            self._notify(entry.parent, old, entry)
+
+    def delete_entry(self, path: str, recursive: bool = False,
+                     ignore_recursive_error: bool = False):
+        """filer_delete_entry.go semantics: directories need recursive=True
+        unless empty; file deletion reclaims chunks."""
+        path = self._norm(path)
+        with self.lock:
+            entry = self.store.find_entry(path)
+            if entry.is_directory:
+                children = self.store.list_directory(path, limit=1)
+                if children and not recursive:
+                    raise ValueError(f"{path} is not empty")
+                self._delete_recursive(path)
+                self.store.delete_entry(path)
+            else:
+                self.store.delete_entry(path)
+                if self.on_delete_chunks and entry.chunks:
+                    self.on_delete_chunks(entry.chunks)
+            self._notify(entry.parent, entry, None)
+
+    def _delete_recursive(self, dir_path: str):
+        while True:
+            children = self.store.list_directory(dir_path, limit=1024)
+            if not children:
+                break
+            for child in children:
+                if child.is_directory:
+                    self._delete_recursive(child.full_path)
+                    self.store.delete_entry(child.full_path)
+                else:
+                    self.store.delete_entry(child.full_path)
+                    if self.on_delete_chunks and child.chunks:
+                        self.on_delete_chunks(child.chunks)
+
+    def list_directory(self, path: str, start_file: str = "",
+                       limit: int = 1024, prefix: str = "",
+                       include_start: bool = False) -> list[Entry]:
+        return self.store.list_directory(
+            self._norm(path), start_file=start_file, limit=limit,
+            prefix=prefix, include_start=include_start)
+
+    def rename(self, old_path: str, new_path: str):
+        """Atomic single-entry rename + recursive subtree move
+        (filer_rename.go)."""
+        old_path, new_path = self._norm(old_path), self._norm(new_path)
+        with self.lock:
+            entry = self.store.find_entry(old_path)
+            dst = self._find_or_none(new_path)
+            if dst is not None:
+                if dst.is_directory and not entry.is_directory:
+                    raise ValueError(f"{new_path} is a directory")
+                if self.on_delete_chunks and dst.chunks:
+                    self.on_delete_chunks(dst.chunks)
+            self._ensure_parents(new_path.rsplit("/", 1)[0] or "/")
+            if entry.is_directory:
+                for child in self.store.list_directory(old_path,
+                                                       limit=100000):
+                    self.rename(child.full_path,
+                                new_path + "/" + child.name)
+            entry.full_path = new_path
+            self.store.insert_entry(entry)
+            self.store.delete_entry(old_path)
+            self._notify(entry.parent, None, entry)
+
+    @staticmethod
+    def _norm(path: str) -> str:
+        if not path.startswith("/"):
+            path = "/" + path
+        if len(path) > 1:
+            path = path.rstrip("/")
+        return path

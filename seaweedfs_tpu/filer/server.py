@@ -1,0 +1,250 @@
+"""Filer HTTP server: path-addressed files over the volume store.
+
+Parity with weed/server/filer_server_handlers_*.go:
+  * POST/PUT /path: auto-chunked upload — split body into chunks, assign a
+    fid per chunk from the master, upload to volume servers, save the entry
+    (filer_server_handlers_write_autochunk.go:23-130); small files inline
+    into the entry
+  * GET /path: entry resolution -> chunk fetches -> reassembled body with
+    Range support (filer_server_handlers_read.go); directories return JSON
+    listings (?limit=&lastFileName=)
+  * DELETE /path?recursive=true: recursive delete + chunk reclamation
+  * POST /path?mv.from=/src: rename
+  * GET /metadata/subscribe?since=: change-log tail (SubscribeMetadata)
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+import time
+from typing import Optional
+
+from ..rpc.http_rpc import Request, Response, RpcError, RpcServer, call
+from .entry import Attr, Entry, FileChunk, total_size
+from .filechunks import etag_of_chunks, read_chunk_views
+from .filer import Filer
+from .filer_store import FilerStore, NotFoundError
+
+DEFAULT_CHUNK_SIZE = 4 * 1024 * 1024  # filer -maxMB default (4MB)
+INLINE_LIMIT = 2048  # small-content inlining threshold
+
+
+class FilerServer:
+    def __init__(self, master_address: str, host: str = "127.0.0.1",
+                 port: int = 0, store: Optional[FilerStore] = None,
+                 chunk_size: int = DEFAULT_CHUNK_SIZE,
+                 replication: str = "", collection: str = ""):
+        self.master_address = master_address
+        self.chunk_size = chunk_size
+        self.replication = replication
+        self.collection = collection
+        self.filer = Filer(store)
+        self.filer.on_delete_chunks = self._delete_chunks
+        self.server = RpcServer(host, port)
+        self.server.add("GET", "/metadata/subscribe", self._h_subscribe)
+        self.server.default_route = self._handle
+
+    @property
+    def address(self) -> str:
+        return self.server.address
+
+    def start(self):
+        self.server.start()
+
+    def stop(self):
+        self.server.stop()
+        self.filer.store.close()
+
+    # -- volume cluster plumbing ---------------------------------------------
+    def _assign(self, count: int = 1) -> dict:
+        query = f"count={count}"
+        if self.replication:
+            query += f"&replication={self.replication}"
+        if self.collection:
+            query += f"&collection={self.collection}"
+        return call(self.master_address, f"/dir/assign?{query}", timeout=30)
+
+    def _lookup_url(self, fid: str) -> str:
+        vid = fid.split(",")[0]
+        found = call(self.master_address, f"/dir/lookup?volumeId={vid}",
+                     timeout=10)
+        return found["locations"][0]["url"]
+
+    def _delete_chunks(self, chunks: list[FileChunk]):
+        for chunk in chunks:
+            try:
+                call(self._lookup_url(chunk.fid), f"/{chunk.fid}",
+                     method="DELETE", timeout=10)
+            except RpcError:
+                pass  # chunk may already be gone; vacuum reclaims the rest
+
+    # -- request routing -----------------------------------------------------
+    def _handle(self, method: str, req: Request):
+        path = req.path or "/"
+        if method in ("POST", "PUT"):
+            return self._h_write(path, req)
+        if method in ("GET", "HEAD"):
+            return self._h_read(path, req, method)
+        if method == "DELETE":
+            return self._h_delete(path, req)
+        raise RpcError(f"unsupported method {method}", 405)
+
+    # -- write (auto-chunk) --------------------------------------------------
+    def _h_write(self, path: str, req: Request):
+        move_from = req.param("mv.from")
+        if move_from:
+            try:
+                self.filer.rename(move_from, path)
+            except NotFoundError:
+                raise RpcError(f"{move_from} not found", 404)
+            return {"from": move_from, "to": path}
+
+        if path.endswith("/"):
+            # mkdir-style: create the directory entry
+            from .entry import new_directory_entry
+
+            self.filer.create_entry(new_directory_entry(
+                self.filer._norm(path)))
+            return {"name": path}
+
+        body = req.body
+        mime = req.headers.get("Content-Type") or ""
+        entry = self.save_bytes(path, body, mime)
+        return {"name": entry.name, "size": len(body),
+                "md5": entry.attr.md5}
+
+    def save_bytes(self, path: str, body: bytes, mime: str = "",
+                   extended: Optional[dict] = None) -> Entry:
+        """Auto-chunked write used by both the filer HTTP API and the S3
+        gateway: small bodies inline, larger ones chunk to the volume
+        cluster (doPutAutoChunk, _write_upload.go)."""
+        now = time.time()
+        md5 = hashlib.md5(body).hexdigest()
+        entry = Entry(
+            full_path=self.filer._norm(path),
+            attr=Attr(mtime=now, crtime=now, mime=mime, md5=md5,
+                      file_size=len(body)),
+            extended=extended or {})
+        if len(body) <= INLINE_LIMIT:
+            entry.content = body
+        else:
+            offset = 0
+            while offset < len(body):
+                piece = body[offset:offset + self.chunk_size]
+                assign = self._assign()
+                fid, url = assign["fid"], assign["url"]
+                up = call(url, f"/{fid}", raw=piece, method="POST",
+                          headers={"Content-Type":
+                                   "application/octet-stream"},
+                          timeout=60)
+                entry.chunks.append(FileChunk(
+                    fid=fid, offset=offset, size=len(piece),
+                    etag=up.get("eTag", ""),
+                    modified_ts_ns=time.time_ns()))
+                offset += len(piece)
+        self.filer.create_entry(entry)
+        return entry
+
+    def read_bytes(self, entry: Entry, start: int = 0,
+                   length: Optional[int] = None) -> bytes:
+        """Reassemble [start, start+length) of an entry's content."""
+        size = entry.size()
+        if length is None:
+            length = size - start
+        if entry.content:
+            return entry.content[start:start + length]
+        parts = []
+        for view in read_chunk_views(entry.chunks, start, length):
+            url = self._lookup_url(view.fid)
+            data = call(url, f"/{view.fid}", timeout=60)
+            if isinstance(data, dict):
+                raise RpcError(f"chunk {view.fid} fetch failed", 500)
+            parts.append(bytes(data)[view.offset_in_chunk:
+                                     view.offset_in_chunk + view.size])
+        return b"".join(parts)
+
+    # -- read ----------------------------------------------------------------
+    def _h_read(self, path: str, req: Request, method: str):
+        try:
+            entry = self.filer.find_entry(path)
+        except NotFoundError:
+            raise RpcError(f"{path} not found", 404)
+        if entry.is_directory:
+            return self._list_directory(entry, req)
+
+        size = entry.size()
+        start, length = 0, size
+        status = 200
+        headers = {}
+        range_header = req.headers.get("Range")
+        if range_header and range_header.startswith("bytes="):
+            spec = range_header[6:].split(",")[0]
+            lo_s, _, hi_s = spec.partition("-")
+            lo = int(lo_s) if lo_s else None
+            hi = int(hi_s) if hi_s else None
+            if lo is None:  # suffix range: last N bytes
+                start = max(0, size - (hi or 0))
+                length = size - start
+            else:
+                start = lo
+                length = (min(hi, size - 1) - lo + 1) if hi is not None \
+                    else size - lo
+            if start >= size or length <= 0:
+                raise RpcError("range not satisfiable", 416)
+            status = 206
+            headers["Content-Range"] = \
+                f"bytes {start}-{start + length - 1}/{size}"
+
+        if entry.attr.mime:
+            content_type = entry.attr.mime
+        else:
+            content_type = "application/octet-stream"
+        headers["Etag"] = f'"{entry.attr.md5 or etag_of_chunks(entry.chunks)}"'
+        headers["Accept-Ranges"] = "bytes"
+        if method == "HEAD":
+            headers["Content-Length"] = str(length)
+            return Response(b"", status, content_type, headers)
+
+        return Response(self.read_bytes(entry, start, length), status,
+                        content_type, headers)
+
+    def _list_directory(self, entry: Entry, req: Request):
+        limit = int(req.param("limit", "100"))
+        last = req.param("lastFileName", "") or ""
+        entries = self.filer.list_directory(entry.full_path,
+                                            start_file=last, limit=limit)
+        return {
+            "Path": entry.full_path,
+            "Entries": [
+                {
+                    "FullPath": e.full_path,
+                    "Mtime": e.attr.mtime,
+                    "Mode": e.attr.mode,
+                    "Mime": e.attr.mime,
+                    "FileSize": e.size(),
+                    "IsDirectory": e.is_directory,
+                } for e in entries
+            ],
+            "Limit": limit,
+            "LastFileName": entries[-1].name if entries else "",
+            "ShouldDisplayLoadMore": len(entries) == limit,
+        }
+
+    # -- delete --------------------------------------------------------------
+    def _h_delete(self, path: str, req: Request):
+        recursive = req.param("recursive") == "true"
+        try:
+            self.filer.delete_entry(path, recursive=recursive)
+        except NotFoundError:
+            raise RpcError(f"{path} not found", 404)
+        except ValueError as e:
+            raise RpcError(str(e), 400)
+        return Response(b"", 204)
+
+    # -- metadata subscription ----------------------------------------------
+    def _h_subscribe(self, req: Request):
+        since = int(req.param("since", "0"))
+        prefix = req.param("pathPrefix", "/") or "/"
+        return {"events": self.filer.subscribe_metadata(since, prefix)}

@@ -42,7 +42,10 @@ class Request:
         return json.loads(self.body)
 
     def param(self, name: str, default: Optional[str] = None) -> Optional[str]:
-        return self.query.get(name, default)
+        value = self.query.get(name)
+        # blank values ("?limit=") behave as absent for value params;
+        # flag params ("?delete=") test membership via `in req.query`
+        return default if value in (None, "") else value
 
 
 class Response:
@@ -80,7 +83,8 @@ class RpcServer:
                 parsed = urllib.parse.urlsplit(self.path)
                 path = parsed.path
                 query = {k: v[0] for k, v in
-                         urllib.parse.parse_qs(parsed.query).items()}
+                         urllib.parse.parse_qs(
+                             parsed.query, keep_blank_values=True).items()}
                 length = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(length) if length else b""
                 req = Request(self, path, query, body)

@@ -1,0 +1,199 @@
+"""AWS Signature V4 verification for the S3 gateway.
+
+Parity with weed/s3api/auth_signature_v4.go (header-based signing and
+presigned query auth) and auth_credentials.go's identity model: identities
+with access/secret keys and allowed actions.  Anonymous access is allowed
+when no identities are configured, mirroring the reference's behaviour
+without a config.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import time
+import urllib.parse
+from dataclasses import dataclass, field
+from typing import Optional
+
+ALGORITHM = "AWS4-HMAC-SHA256"
+MAX_CLOCK_SKEW_SECONDS = 15 * 60  # AWS allows +/-15 minutes
+
+
+def _parse_amz_date(amz_date: str) -> float:
+    try:
+        return time.mktime(time.strptime(amz_date, "%Y%m%dT%H%M%SZ")) \
+            - time.timezone
+    except ValueError:
+        raise AuthError("AccessDenied", f"malformed date {amz_date!r}", 403)
+
+ACTION_READ = "Read"
+ACTION_WRITE = "Write"
+ACTION_LIST = "List"
+ACTION_ADMIN = "Admin"
+
+
+class AuthError(Exception):
+    def __init__(self, code: str, message: str, status: int = 403):
+        super().__init__(message)
+        self.code = code
+        self.status = status
+
+
+@dataclass
+class Identity:
+    name: str
+    access_key: str
+    secret_key: str
+    actions: list[str] = field(default_factory=lambda: [ACTION_ADMIN])
+
+    def can(self, action: str, bucket: str = "") -> bool:
+        for a in self.actions:
+            if a == ACTION_ADMIN:
+                return True
+            base, _, target = a.partition(":")
+            if base != action:
+                continue
+            if not target or target == bucket:
+                return True
+        return False
+
+
+class IdentityAccessManagement:
+    def __init__(self, identities: Optional[list[Identity]] = None):
+        self.identities = {i.access_key: i for i in (identities or [])}
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.identities)
+
+    # -- sigv4 ---------------------------------------------------------------
+    def verify(self, method: str, path: str, query: dict, headers,
+               body: bytes) -> Optional[Identity]:
+        """Verify the request; returns the Identity (None when auth is
+        disabled).  Raises AuthError on failure."""
+        if not self.enabled:
+            return None
+        auth_header = headers.get("Authorization", "")
+        if auth_header.startswith(ALGORITHM):
+            return self._verify_header(method, path, query, headers, body,
+                                       auth_header)
+        if query.get("X-Amz-Algorithm") == ALGORITHM:
+            return self._verify_presigned(method, path, query, headers)
+        raise AuthError("AccessDenied", "no valid authentication", 403)
+
+    def _parse_auth_header(self, auth_header: str) -> dict:
+        # AWS4-HMAC-SHA256 Credential=AK/date/region/s3/aws4_request,
+        #   SignedHeaders=a;b;c, Signature=hex
+        parts = auth_header[len(ALGORITHM):].strip().split(",")
+        fields = {}
+        for part in parts:
+            k, _, v = part.strip().partition("=")
+            fields[k] = v
+        missing = {"Credential", "SignedHeaders", "Signature"} - set(fields)
+        if missing:
+            raise AuthError("AuthorizationHeaderMalformed",
+                            f"missing {missing}", 400)
+        return fields
+
+    def _verify_header(self, method, path, query, headers, body,
+                       auth_header) -> Identity:
+        fields = self._parse_auth_header(auth_header)
+        cred_parts = fields["Credential"].split("/")
+        if len(cred_parts) != 5:
+            raise AuthError("AuthorizationHeaderMalformed",
+                            "bad credential scope", 400)
+        access_key, datestamp, region, service, terminal = cred_parts
+        identity = self.identities.get(access_key)
+        if identity is None:
+            raise AuthError("InvalidAccessKeyId",
+                            f"unknown access key {access_key}", 403)
+        signed_headers = fields["SignedHeaders"].split(";")
+        amz_date = headers.get("X-Amz-Date", "")
+        if abs(time.time() - _parse_amz_date(amz_date)) \
+                > MAX_CLOCK_SKEW_SECONDS:
+            raise AuthError("RequestTimeTooSkewed",
+                            "request time too skewed", 403)
+        payload_hash = headers.get("X-Amz-Content-Sha256", "")
+        if payload_hash in ("", "UNSIGNED-PAYLOAD"):
+            payload_hash = payload_hash or hashlib.sha256(body).hexdigest()
+        elif payload_hash.startswith("STREAMING-"):
+            pass  # chunked uploads sign the seed; body chunks carry their own
+        canonical = self._canonical_request(
+            method, path, query, headers, signed_headers, payload_hash)
+        scope = f"{datestamp}/{region}/{service}/{terminal}"
+        string_to_sign = "\n".join([
+            ALGORITHM, amz_date, scope,
+            hashlib.sha256(canonical.encode()).hexdigest()])
+        signature = self._signature(identity.secret_key, datestamp, region,
+                                    service, string_to_sign)
+        if not hmac.compare_digest(signature, fields["Signature"]):
+            raise AuthError("SignatureDoesNotMatch",
+                            "signature mismatch", 403)
+        return identity
+
+    def _verify_presigned(self, method, path, query, headers) -> Identity:
+        cred = query.get("X-Amz-Credential", "")
+        cred_parts = cred.split("/")
+        if len(cred_parts) != 5:
+            raise AuthError("AuthorizationQueryParametersError",
+                            "bad credential", 400)
+        access_key, datestamp, region, service, terminal = cred_parts
+        identity = self.identities.get(access_key)
+        if identity is None:
+            raise AuthError("InvalidAccessKeyId",
+                            f"unknown access key {access_key}", 403)
+        amz_date = query.get("X-Amz-Date", "")
+        request_time = _parse_amz_date(amz_date)
+        expires = int(query.get("X-Amz-Expires", "604800"))
+        if time.time() > request_time + expires:
+            raise AuthError("AccessDenied", "request has expired", 403)
+        if time.time() + MAX_CLOCK_SKEW_SECONDS < request_time:
+            raise AuthError("RequestTimeTooSkewed",
+                            "request time too skewed", 403)
+        signed_headers = query.get("X-Amz-SignedHeaders", "host").split(";")
+        provided = query.get("X-Amz-Signature", "")
+        q = {k: v for k, v in query.items() if k != "X-Amz-Signature"}
+        canonical = self._canonical_request(
+            method, path, q, headers, signed_headers, "UNSIGNED-PAYLOAD")
+        scope = f"{datestamp}/{region}/{service}/{terminal}"
+        string_to_sign = "\n".join([
+            ALGORITHM, amz_date, scope,
+            hashlib.sha256(canonical.encode()).hexdigest()])
+        signature = self._signature(identity.secret_key, datestamp, region,
+                                    service, string_to_sign)
+        if not hmac.compare_digest(signature, provided):
+            raise AuthError("SignatureDoesNotMatch",
+                            "signature mismatch", 403)
+        return identity
+
+    @staticmethod
+    def _canonical_request(method, path, query, headers, signed_headers,
+                           payload_hash) -> str:
+        canonical_uri = urllib.parse.quote(path, safe="/~")
+        q_pairs = sorted(
+            (urllib.parse.quote(k, safe="~"),
+             urllib.parse.quote(str(v), safe="~"))
+            for k, v in query.items())
+        canonical_query = "&".join(f"{k}={v}" for k, v in q_pairs)
+        header_lines = []
+        for name in signed_headers:
+            value = headers.get(name) or ""
+            header_lines.append(f"{name}:{' '.join(value.split())}")
+        return "\n".join([
+            method, canonical_uri, canonical_query,
+            "\n".join(header_lines) + "\n",
+            ";".join(signed_headers), payload_hash])
+
+    @staticmethod
+    def _signature(secret, datestamp, region, service,
+                   string_to_sign) -> str:
+        def h(key, msg):
+            return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+        k_date = h(("AWS4" + secret).encode(), datestamp)
+        k_region = h(k_date, region)
+        k_service = h(k_region, service)
+        k_signing = h(k_service, "aws4_request")
+        return hmac.new(k_signing, string_to_sign.encode(),
+                        hashlib.sha256).hexdigest()

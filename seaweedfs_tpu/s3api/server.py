@@ -1,0 +1,531 @@
+"""S3-compatible gateway over the filer.
+
+Parity with weed/s3api/s3api_server.go's route table: bucket CRUD +
+listing (v1/v2), object CRUD with Range/metadata/tagging, CopyObject,
+multi-delete, and multipart uploads, with AWS SigV4 auth (auth.py) and
+XML wire format.  Buckets live under /buckets/<name> in the filer
+namespace, like the reference's filer integration (filer_multipart.go,
+s3api_objects_*.go); multipart parts are staged under
+/buckets/<b>/.uploads/<uploadId>/ and composed by chunk-list rebasing.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import time
+import urllib.parse
+import uuid
+import xml.etree.ElementTree as ET
+from typing import Optional
+
+from ..filer.entry import Entry, FileChunk, new_directory_entry
+from ..filer.filer_store import NotFoundError
+from ..filer.server import FilerServer
+from ..rpc.http_rpc import Request, Response, RpcError, RpcServer
+from .auth import (ACTION_ADMIN, ACTION_LIST, ACTION_READ, ACTION_WRITE,
+                   AuthError, Identity, IdentityAccessManagement)
+
+BUCKETS_ROOT = "/buckets"
+UPLOADS_DIR = ".uploads"
+
+
+def _xml(tag: str, children) -> bytes:
+    root = ET.Element(tag,
+                      xmlns="http://s3.amazonaws.com/doc/2006-03-01/")
+    _build(root, children)
+    return (b'<?xml version="1.0" encoding="UTF-8"?>'
+            + ET.tostring(root))
+
+
+def _build(parent, children):
+    if isinstance(children, dict):
+        for k, v in children.items():
+            if isinstance(v, list):
+                for item in v:
+                    node = ET.SubElement(parent, k)
+                    _build(node, item)
+            else:
+                node = ET.SubElement(parent, k)
+                _build(node, v)
+    else:
+        parent.text = "" if children is None else str(children)
+
+
+def _error_xml(code: str, message: str, status: int) -> Response:
+    root = ET.Element("Error")
+    ET.SubElement(root, "Code").text = code
+    ET.SubElement(root, "Message").text = message
+    return Response(ET.tostring(root), status, "application/xml")
+
+
+class S3ApiServer:
+    def __init__(self, filer: FilerServer, host: str = "127.0.0.1",
+                 port: int = 0,
+                 identities: Optional[list[Identity]] = None):
+        self.filer_server = filer
+        self.filer = filer.filer
+        self.iam = IdentityAccessManagement(identities)
+        self.server = RpcServer(host, port)
+        self.server.default_route = self._handle
+
+    @property
+    def address(self) -> str:
+        return self.server.address
+
+    def start(self):
+        self.server.start()
+
+    def stop(self):
+        self.server.stop()
+
+    # -- routing -------------------------------------------------------------
+    def _handle(self, method: str, req: Request):
+        try:
+            return self._route(method, req)
+        except AuthError as e:
+            return _error_xml(e.code, str(e), e.status)
+        except NotFoundError as e:
+            return _error_xml("NoSuchKey", str(e), 404)
+
+    def _route(self, method: str, req: Request):
+        path = urllib.parse.unquote(req.path)
+        parts = path.lstrip("/").split("/", 1)
+        bucket = parts[0]
+        key = parts[1] if len(parts) > 1 else ""
+
+        action = ACTION_READ if method in ("GET", "HEAD") else ACTION_WRITE
+        if method == "GET" and not key:
+            action = ACTION_LIST
+        identity = self.iam.verify(method, path, req.query, req.headers,
+                                   req.body)
+        if identity is not None and not identity.can(action, bucket):
+            raise AuthError("AccessDenied",
+                            f"{action} not allowed on {bucket}", 403)
+
+        if not bucket:
+            if method == "GET":
+                return self._list_buckets()
+            raise RpcError("bad request", 400)
+        if not key:
+            return self._bucket_op(method, bucket, req)
+        return self._object_op(method, bucket, key, req)
+
+    # -- buckets -------------------------------------------------------------
+    def _bucket_path(self, bucket: str) -> str:
+        return f"{BUCKETS_ROOT}/{bucket}"
+
+    def _list_buckets(self):
+        try:
+            entries = self.filer.list_directory(BUCKETS_ROOT, limit=10000)
+        except NotFoundError:
+            entries = []
+        return Response(_xml("ListAllMyBucketsResult", {
+            "Owner": {"ID": "seaweedfs_tpu"},
+            "Buckets": {"Bucket": [
+                {"Name": e.name,
+                 "CreationDate": _iso(e.attr.crtime)}
+                for e in entries if e.is_directory
+            ]},
+        }), 200, "application/xml")
+
+    def _bucket_op(self, method: str, bucket: str, req: Request):
+        path = self._bucket_path(bucket)
+        if method == "PUT":
+            self.filer.create_entry(new_directory_entry(path))
+            return Response(b"", 200)
+        if method == "HEAD":
+            entry = self.filer.find_entry(path)  # raises NotFound
+            return Response(b"", 200)
+        if method == "DELETE":
+            try:
+                children = [e for e in
+                            self.filer.list_directory(path, limit=2)
+                            if e.name != UPLOADS_DIR]
+                if children:
+                    return _error_xml("BucketNotEmpty",
+                                      f"{bucket} is not empty", 409)
+                self.filer.delete_entry(path, recursive=True)
+            except NotFoundError:
+                return _error_xml("NoSuchBucket", bucket, 404)
+            return Response(b"", 204)
+        if method == "GET":
+            self.filer.find_entry(path)  # 404 when missing
+            return self._list_objects(bucket, req)
+        if method == "POST" and "delete" in req.query:
+            return self._multi_delete(bucket, req)
+        raise RpcError(f"unsupported bucket op {method}", 405)
+
+    # -- object listing ------------------------------------------------------
+    def _walk(self, dir_path: str, rel_prefix: str = ""):
+        """Yield (key, entry) for all files under dir_path, sorted."""
+        for e in self.filer.list_directory(dir_path, limit=100000):
+            if e.name == UPLOADS_DIR:
+                continue
+            rel = rel_prefix + e.name
+            if e.is_directory:
+                yield from self._walk(e.full_path, rel + "/")
+            else:
+                yield rel, e
+
+    def _list_objects(self, bucket: str, req: Request):
+        prefix = req.param("prefix", "") or ""
+        delimiter = req.param("delimiter", "") or ""
+        max_keys = int(req.param("max-keys", "1000"))
+        v2 = req.param("list-type") == "2"
+        marker = (req.param("continuation-token")
+                  or req.param("start-after")
+                  or req.param("marker") or "")
+
+        contents, common = [], []
+        seen_prefixes = set()
+        truncated = False
+        last_emitted = ""
+        for key, entry in self._walk(self._bucket_path(bucket)):
+            if prefix and not key.startswith(prefix):
+                continue
+            if marker and key <= marker:
+                continue
+            if delimiter:
+                rest = key[len(prefix):]
+                if delimiter in rest:
+                    cp = prefix + rest.split(delimiter)[0] + delimiter
+                    if cp in seen_prefixes:
+                        continue
+                    if len(contents) + len(common) >= max_keys:
+                        truncated = True
+                        break
+                    seen_prefixes.add(cp)
+                    common.append(cp)
+                    last_emitted = cp
+                    continue
+            if len(contents) + len(common) >= max_keys:
+                truncated = True
+                break
+            contents.append((key, entry))
+            last_emitted = key
+
+        result = {
+            "Name": bucket,
+            "Prefix": prefix,
+            "MaxKeys": max_keys,
+            "IsTruncated": str(truncated).lower(),
+            "Contents": [
+                {"Key": k,
+                 "LastModified": _iso(e.attr.mtime),
+                 "ETag": f'"{e.attr.md5}"',
+                 "Size": e.size(),
+                 "StorageClass": "STANDARD"} for k, e in contents
+            ],
+            "CommonPrefixes": [{"Prefix": p} for p in common],
+        }
+        if v2:
+            # KeyCount counts keys + common prefixes (AWS semantics)
+            result["KeyCount"] = len(contents) + len(common)
+            if truncated and last_emitted:
+                result["NextContinuationToken"] = last_emitted
+        else:
+            result["Marker"] = marker
+        return Response(_xml("ListBucketResult", result), 200,
+                        "application/xml")
+
+    # -- objects -------------------------------------------------------------
+    def _object_path(self, bucket: str, key: str) -> str:
+        return f"{BUCKETS_ROOT}/{bucket}/{key}"
+
+    def _object_op(self, method: str, bucket: str, key: str, req: Request):
+        self.filer.find_entry(self._bucket_path(bucket))  # NoSuchBucket
+        if method == "PUT":
+            if "partNumber" in req.query and "uploadId" in req.query:
+                return self._upload_part(bucket, key, req)
+            if req.headers.get("X-Amz-Copy-Source"):
+                return self._copy_object(bucket, key, req)
+            if "tagging" in req.query:
+                return self._put_tagging(bucket, key, req)
+            return self._put_object(bucket, key, req)
+        if method == "POST":
+            if "uploads" in req.query:
+                return self._create_multipart(bucket, key, req)
+            if "uploadId" in req.query:
+                return self._complete_multipart(bucket, key, req)
+            raise RpcError("bad POST", 400)
+        if method in ("GET", "HEAD"):
+            if "uploadId" in req.query:
+                return self._list_parts(bucket, key, req)
+            if "tagging" in req.query:
+                return self._get_tagging(bucket, key)
+            return self._get_object(bucket, key, req, method)
+        if method == "DELETE":
+            if "uploadId" in req.query:
+                return self._abort_multipart(bucket, key, req)
+            if "tagging" in req.query:
+                return self._delete_tagging(bucket, key)
+            return self._delete_object(bucket, key)
+        raise RpcError(f"unsupported object op {method}", 405)
+
+    def _put_object(self, bucket: str, key: str, req: Request):
+        extended = {f"x-amz-meta-{k[11:].lower()}": v
+                    for k, v in req.headers.items()
+                    if k.lower().startswith("x-amz-meta-")}
+        entry = self.filer_server.save_bytes(
+            self._object_path(bucket, key), req.body,
+            mime=req.headers.get("Content-Type") or "",
+            extended=extended)
+        return Response(b"", 200, headers={"ETag": f'"{entry.attr.md5}"'})
+
+    def _get_object(self, bucket: str, key: str, req: Request, method: str):
+        entry = self.filer.find_entry(self._object_path(bucket, key))
+        if entry.is_directory:
+            raise NotFoundError(key)
+        size = entry.size()
+        start, length, status = 0, size, 200
+        headers = {"ETag": f'"{entry.attr.md5}"',
+                   "Last-Modified": _http_date(entry.attr.mtime),
+                   "Accept-Ranges": "bytes"}
+        for k, v in entry.extended.items():
+            if k.startswith("x-amz-meta-"):
+                headers[k] = v
+        range_header = req.headers.get("Range")
+        if range_header and range_header.startswith("bytes="):
+            lo_s, _, hi_s = range_header[6:].split(",")[0].partition("-")
+            lo = int(lo_s) if lo_s else None
+            hi = int(hi_s) if hi_s else None
+            if lo is None:
+                start = max(0, size - (hi or 0))
+                length = size - start
+            else:
+                start = lo
+                length = (min(hi, size - 1) - lo + 1) if hi is not None \
+                    else size - lo
+            if start >= size or length <= 0:
+                return _error_xml("InvalidRange", "range not satisfiable",
+                                  416)
+            status = 206
+            headers["Content-Range"] = \
+                f"bytes {start}-{start + length - 1}/{size}"
+        content_type = entry.attr.mime or "application/octet-stream"
+        if method == "HEAD":
+            headers["Content-Length"] = str(length)
+            return Response(b"", status, content_type, headers)
+        body = self.filer_server.read_bytes(entry, start, length)
+        return Response(body, status, content_type, headers)
+
+    def _delete_object(self, bucket: str, key: str):
+        try:
+            self.filer.delete_entry(self._object_path(bucket, key))
+        except NotFoundError:
+            pass  # S3 delete is idempotent
+        except ValueError as e:
+            return _error_xml("InvalidRequest", str(e), 400)
+        return Response(b"", 204)
+
+    def _copy_object(self, bucket: str, key: str, req: Request):
+        source = urllib.parse.unquote(
+            req.headers.get("X-Amz-Copy-Source", "")).lstrip("/")
+        src_bucket, _, src_key = source.partition("/")
+        src = self.filer.find_entry(self._object_path(src_bucket, src_key))
+        body = self.filer_server.read_bytes(src)
+        entry = self.filer_server.save_bytes(
+            self._object_path(bucket, key), body,
+            mime=src.attr.mime, extended=dict(src.extended))
+        return Response(_xml("CopyObjectResult", {
+            "ETag": f'"{entry.attr.md5}"',
+            "LastModified": _iso(entry.attr.mtime),
+        }), 200, "application/xml")
+
+    def _multi_delete(self, bucket: str, req: Request):
+        root = ET.fromstring(req.body)
+        ns = ""
+        if root.tag.startswith("{"):
+            ns = root.tag[:root.tag.index("}") + 1]
+        deleted, errors = [], []
+        for obj in root.findall(f"{ns}Object"):
+            key_el = obj.find(f"{ns}Key")
+            if key_el is None or not key_el.text:
+                continue
+            try:
+                self.filer.delete_entry(
+                    self._object_path(bucket, key_el.text))
+                deleted.append(key_el.text)
+            except NotFoundError:
+                deleted.append(key_el.text)  # S3: missing counts as deleted
+            except ValueError as e:
+                errors.append((key_el.text, str(e)))
+        return Response(_xml("DeleteResult", {
+            "Deleted": [{"Key": k} for k in deleted],
+            "Error": [{"Key": k, "Code": "InvalidRequest", "Message": m}
+                      for k, m in errors],
+        }), 200, "application/xml")
+
+    # -- tagging -------------------------------------------------------------
+    def _put_tagging(self, bucket: str, key: str, req: Request):
+        entry = self.filer.find_entry(self._object_path(bucket, key))
+        root = ET.fromstring(req.body)
+        ns = root.tag[:root.tag.index("}") + 1] if \
+            root.tag.startswith("{") else ""
+        tags = {}
+        for tag_el in root.iter(f"{ns}Tag"):
+            k = tag_el.find(f"{ns}Key")
+            v = tag_el.find(f"{ns}Value")
+            if k is not None and v is not None:
+                tags[k.text] = v.text or ""
+        entry.extended = {k: v for k, v in entry.extended.items()
+                          if not k.startswith("x-amz-tag-")}
+        for k, v in tags.items():
+            entry.extended[f"x-amz-tag-{k}"] = v
+        self.filer.update_entry(entry)
+        return Response(b"", 200)
+
+    def _get_tagging(self, bucket: str, key: str):
+        entry = self.filer.find_entry(self._object_path(bucket, key))
+        tags = [(k[len("x-amz-tag-"):], v)
+                for k, v in entry.extended.items()
+                if k.startswith("x-amz-tag-")]
+        return Response(_xml("Tagging", {
+            "TagSet": {"Tag": [{"Key": k, "Value": v} for k, v in tags]},
+        }), 200, "application/xml")
+
+    def _delete_tagging(self, bucket: str, key: str):
+        entry = self.filer.find_entry(self._object_path(bucket, key))
+        entry.extended = {k: v for k, v in entry.extended.items()
+                          if not k.startswith("x-amz-tag-")}
+        self.filer.update_entry(entry)
+        return Response(b"", 204)
+
+    # -- multipart (filer_multipart.go) --------------------------------------
+    def _upload_dir(self, bucket: str, upload_id: str) -> str:
+        return f"{BUCKETS_ROOT}/{bucket}/{UPLOADS_DIR}/{upload_id}"
+
+    def _create_multipart(self, bucket: str, key: str, req: Request):
+        upload_id = uuid.uuid4().hex
+        marker = new_directory_entry(self._upload_dir(bucket, upload_id))
+        marker.extended["key"] = key
+        marker.extended["mime"] = req.headers.get("Content-Type") or ""
+        self.filer.create_entry(marker)
+        return Response(_xml("InitiateMultipartUploadResult", {
+            "Bucket": bucket, "Key": key, "UploadId": upload_id,
+        }), 200, "application/xml")
+
+    def _upload_part(self, bucket: str, key: str, req: Request):
+        upload_id = req.param("uploadId")
+        part = int(req.param("partNumber"))
+        self.filer.find_entry(self._upload_dir(bucket, upload_id))
+        entry = self.filer_server.save_bytes(
+            f"{self._upload_dir(bucket, upload_id)}/{part:05d}.part",
+            req.body)
+        return Response(b"", 200,
+                        headers={"ETag": f'"{entry.attr.md5}"'})
+
+    def _complete_multipart(self, bucket: str, key: str, req: Request):
+        upload_id = req.param("uploadId")
+        upload_dir = self._upload_dir(bucket, upload_id)
+        marker = self.filer.find_entry(upload_dir)
+        staged = {int(e.name.split(".")[0]): e
+                  for e in self.filer.list_directory(upload_dir,
+                                                     limit=10001)
+                  if e.name.endswith(".part")}
+        requested = self._requested_part_numbers(req.body)
+        if requested is not None:
+            missing = [n for n in requested if n not in staged]
+            if missing:
+                return _error_xml("InvalidPart",
+                                  f"parts {missing} not uploaded", 400)
+            part_numbers = requested  # the client's list is authoritative
+        else:
+            part_numbers = sorted(staged)
+        parts = [staged[n] for n in part_numbers]
+        if not parts:
+            return _error_xml("InvalidPart", "no parts uploaded", 400)
+        final = Entry(full_path=self._object_path(bucket, key))
+        final.attr.mtime = final.attr.crtime = time.time()
+        final.attr.mime = marker.extended.get("mime", "")
+        offset = 0
+        md5s = b""
+        for p in parts:
+            md5s += bytes.fromhex(p.attr.md5)
+            if p.content:
+                # inlined small part: push it to a volume chunk so
+                # composition stays a pure chunk-list operation
+                source_chunks = self._force_chunk(p.content)
+            else:
+                source_chunks = p.chunks
+            for c in sorted(source_chunks, key=lambda c: c.offset):
+                final.chunks.append(FileChunk(
+                    fid=c.fid, offset=offset + c.offset, size=c.size,
+                    etag=c.etag, modified_ts_ns=time.time_ns()))
+            offset += p.size()
+        final.attr.file_size = offset
+        etag = f"{hashlib.md5(md5s).hexdigest()}-{len(parts)}"
+        final.attr.md5 = etag
+        self.filer.create_entry(final)
+        # drop the staging dir without reclaiming chunks now owned by the
+        # final entry
+        saved_hook = self.filer.on_delete_chunks
+        final_fids = {c.fid for c in final.chunks}
+        self.filer.on_delete_chunks = lambda chunks: saved_hook(
+            [c for c in chunks if c.fid not in final_fids])
+        try:
+            self.filer.delete_entry(upload_dir, recursive=True)
+        finally:
+            self.filer.on_delete_chunks = saved_hook
+        return Response(_xml("CompleteMultipartUploadResult", {
+            "Bucket": bucket, "Key": key, "ETag": f'"{etag}"',
+        }), 200, "application/xml")
+
+    @staticmethod
+    def _requested_part_numbers(body: bytes):
+        """Parse CompleteMultipartUpload XML -> ordered part numbers, or
+        None when the client sent no body (lenient mode)."""
+        if not body:
+            return None
+        try:
+            root = ET.fromstring(body)
+        except ET.ParseError:
+            return None
+        ns = root.tag[:root.tag.index("}") + 1] if \
+            root.tag.startswith("{") else ""
+        numbers = [int(el.text) for el in root.iter(f"{ns}PartNumber")
+                   if el.text]
+        return numbers or None
+
+    def _force_chunk(self, content: bytes) -> list[FileChunk]:
+        from ..rpc.http_rpc import call
+
+        assign = self.filer_server._assign()
+        up = call(assign["url"], f"/{assign['fid']}", raw=content,
+                  method="POST", timeout=60)
+        return [FileChunk(fid=assign["fid"], offset=0, size=len(content),
+                          etag=up.get("eTag", ""))]
+
+    def _abort_multipart(self, bucket: str, key: str, req: Request):
+        upload_id = req.param("uploadId")
+        try:
+            self.filer.delete_entry(self._upload_dir(bucket, upload_id),
+                                    recursive=True)
+        except NotFoundError:
+            return _error_xml("NoSuchUpload", upload_id, 404)
+        return Response(b"", 204)
+
+    def _list_parts(self, bucket: str, key: str, req: Request):
+        upload_id = req.param("uploadId")
+        upload_dir = self._upload_dir(bucket, upload_id)
+        self.filer.find_entry(upload_dir)
+        parts = [e for e in self.filer.list_directory(upload_dir,
+                                                      limit=10001)
+                 if e.name.endswith(".part")]
+        parts.sort(key=lambda e: int(e.name.split(".")[0]))
+        return Response(_xml("ListPartsResult", {
+            "Bucket": bucket, "Key": key, "UploadId": upload_id,
+            "Part": [
+                {"PartNumber": int(p.name.split(".")[0]),
+                 "ETag": f'"{p.attr.md5}"',
+                 "Size": p.size()} for p in parts
+            ],
+        }), 200, "application/xml")
+
+
+def _iso(ts: float) -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%S.000Z", time.gmtime(ts))
+
+
+def _http_date(ts: float) -> str:
+    return time.strftime("%a, %d %b %Y %H:%M:%S GMT", time.gmtime(ts))

@@ -1,0 +1,293 @@
+"""Filer: chunk model, store conformance, core CRUD, HTTP server e2e."""
+
+import json
+import time
+
+import pytest
+
+from seaweedfs_tpu.filer.entry import (Attr, Entry, FileChunk,
+                                       new_directory_entry, total_size)
+from seaweedfs_tpu.filer.filechunks import (etag_of_chunks,
+                                            non_overlapping_visible_intervals,
+                                            read_chunk_views)
+from seaweedfs_tpu.filer.filer import Filer
+from seaweedfs_tpu.filer.filer_store import (MemoryStore, NotFoundError,
+                                             SqliteStore)
+
+
+def chunk(fid, offset, size, ts=0):
+    return FileChunk(fid=fid, offset=offset, size=size, modified_ts_ns=ts)
+
+
+class TestChunkModel:
+    def test_non_overlapping(self):
+        chunks = [chunk("a", 0, 100, 1), chunk("b", 100, 100, 2)]
+        vis = non_overlapping_visible_intervals(chunks)
+        assert [(v.start, v.stop, v.fid) for v in vis] == [
+            (0, 100, "a"), (100, 200, "b")]
+
+    def test_full_overwrite(self):
+        chunks = [chunk("a", 0, 100, 1), chunk("b", 0, 100, 2)]
+        vis = non_overlapping_visible_intervals(chunks)
+        assert [(v.start, v.stop, v.fid) for v in vis] == [(0, 100, "b")]
+
+    def test_partial_overwrite_middle(self):
+        # later chunk punches a hole in the middle of an earlier one
+        chunks = [chunk("a", 0, 300, 1), chunk("b", 100, 100, 2)]
+        vis = non_overlapping_visible_intervals(chunks)
+        assert [(v.start, v.stop, v.fid, v.chunk_offset) for v in vis] == [
+            (0, 100, "a", 0), (100, 200, "b", 0), (200, 300, "a", 200)]
+
+    def test_read_views_range(self):
+        chunks = [chunk("a", 0, 100, 1), chunk("b", 100, 100, 2)]
+        views = read_chunk_views(chunks, 50, 100)
+        assert [(v.fid, v.offset_in_chunk, v.size) for v in views] == [
+            ("a", 50, 50), ("b", 0, 50)]
+
+    def test_total_size(self):
+        assert total_size([chunk("a", 0, 10), chunk("b", 100, 5)]) == 105
+
+    def test_etag_single_vs_multi(self):
+        c1 = FileChunk(fid="a", offset=0, size=5, etag="aabb")
+        assert etag_of_chunks([c1]) == "aabb"
+        c2 = FileChunk(fid="b", offset=5, size=5, etag="ccdd")
+        multi = etag_of_chunks([c1, c2])
+        assert multi.endswith("-2")
+
+
+@pytest.mark.parametrize("store_factory", [
+    lambda tmp: MemoryStore(),
+    lambda tmp: SqliteStore(str(tmp / "meta.db")),
+], ids=["memory", "sqlite"])
+class TestStoreConformance:
+    """Shared store harness (the filer/store_test analogue)."""
+
+    def test_insert_find_delete(self, store_factory, tmp_path):
+        store = store_factory(tmp_path)
+        e = Entry(full_path="/dir/file.txt",
+                  attr=Attr(mtime=1.0, file_size=10))
+        store.insert_entry(e)
+        found = store.find_entry("/dir/file.txt")
+        assert found.full_path == "/dir/file.txt"
+        assert found.attr.file_size == 10
+        store.delete_entry("/dir/file.txt")
+        with pytest.raises(NotFoundError):
+            store.find_entry("/dir/file.txt")
+
+    def test_list_directory_pagination(self, store_factory, tmp_path):
+        store = store_factory(tmp_path)
+        for i in range(10):
+            store.insert_entry(Entry(full_path=f"/d/f{i:02d}"))
+        page1 = store.list_directory("/d", limit=4)
+        assert [e.name for e in page1] == ["f00", "f01", "f02", "f03"]
+        page2 = store.list_directory("/d", start_file="f03", limit=4)
+        assert [e.name for e in page2] == ["f04", "f05", "f06", "f07"]
+
+    def test_list_prefix(self, store_factory, tmp_path):
+        store = store_factory(tmp_path)
+        for name in ("apple", "banana", "apricot"):
+            store.insert_entry(Entry(full_path=f"/d/{name}"))
+        got = store.list_directory("/d", prefix="ap")
+        assert [e.name for e in got] == ["apple", "apricot"]
+
+    def test_delete_folder_children(self, store_factory, tmp_path):
+        store = store_factory(tmp_path)
+        store.insert_entry(Entry(full_path="/a/b/c"))
+        store.insert_entry(Entry(full_path="/a/b/d/e"))
+        store.insert_entry(Entry(full_path="/ab/keep"))
+        store.delete_folder_children("/a/b")
+        assert store.list_directory("/a/b") == []
+        assert len(store.list_directory("/ab")) == 1
+
+    def test_chunks_roundtrip(self, store_factory, tmp_path):
+        store = store_factory(tmp_path)
+        e = Entry(full_path="/f",
+                  chunks=[FileChunk(fid="3,ab12", offset=0, size=100,
+                                    etag="ee")])
+        store.insert_entry(e)
+        found = store.find_entry("/f")
+        assert found.chunks[0].fid == "3,ab12"
+        assert found.chunks[0].size == 100
+
+
+class TestFilerCore:
+    def test_parent_dirs_auto_created(self):
+        f = Filer()
+        f.create_entry(Entry(full_path="/a/b/c/file"))
+        assert f.find_entry("/a/b/c").is_directory
+        assert f.find_entry("/a").is_directory
+
+    def test_delete_directory_requires_recursive(self):
+        f = Filer()
+        f.create_entry(Entry(full_path="/d/x"))
+        with pytest.raises(ValueError):
+            f.delete_entry("/d")
+        f.delete_entry("/d", recursive=True)
+        with pytest.raises(NotFoundError):
+            f.find_entry("/d")
+
+    def test_delete_reclaims_chunks(self):
+        f = Filer()
+        reclaimed = []
+        f.on_delete_chunks = reclaimed.extend
+        f.create_entry(Entry(full_path="/f", chunks=[
+            FileChunk(fid="1,aa", offset=0, size=5)]))
+        f.delete_entry("/f")
+        assert [c.fid for c in reclaimed] == ["1,aa"]
+
+    def test_overwrite_reclaims_orphaned_chunks(self):
+        f = Filer()
+        reclaimed = []
+        f.on_delete_chunks = reclaimed.extend
+        f.create_entry(Entry(full_path="/f", chunks=[
+            FileChunk(fid="1,aa", offset=0, size=5)]))
+        f.create_entry(Entry(full_path="/f", chunks=[
+            FileChunk(fid="1,bb", offset=0, size=6)]))
+        assert [c.fid for c in reclaimed] == ["1,aa"]
+
+    def test_rename_file_and_dir(self):
+        f = Filer()
+        f.create_entry(Entry(full_path="/old/f1"))
+        f.create_entry(Entry(full_path="/old/sub/f2"))
+        f.rename("/old", "/new")
+        assert f.find_entry("/new/f1")
+        assert f.find_entry("/new/sub/f2")
+        with pytest.raises(NotFoundError):
+            f.find_entry("/old/f1")
+
+    def test_metadata_log(self):
+        f = Filer()
+        t0 = time.time_ns()
+        f.create_entry(Entry(full_path="/x/y"))
+        f.delete_entry("/x/y")
+        events = f.subscribe_metadata(since_ns=t0)
+        # mkdir /x + create /x/y + delete /x/y
+        assert len(events) == 3
+        assert events[-1]["old_entry"] is not None
+        assert events[-1]["new_entry"] is None
+        scoped = f.subscribe_metadata(since_ns=t0, path_prefix="/other")
+        assert scoped == []
+
+    def test_file_over_directory_rejected(self):
+        f = Filer()
+        f.create_entry(Entry(full_path="/d/child"))
+        with pytest.raises(ValueError):
+            f.create_entry(Entry(full_path="/d", attr=Attr(file_size=3)))
+
+
+class TestFilerServerE2E:
+    @pytest.fixture
+    def stack(self, tmp_path):
+        from seaweedfs_tpu.filer.server import FilerServer
+        from seaweedfs_tpu.master.server import MasterServer
+        from seaweedfs_tpu.volume_server.server import VolumeServer
+
+        master = MasterServer(port=0, pulse_seconds=0.2)
+        master.start()
+        vols = []
+        for i in range(2):
+            d = tmp_path / f"vs{i}"
+            d.mkdir()
+            vs = VolumeServer([str(d)], master.address, port=0,
+                              pulse_seconds=0.2)
+            vs.start()
+            vs.heartbeat_once()
+            vols.append(vs)
+        filer = FilerServer(master.address, port=0,
+                            chunk_size=1024)  # tiny chunks to force chunking
+        filer.start()
+        yield master, vols, filer
+        filer.stop()
+        for vs in vols:
+            vs.stop()
+        master.stop()
+
+    def test_write_read_roundtrip_chunked(self, stack):
+        from seaweedfs_tpu.rpc.http_rpc import RpcError, call
+
+        master, vols, filer = stack
+        payload = bytes(range(256)) * 20  # 5120 bytes -> 5 chunks of 1024
+        resp = call(filer.address, "/docs/data.bin", raw=payload,
+                    method="POST",
+                    headers={"Content-Type": "application/x-binary"})
+        assert resp["size"] == len(payload)
+        got = call(filer.address, "/docs/data.bin")
+        assert got == payload
+
+    def test_small_file_inlined(self, stack):
+        from seaweedfs_tpu.rpc.http_rpc import call
+
+        master, vols, filer = stack
+        call(filer.address, "/small.txt", raw=b"tiny", method="POST")
+        entry = filer.filer.find_entry("/small.txt")
+        assert entry.content == b"tiny"
+        assert entry.chunks == []
+        assert call(filer.address, "/small.txt") == b"tiny"
+
+    def test_range_read(self, stack):
+        from seaweedfs_tpu.rpc.http_rpc import call
+        import urllib.request
+
+        master, vols, filer = stack
+        payload = bytes(range(256)) * 20
+        call(filer.address, "/r.bin", raw=payload, method="POST")
+        req = urllib.request.Request(
+            f"http://{filer.address}/r.bin",
+            headers={"Range": "bytes=1000-2999"})
+        with urllib.request.urlopen(req) as resp:
+            assert resp.status == 206
+            body = resp.read()
+        assert body == payload[1000:3000]
+
+    def test_directory_listing(self, stack):
+        from seaweedfs_tpu.rpc.http_rpc import call
+
+        master, vols, filer = stack
+        for name in ("a.txt", "b.txt", "c.txt"):
+            call(filer.address, f"/dir/{name}", raw=b"x", method="POST")
+        listing = call(filer.address, "/dir")
+        names = [e["FullPath"] for e in listing["Entries"]]
+        assert names == ["/dir/a.txt", "/dir/b.txt", "/dir/c.txt"]
+
+    def test_delete_and_chunk_reclaim(self, stack):
+        from seaweedfs_tpu.rpc.http_rpc import RpcError, call
+
+        master, vols, filer = stack
+        payload = b"z" * 3000
+        call(filer.address, "/del.bin", raw=payload, method="POST")
+        entry = filer.filer.find_entry("/del.bin")
+        fids = [c.fid for c in entry.chunks]
+        assert fids
+        call(filer.address, "/del.bin", method="DELETE")
+        with pytest.raises(RpcError):
+            call(filer.address, "/del.bin")
+        # chunks physically deleted from volume servers
+        for fid in fids:
+            url = call(master.address,
+                       f"/dir/lookup?volumeId={fid.split(',')[0]}"
+                       )["locations"][0]["url"]
+            with pytest.raises(RpcError):
+                call(url, f"/{fid}")
+
+    def test_rename_via_mv_from(self, stack):
+        from seaweedfs_tpu.rpc.http_rpc import RpcError, call
+
+        master, vols, filer = stack
+        call(filer.address, "/src.txt", raw=b"move me", method="POST")
+        call(filer.address, "/dst.txt?mv.from=/src.txt", method="POST",
+             raw=b"")
+        assert call(filer.address, "/dst.txt") == b"move me"
+        with pytest.raises(RpcError):
+            call(filer.address, "/src.txt")
+
+    def test_metadata_subscribe(self, stack):
+        from seaweedfs_tpu.rpc.http_rpc import call
+
+        master, vols, filer = stack
+        since = time.time_ns()
+        call(filer.address, "/sub/f.txt", raw=b"x", method="POST")
+        events = call(filer.address,
+                      f"/metadata/subscribe?since={since}")["events"]
+        assert any(e["new_entry"]
+                   and e["new_entry"]["full_path"] == "/sub/f.txt"
+                   for e in events)

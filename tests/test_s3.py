@@ -1,0 +1,398 @@
+"""S3 gateway: bucket/object CRUD, listing, multipart, tagging, sigv4 auth.
+
+The protocol analogue of the reference's test/s3/basic + multipart suites
+and the sigv4 vectors in s3api/auto_signature_v4_test.go — driven with a
+minimal in-test sigv4 client (stdlib only; no boto in the image)."""
+
+import hashlib
+import hmac
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+import xml.etree.ElementTree as ET
+
+import pytest
+
+from seaweedfs_tpu.filer.server import FilerServer
+from seaweedfs_tpu.master.server import MasterServer
+from seaweedfs_tpu.s3api.auth import (ACTION_READ, AuthError, Identity,
+                                      IdentityAccessManagement)
+from seaweedfs_tpu.s3api.server import S3ApiServer
+from seaweedfs_tpu.volume_server.server import VolumeServer
+
+NS = "{http://s3.amazonaws.com/doc/2006-03-01/}"
+
+
+# --------------------------------------------------------------------------
+# minimal sigv4 client
+# --------------------------------------------------------------------------
+
+
+def _sign(key, msg):
+    return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+
+def sigv4_request(address, method, path, query="", body=b"",
+                  access_key=None, secret_key=None, headers=None,
+                  region="us-east-1"):
+    headers = dict(headers or {})
+    url = f"http://{address}{urllib.parse.quote(path)}"
+    if query:
+        url += f"?{query}"
+    if access_key:
+        now = time.gmtime()
+        amz_date = time.strftime("%Y%m%dT%H%M%SZ", now)
+        datestamp = time.strftime("%Y%m%d", now)
+        payload_hash = hashlib.sha256(body).hexdigest()
+        headers["X-Amz-Date"] = amz_date
+        headers["X-Amz-Content-Sha256"] = payload_hash
+        headers["Host"] = address
+        signed = sorted(["host", "x-amz-date", "x-amz-content-sha256"])
+        q_pairs = sorted(
+            (urllib.parse.quote(k, safe="~"),
+             urllib.parse.quote(v, safe="~"))
+            for k, v in urllib.parse.parse_qsl(query, keep_blank_values=True))
+        canonical_query = "&".join(f"{k}={v}" for k, v in q_pairs)
+        lower = {k.lower(): v for k, v in headers.items()}
+        canonical = "\n".join([
+            method, urllib.parse.quote(path, safe="/~"), canonical_query,
+            "".join(f"{h}:{' '.join(lower[h].split())}\n" for h in signed),
+            ";".join(signed), payload_hash])
+        scope = f"{datestamp}/{region}/s3/aws4_request"
+        sts = "\n".join(["AWS4-HMAC-SHA256", amz_date, scope,
+                         hashlib.sha256(canonical.encode()).hexdigest()])
+        k = _sign(_sign(_sign(_sign(("AWS4" + secret_key).encode(),
+                                    datestamp), region), "s3"),
+                  "aws4_request")
+        sig = hmac.new(k, sts.encode(), hashlib.sha256).hexdigest()
+        headers["Authorization"] = (
+            f"AWS4-HMAC-SHA256 Credential={access_key}/{scope}, "
+            f"SignedHeaders={';'.join(signed)}, Signature={sig}")
+    req = urllib.request.Request(url, data=body if method not in
+                                 ("GET", "HEAD", "DELETE") else body or None,
+                                 method=method, headers=headers)
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.status, dict(resp.headers), resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read()
+
+
+@pytest.fixture
+def stack(tmp_path):
+    master = MasterServer(port=0, pulse_seconds=0.2)
+    master.start()
+    vols = []
+    for i in range(2):
+        d = tmp_path / f"vs{i}"
+        d.mkdir()
+        vs = VolumeServer([str(d)], master.address, port=0,
+                          pulse_seconds=0.2)
+        vs.start()
+        vs.heartbeat_once()
+        vols.append(vs)
+    filer = FilerServer(master.address, port=0, chunk_size=1024)
+    filer.start()
+    s3 = S3ApiServer(filer, port=0)
+    s3.start()
+    yield s3
+    s3.stop()
+    filer.stop()
+    for vs in vols:
+        vs.stop()
+    master.stop()
+
+
+def req(s3, method, path, query="", body=b"", headers=None):
+    return sigv4_request(s3.address, method, path, query, body,
+                         headers=headers)
+
+
+class TestBuckets:
+    def test_create_list_delete(self, stack):
+        s3 = stack
+        assert req(s3, "PUT", "/b1")[0] == 200
+        assert req(s3, "PUT", "/b2")[0] == 200
+        status, _, body = req(s3, "GET", "/")
+        assert status == 200
+        names = [el.text for el in
+                 ET.fromstring(body).iter(f"{NS}Name")]
+        assert names == ["b1", "b2"]
+        assert req(s3, "DELETE", "/b2")[0] == 204
+        status, _, body = req(s3, "GET", "/")
+        assert "b2" not in body.decode()
+
+    def test_delete_nonempty_bucket_rejected(self, stack):
+        s3 = stack
+        req(s3, "PUT", "/b")
+        req(s3, "PUT", "/b/k", body=b"x")
+        status, _, body = req(s3, "DELETE", "/b")
+        assert status == 409
+        assert b"BucketNotEmpty" in body
+
+    def test_head_missing_bucket(self, stack):
+        assert req(stack, "HEAD", "/ghost")[0] == 404
+
+
+class TestObjects:
+    def test_put_get_roundtrip(self, stack):
+        s3 = stack
+        req(s3, "PUT", "/b")
+        payload = bytes(range(256)) * 30  # multi-chunk via 1KB chunks
+        status, headers, _ = req(s3, "PUT", "/b/dir/obj.bin", body=payload,
+                                 headers={"Content-Type": "application/foo"})
+        assert status == 200
+        expect_etag = f'"{hashlib.md5(payload).hexdigest()}"'
+        assert headers["ETag"] == expect_etag
+        status, headers, body = req(s3, "GET", "/b/dir/obj.bin")
+        assert status == 200
+        assert body == payload
+        assert headers["ETag"] == expect_etag
+        assert headers["Content-Type"] == "application/foo"
+
+    def test_head_and_range(self, stack):
+        s3 = stack
+        req(s3, "PUT", "/b")
+        payload = b"0123456789" * 500
+        req(s3, "PUT", "/b/r", body=payload)
+        status, headers, body = req(s3, "HEAD", "/b/r")
+        assert status == 200 and headers["Content-Length"] == "5000"
+        status, headers, body = req(s3, "GET", "/b/r",
+                                    headers={"Range": "bytes=10-19"})
+        assert status == 206
+        assert body == payload[10:20]
+        assert headers["Content-Range"] == "bytes 10-19/5000"
+
+    def test_delete_idempotent(self, stack):
+        s3 = stack
+        req(s3, "PUT", "/b")
+        req(s3, "PUT", "/b/k", body=b"x")
+        assert req(s3, "DELETE", "/b/k")[0] == 204
+        assert req(s3, "GET", "/b/k")[0] == 404
+        assert req(s3, "DELETE", "/b/k")[0] == 204  # no error on repeat
+
+    def test_copy_object(self, stack):
+        s3 = stack
+        req(s3, "PUT", "/b")
+        req(s3, "PUT", "/b/src", body=b"copy payload",
+            headers={"Content-Type": "text/x-src"})
+        status, _, body = req(s3, "PUT", "/b/dst",
+                              headers={"X-Amz-Copy-Source": "/b/src"})
+        assert status == 200 and b"CopyObjectResult" in body
+        status, headers, body = req(s3, "GET", "/b/dst")
+        assert body == b"copy payload"
+        assert headers["Content-Type"] == "text/x-src"
+
+    def test_user_metadata(self, stack):
+        s3 = stack
+        req(s3, "PUT", "/b")
+        req(s3, "PUT", "/b/m", body=b"x",
+            headers={"X-Amz-Meta-Color": "green"})
+        _, headers, _ = req(s3, "GET", "/b/m")
+        assert headers.get("x-amz-meta-color") == "green"
+
+    def test_multi_delete(self, stack):
+        s3 = stack
+        req(s3, "PUT", "/b")
+        for k in ("a", "b", "c"):
+            req(s3, "PUT", f"/b/{k}", body=b"x")
+        delete_xml = (b"<Delete><Object><Key>a</Key></Object>"
+                      b"<Object><Key>c</Key></Object></Delete>")
+        status, _, body = req(s3, "POST", "/b", query="delete=",
+                              body=delete_xml)
+        assert status == 200
+        assert req(s3, "GET", "/b/a")[0] == 404
+        assert req(s3, "GET", "/b/b")[0] == 200
+        assert req(s3, "GET", "/b/c")[0] == 404
+
+
+class TestListing:
+    def _fill(self, s3):
+        req(s3, "PUT", "/b")
+        for key in ("a.txt", "dir/one.txt", "dir/two.txt",
+                    "dir/sub/deep.txt", "z.txt"):
+            req(s3, "PUT", f"/b/{key}", body=b"x")
+
+    def test_list_v2_all(self, stack):
+        s3 = stack
+        self._fill(s3)
+        status, _, body = req(s3, "GET", "/b", query="list-type=2")
+        keys = [el.text for el in ET.fromstring(body).iter(f"{NS}Key")]
+        assert keys == ["a.txt", "dir/one.txt", "dir/sub/deep.txt",
+                        "dir/two.txt", "z.txt"]
+
+    def test_list_prefix(self, stack):
+        s3 = stack
+        self._fill(s3)
+        _, _, body = req(s3, "GET", "/b", query="list-type=2&prefix=dir/")
+        keys = [el.text for el in ET.fromstring(body).iter(f"{NS}Key")]
+        assert keys == ["dir/one.txt", "dir/sub/deep.txt", "dir/two.txt"]
+
+    def test_list_delimiter_common_prefixes(self, stack):
+        s3 = stack
+        self._fill(s3)
+        _, _, body = req(s3, "GET", "/b", query="list-type=2&delimiter=/")
+        root = ET.fromstring(body)
+        keys = [el.text for el in root.iter(f"{NS}Key")]
+        prefixes = [el.text for el in root.iter(f"{NS}Prefix")
+                    if el.text and el.text.endswith("/")]
+        assert keys == ["a.txt", "z.txt"]
+        assert prefixes == ["dir/"]
+
+    def test_list_max_keys_truncation(self, stack):
+        s3 = stack
+        self._fill(s3)
+        _, _, body = req(s3, "GET", "/b", query="list-type=2&max-keys=2")
+        root = ET.fromstring(body)
+        assert root.find(f"{NS}IsTruncated").text == "true"
+        keys = [el.text for el in root.iter(f"{NS}Key")]
+        assert len(keys) == 2
+
+
+class TestMultipart:
+    def test_full_flow(self, stack):
+        s3 = stack
+        req(s3, "PUT", "/b")
+        status, _, body = req(s3, "POST", "/b/big.bin", query="uploads=")
+        upload_id = ET.fromstring(body).find(f"{NS}UploadId").text
+        part1 = b"A" * 5000
+        part2 = b"B" * 3000
+        for num, part in ((1, part1), (2, part2)):
+            status, headers, _ = req(
+                s3, "PUT", "/b/big.bin",
+                query=f"partNumber={num}&uploadId={upload_id}", body=part)
+            assert status == 200
+
+        _, _, body = req(s3, "GET", "/b/big.bin",
+                         query=f"uploadId={upload_id}")
+        assert len(ET.fromstring(body).findall(f"{NS}Part")) == 2
+
+        status, _, body = req(s3, "POST", "/b/big.bin",
+                              query=f"uploadId={upload_id}")
+        assert status == 200
+        etag = ET.fromstring(body).find(f"{NS}ETag").text
+        assert etag.endswith('-2"')
+
+        status, headers, body = req(s3, "GET", "/b/big.bin")
+        assert status == 200
+        assert body == part1 + part2
+
+    def test_abort(self, stack):
+        s3 = stack
+        req(s3, "PUT", "/b")
+        _, _, body = req(s3, "POST", "/b/k", query="uploads=")
+        upload_id = ET.fromstring(body).find(f"{NS}UploadId").text
+        req(s3, "PUT", "/b/k", query=f"partNumber=1&uploadId={upload_id}",
+            body=b"part")
+        assert req(s3, "DELETE", "/b/k",
+                   query=f"uploadId={upload_id}")[0] == 204
+        assert req(s3, "GET", "/b/k",
+                   query=f"uploadId={upload_id}")[0] == 404
+
+
+class TestTagging:
+    def test_put_get_delete(self, stack):
+        s3 = stack
+        req(s3, "PUT", "/b")
+        req(s3, "PUT", "/b/t", body=b"x")
+        tag_xml = (b"<Tagging><TagSet><Tag><Key>env</Key>"
+                   b"<Value>prod</Value></Tag></TagSet></Tagging>")
+        assert req(s3, "PUT", "/b/t", query="tagging=",
+                   body=tag_xml)[0] == 200
+        _, _, body = req(s3, "GET", "/b/t", query="tagging=")
+        root = ET.fromstring(body)
+        assert root.find(f"{NS}TagSet/{NS}Tag/{NS}Key").text == "env"
+        assert root.find(f"{NS}TagSet/{NS}Tag/{NS}Value").text == "prod"
+        assert req(s3, "DELETE", "/b/t", query="tagging=")[0] == 204
+        _, _, body = req(s3, "GET", "/b/t", query="tagging=")
+        assert ET.fromstring(body).find(f"{NS}TagSet/{NS}Tag") is None
+
+
+class TestSigV4:
+    @pytest.fixture
+    def auth_stack(self, tmp_path):
+        master = MasterServer(port=0, pulse_seconds=0.2)
+        master.start()
+        d = tmp_path / "v"
+        d.mkdir()
+        vs = VolumeServer([str(d)], master.address, port=0,
+                          pulse_seconds=0.2)
+        vs.start()
+        vs.heartbeat_once()
+        filer = FilerServer(master.address, port=0)
+        filer.start()
+        s3 = S3ApiServer(filer, port=0, identities=[
+            Identity(name="admin", access_key="AKID", secret_key="SK"),
+            Identity(name="reader", access_key="AKR", secret_key="SKR",
+                     actions=[ACTION_READ]),
+        ])
+        s3.start()
+        yield s3
+        s3.stop()
+        filer.stop()
+        vs.stop()
+        master.stop()
+
+    def test_signed_request_accepted(self, auth_stack):
+        s3 = auth_stack
+        status, _, _ = sigv4_request(s3.address, "PUT", "/b",
+                                     access_key="AKID", secret_key="SK")
+        assert status == 200
+        status, _, _ = sigv4_request(s3.address, "PUT", "/b/k",
+                                     body=b"payload", access_key="AKID",
+                                     secret_key="SK")
+        assert status == 200
+        status, _, body = sigv4_request(s3.address, "GET", "/b/k",
+                                        access_key="AKID", secret_key="SK")
+        assert status == 200 and body == b"payload"
+
+    def test_anonymous_rejected(self, auth_stack):
+        status, _, body = sigv4_request(auth_stack.address, "GET", "/b/k")
+        assert status == 403
+        assert b"AccessDenied" in body
+
+    def test_bad_secret_rejected(self, auth_stack):
+        status, _, body = sigv4_request(
+            auth_stack.address, "GET", "/b/k",
+            access_key="AKID", secret_key="WRONG")
+        assert status == 403
+        assert b"SignatureDoesNotMatch" in body
+
+    def test_unknown_access_key(self, auth_stack):
+        status, _, body = sigv4_request(
+            auth_stack.address, "GET", "/b/k",
+            access_key="NOBODY", secret_key="X")
+        assert status == 403
+        assert b"InvalidAccessKeyId" in body
+
+    def test_action_scoping(self, auth_stack):
+        s3 = auth_stack
+        sigv4_request(s3.address, "PUT", "/b", access_key="AKID",
+                      secret_key="SK")
+        sigv4_request(s3.address, "PUT", "/b/k", body=b"data",
+                      access_key="AKID", secret_key="SK")
+        # reader cannot write...
+        status, _, body = sigv4_request(s3.address, "PUT", "/b/nope",
+                                        body=b"x", access_key="AKR",
+                                        secret_key="SKR")
+        assert status == 403
+        # ...but can read
+        status, _, body = sigv4_request(s3.address, "GET", "/b/k",
+                                        access_key="AKR", secret_key="SKR")
+        assert status == 200 and body == b"data"
+
+
+class TestSigV4Vectors:
+    def test_signature_derivation_known_vector(self):
+        """AWS's documented example signing key derivation."""
+        iam = IdentityAccessManagement()
+        sig = iam._signature(
+            "wJalrXUtnFEMI/K7MDENG+bPxRfiCYEXAMPLEKEY",
+            "20150830", "us-east-1", "iam",
+            "AWS4-HMAC-SHA256\n20150830T123600Z\n"
+            "20150830/us-east-1/iam/aws4_request\n"
+            "f536975d06c0309214f805bb90ccff089219ecd68b2"
+            "577efef23edd43b7e1a59")
+        assert sig == ("5d672d79c15b13162d9279b0855cfba"
+                       "6789a8edb4c82c400e06b5924a6f2b5d7")

@@ -1,0 +1,311 @@
+#!/usr/bin/env python3
+"""weed — CLI entrypoint for the TPU-native SeaweedFS-capability store.
+
+Subcommand surface modelled on the reference's weed/command registry
+(weed/weed.go:37-84, command/command.go): master, volume, filer, s3,
+server (combined), shell, benchmark, upload, download, version.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from seaweedfs_tpu.rpc.http_rpc import RpcError, call  # noqa: E402
+
+VERSION = "seaweedfs_tpu 0.1 (RS(10,4) EC on TPU via JAX/Pallas)"
+
+
+def _wait_forever(stoppables):
+    stop = lambda *a: (_stop_all(stoppables), sys.exit(0))
+    signal.signal(signal.SIGINT, stop)
+    signal.signal(signal.SIGTERM, stop)
+    signal.pause()
+
+
+def _stop_all(stoppables):
+    for s in reversed(stoppables):
+        try:
+            s.stop()
+        except Exception:
+            pass
+
+
+def cmd_master(args):
+    from seaweedfs_tpu.master.server import MasterServer
+
+    m = MasterServer(host=args.ip, port=args.port,
+                     volume_size_limit_mb=args.volumeSizeLimitMB,
+                     default_replication=args.defaultReplication,
+                     pulse_seconds=args.pulseSeconds)
+    m.start()
+    print(f"master listening on {m.address}")
+    _wait_forever([m])
+
+
+def cmd_volume(args):
+    from seaweedfs_tpu.volume_server.server import VolumeServer
+
+    dirs = args.dir.split(",")
+    maxes = [int(x) for x in args.max.split(",")] if args.max else None
+    if maxes and len(maxes) == 1:
+        maxes = maxes * len(dirs)
+    vs = VolumeServer(dirs, args.mserver, host=args.ip, port=args.port,
+                      rack=args.rack, data_center=args.dataCenter,
+                      max_volume_counts=maxes,
+                      pulse_seconds=args.pulseSeconds)
+    vs.start()
+    print(f"volume server listening on {vs.address}, dirs={dirs}")
+    _wait_forever([vs])
+
+
+def cmd_filer(args):
+    from seaweedfs_tpu.filer.filer_store import SqliteStore
+    from seaweedfs_tpu.filer.server import FilerServer
+
+    store = SqliteStore(args.db) if args.db else None
+    f = FilerServer(args.master, host=args.ip, port=args.port, store=store,
+                    chunk_size=args.maxMB * 1024 * 1024,
+                    replication=args.replication,
+                    collection=args.collection)
+    f.start()
+    print(f"filer listening on {f.address}")
+    _wait_forever([f])
+
+
+def _load_identities(path):
+    from seaweedfs_tpu.s3api.auth import Identity
+
+    if not path:
+        return None
+    with open(path) as f:
+        config = json.load(f)
+    return [Identity(name=i["name"], access_key=i["access_key"],
+                     secret_key=i["secret_key"],
+                     actions=i.get("actions", ["Admin"]))
+            for i in config.get("identities", [])]
+
+
+def cmd_s3(args):
+    from seaweedfs_tpu.filer.filer_store import SqliteStore
+    from seaweedfs_tpu.filer.server import FilerServer
+    from seaweedfs_tpu.s3api.server import S3ApiServer
+
+    store = SqliteStore(args.db) if args.db else None
+    filer = FilerServer(args.master, port=0, store=store)
+    filer.start()
+    s3 = S3ApiServer(filer, host=args.ip, port=args.port,
+                     identities=_load_identities(args.config))
+    s3.start()
+    print(f"s3 gateway on {s3.address} (filer {filer.address})")
+    _wait_forever([s3, filer])
+
+
+def cmd_server(args):
+    """Combined master + volume + filer (+ s3) in one process
+    (weed/command/server.go)."""
+    from seaweedfs_tpu.filer.filer_store import SqliteStore
+    from seaweedfs_tpu.filer.server import FilerServer
+    from seaweedfs_tpu.master.server import MasterServer
+    from seaweedfs_tpu.s3api.server import S3ApiServer
+    from seaweedfs_tpu.volume_server.server import VolumeServer
+
+    stoppables = []
+    master = MasterServer(host=args.ip, port=args.masterPort,
+                          volume_size_limit_mb=args.volumeSizeLimitMB,
+                          pulse_seconds=args.pulseSeconds)
+    master.start()
+    stoppables.append(master)
+    print(f"master on {master.address}")
+
+    dirs = args.dir.split(",")
+    vs = VolumeServer(dirs, master.address, host=args.ip,
+                      port=args.volumePort, rack=args.rack,
+                      pulse_seconds=args.pulseSeconds)
+    vs.start()
+    vs.heartbeat_once()
+    stoppables.append(vs)
+    print(f"volume server on {vs.address}")
+
+    if args.filer or args.s3:
+        store = SqliteStore(args.db) if args.db else None
+        filer = FilerServer(master.address, host=args.ip,
+                            port=args.filerPort, store=store)
+        filer.start()
+        stoppables.append(filer)
+        print(f"filer on {filer.address}")
+        if args.s3:
+            s3 = S3ApiServer(filer, host=args.ip, port=args.s3Port,
+                             identities=_load_identities(args.config))
+            s3.start()
+            stoppables.append(s3)
+            print(f"s3 gateway on {s3.address}")
+    _wait_forever(stoppables)
+
+
+def cmd_shell(args):
+    from seaweedfs_tpu.shell import commands as sh
+
+    env = sh.CommandEnv(args.master)
+    print(f"connected to master {args.master}; .help for commands")
+    handlers = {
+        "volume.list": lambda a: print(json.dumps(sh.volume_list(env),
+                                                  indent=2)),
+        "volume.vacuum": lambda a: print(sh.volume_vacuum(
+            env, float(a[0]) if a else None)),
+        "ec.encode": lambda a: print(sh.ec_encode(
+            env, int(a[0]), plan_only="-plan" in a)),
+        "ec.decode": lambda a: print(sh.ec_decode(
+            env, int(a[0]), plan_only="-plan" in a)),
+        "ec.rebuild": lambda a: print(sh.ec_rebuild(
+            env, int(a[0]), plan_only="-plan" in a)),
+        "ec.balance": lambda a: print(sh.ec_balance(
+            env, plan_only="-plan" in a)),
+    }
+    while True:
+        try:
+            line = input("> ").strip()
+        except EOFError:
+            return
+        if not line:
+            continue
+        if line in (".exit", "exit", "quit"):
+            return
+        if line == ".help":
+            print("commands:", ", ".join(sorted(handlers)))
+            continue
+        name, *rest = line.split()
+        fn = handlers.get(name)
+        if fn is None:
+            print(f"unknown command {name!r}; .help lists commands")
+            continue
+        try:
+            fn(rest)
+        except (RpcError, ValueError) as e:
+            print(f"error: {e}")
+
+
+def cmd_benchmark(args):
+    from seaweedfs_tpu.benchmark import run_benchmark
+
+    run_benchmark(args.master, num_files=args.n, file_size=args.size,
+                  concurrency=args.c, delete_percent=args.deletePercent,
+                  replication=args.replication)
+
+
+def cmd_upload(args):
+    with open(args.file, "rb") as f:
+        body = f.read()
+    a = call(args.master, f"/dir/assign?replication={args.replication}")
+    resp = call(a["url"], f"/{a['fid']}", raw=body, method="POST",
+                headers={"X-File-Name": os.path.basename(args.file)})
+    print(json.dumps({"fid": a["fid"], "url": a["url"],
+                      "size": resp.get("size")}))
+
+
+def cmd_download(args):
+    vid = args.fid.split(",")[0]
+    found = call(args.master, f"/dir/lookup?volumeId={vid}")
+    data = call(found["locations"][0]["url"], f"/{args.fid}")
+    out = args.output or args.fid.replace(",", "_")
+    with open(out, "wb") as f:
+        f.write(data)
+    print(f"wrote {len(data)} bytes to {out}")
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(prog="weed", description=__doc__)
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("master", help="start a master server")
+    p.add_argument("-ip", default="127.0.0.1")
+    p.add_argument("-port", type=int, default=9333)
+    p.add_argument("-volumeSizeLimitMB", type=int, default=1024)
+    p.add_argument("-defaultReplication", default="000")
+    p.add_argument("-pulseSeconds", type=float, default=5.0)
+    p.set_defaults(fn=cmd_master)
+
+    p = sub.add_parser("volume", help="start a volume server")
+    p.add_argument("-dir", default="./data")
+    p.add_argument("-max", default="8")
+    p.add_argument("-mserver", default="127.0.0.1:9333")
+    p.add_argument("-ip", default="127.0.0.1")
+    p.add_argument("-port", type=int, default=8080)
+    p.add_argument("-rack", default="")
+    p.add_argument("-dataCenter", default="")
+    p.add_argument("-pulseSeconds", type=float, default=5.0)
+    p.set_defaults(fn=cmd_volume)
+
+    p = sub.add_parser("filer", help="start a filer server")
+    p.add_argument("-master", default="127.0.0.1:9333")
+    p.add_argument("-ip", default="127.0.0.1")
+    p.add_argument("-port", type=int, default=8888)
+    p.add_argument("-maxMB", type=int, default=4)
+    p.add_argument("-db", default="", help="sqlite path (default: memory)")
+    p.add_argument("-replication", default="")
+    p.add_argument("-collection", default="")
+    p.set_defaults(fn=cmd_filer)
+
+    p = sub.add_parser("s3", help="start an s3 gateway (+embedded filer)")
+    p.add_argument("-master", default="127.0.0.1:9333")
+    p.add_argument("-ip", default="127.0.0.1")
+    p.add_argument("-port", type=int, default=8333)
+    p.add_argument("-db", default="")
+    p.add_argument("-config", default="", help="identities json")
+    p.set_defaults(fn=cmd_s3)
+
+    p = sub.add_parser("server", help="combined master+volume(+filer)(+s3)")
+    p.add_argument("-ip", default="127.0.0.1")
+    p.add_argument("-dir", default="./data")
+    p.add_argument("-masterPort", type=int, default=9333)
+    p.add_argument("-volumePort", type=int, default=8080)
+    p.add_argument("-filerPort", type=int, default=8888)
+    p.add_argument("-s3Port", type=int, default=8333)
+    p.add_argument("-volumeSizeLimitMB", type=int, default=1024)
+    p.add_argument("-pulseSeconds", type=float, default=5.0)
+    p.add_argument("-filer", action="store_true")
+    p.add_argument("-s3", action="store_true")
+    p.add_argument("-db", default="")
+    p.add_argument("-config", default="")
+    p.add_argument("-rack", default="")
+    p.set_defaults(fn=cmd_server)
+
+    p = sub.add_parser("shell", help="interactive admin shell")
+    p.add_argument("-master", default="127.0.0.1:9333")
+    p.set_defaults(fn=cmd_shell)
+
+    p = sub.add_parser("benchmark", help="write/read load benchmark")
+    p.add_argument("-master", default="127.0.0.1:9333")
+    p.add_argument("-n", type=int, default=1000)
+    p.add_argument("-size", type=int, default=1024)
+    p.add_argument("-c", type=int, default=16)
+    p.add_argument("-deletePercent", type=int, default=0)
+    p.add_argument("-replication", default="000")
+    p.set_defaults(fn=cmd_benchmark)
+
+    p = sub.add_parser("upload", help="upload one file")
+    p.add_argument("file")
+    p.add_argument("-master", default="127.0.0.1:9333")
+    p.add_argument("-replication", default="000")
+    p.set_defaults(fn=cmd_upload)
+
+    p = sub.add_parser("download", help="download by fid")
+    p.add_argument("fid")
+    p.add_argument("-master", default="127.0.0.1:9333")
+    p.add_argument("-output", default="")
+    p.set_defaults(fn=cmd_download)
+
+    p = sub.add_parser("version", help="print version")
+    p.set_defaults(fn=lambda a: print(VERSION))
+
+    args = parser.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()
