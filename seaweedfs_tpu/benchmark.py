@@ -67,7 +67,10 @@ def run_benchmark(master_address: str, num_files: int = 1000,
             try:
                 a = call(master_address,
                          f"/dir/assign?replication={replication}")
-                call(a["url"], f"/{a['fid']}", raw=payload, method="POST")
+                headers = ({"Authorization": "BEARER " + a["auth"]}
+                           if a.get("auth") else {})
+                call(a["url"], f"/{a['fid']}", raw=payload, method="POST",
+                     headers=headers)
                 dt = (time.perf_counter() - t0) * 1e3
                 with fid_lock:
                     write.requests += 1

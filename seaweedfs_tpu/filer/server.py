@@ -22,6 +22,7 @@ import time
 from typing import Optional
 
 from ..rpc.http_rpc import Request, Response, RpcError, RpcServer, call
+from ..security import Guard, gen_read_jwt, gen_write_jwt
 from .entry import Attr, Entry, FileChunk, total_size
 from .filechunks import etag_of_chunks, read_chunk_views
 from .filer import Filer
@@ -35,11 +36,13 @@ class FilerServer:
     def __init__(self, master_address: str, host: str = "127.0.0.1",
                  port: int = 0, store: Optional[FilerStore] = None,
                  chunk_size: int = DEFAULT_CHUNK_SIZE,
-                 replication: str = "", collection: str = ""):
+                 replication: str = "", collection: str = "",
+                 guard: Optional[Guard] = None):
         self.master_address = master_address
         self.chunk_size = chunk_size
         self.replication = replication
         self.collection = collection
+        self.guard = guard or Guard()
         self.filer = Filer(store)
         self.filer.on_delete_chunks = self._delete_chunks
         self.server = RpcServer(host, port)
@@ -74,9 +77,14 @@ class FilerServer:
 
     def _delete_chunks(self, chunks: list[FileChunk]):
         for chunk in chunks:
+            headers = {}
+            if self.guard.signing:
+                # filer shares security.toml; sign its own delete token
+                headers["Authorization"] = "BEARER " + gen_write_jwt(
+                    self.guard.signing, chunk.fid)
             try:
                 call(self._lookup_url(chunk.fid), f"/{chunk.fid}",
-                     method="DELETE", timeout=10)
+                     method="DELETE", headers=headers, timeout=10)
             except RpcError:
                 pass  # chunk may already be gone; vacuum reclaims the rest
 
@@ -135,10 +143,12 @@ class FilerServer:
                 piece = body[offset:offset + self.chunk_size]
                 assign = self._assign()
                 fid, url = assign["fid"], assign["url"]
+                headers = {"Content-Type": "application/octet-stream"}
+                if assign.get("auth"):
+                    # forward the assign-minted write JWT (jwt-enabled cluster)
+                    headers["Authorization"] = "BEARER " + assign["auth"]
                 up = call(url, f"/{fid}", raw=piece, method="POST",
-                          headers={"Content-Type":
-                                   "application/octet-stream"},
-                          timeout=60)
+                          headers=headers, timeout=60)
                 entry.chunks.append(FileChunk(
                     fid=fid, offset=offset, size=len(piece),
                     etag=up.get("eTag", ""),
@@ -158,7 +168,11 @@ class FilerServer:
         parts = []
         for view in read_chunk_views(entry.chunks, start, length):
             url = self._lookup_url(view.fid)
-            data = call(url, f"/{view.fid}", timeout=60)
+            headers = {}
+            if self.guard.read_signing:
+                headers["Authorization"] = "BEARER " + gen_read_jwt(
+                    self.guard.read_signing, view.fid)
+            data = call(url, f"/{view.fid}", headers=headers, timeout=60)
             if isinstance(data, dict):
                 raise RpcError(f"chunk {view.fid} fetch failed", 500)
             parts.append(bytes(data)[view.offset_in_chunk:

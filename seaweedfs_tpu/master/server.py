@@ -17,6 +17,8 @@ import time
 from typing import Optional
 
 from ..rpc.http_rpc import RpcError, RpcServer, call
+from ..security import Guard, gen_write_jwt
+from ..stats import metrics as stats
 from ..storage import types as t
 from ..storage.super_block import ReplicaPlacement
 from ..storage.ttl import TTL
@@ -30,12 +32,14 @@ class MasterServer:
                  volume_size_limit_mb: int = 1024,
                  default_replication: str = "000",
                  pulse_seconds: float = 5.0,
-                 garbage_threshold: float = 0.3):
+                 garbage_threshold: float = 0.3,
+                 guard: Optional[Guard] = None):
         self.topo = Topology(
             volume_size_limit=volume_size_limit_mb * 1024 * 1024,
             pulse_seconds=pulse_seconds)
         self.default_replication = default_replication
         self.garbage_threshold = garbage_threshold
+        self.guard = guard or Guard()
         self.server = RpcServer(host, port)
         self._register_routes()
         self._reaper: Optional[threading.Thread] = None
@@ -61,22 +65,34 @@ class MasterServer:
             self.topo.reap_dead_nodes()
 
     # -- routes --------------------------------------------------------------
+    def _guarded(self, fn):
+        """IP allow-list on admin/UI routes (guard.go WhiteList wrapper)."""
+        def wrapped(req):
+            peer = req.handler.client_address[0]
+            if not self.guard.check_white_list(peer):
+                raise RpcError(f"ip {peer} not allowed", 403)
+            return fn(req)
+        return wrapped
+
     def _register_routes(self):
         s = self.server
+        g = self._guarded
         s.add("POST", "/api/heartbeat", self._handle_heartbeat)
         s.add("GET", "/dir/assign", self._handle_assign)
         s.add("POST", "/dir/assign", self._handle_assign)
         s.add("GET", "/dir/lookup", self._handle_lookup)
-        s.add("GET", "/dir/status", lambda r: self.topo.to_dict())
+        s.add("GET", "/dir/status", g(lambda r: self.topo.to_dict()))
         s.add("GET", "/cluster/status", self._handle_cluster_status)
-        s.add("POST", "/vol/grow", self._handle_grow)
-        s.add("POST", "/vol/vacuum", self._handle_vacuum)
-        s.add("GET", "/vol/status", lambda r: self.topo.to_dict())
+        s.add("POST", "/vol/grow", g(self._handle_grow))
+        s.add("POST", "/vol/vacuum", g(self._handle_vacuum))
+        s.add("GET", "/vol/status", g(lambda r: self.topo.to_dict()))
         s.add("GET", "/ec/lookup", self._handle_ec_lookup)
+        s.add("GET", "/metrics", stats.metrics_handler)
 
     # -- heartbeat (master_grpc_server.go:60-170) ----------------------------
     def _handle_heartbeat(self, req):
         hb = req.json()
+        stats.MasterReceivedHeartbeatCounter.labels("total").inc()
         self.topo.process_heartbeat(hb)
         return {
             "volume_size_limit": self.topo.volume_size_limit,
@@ -102,12 +118,16 @@ class MasterServer:
         key, _ = self.topo.assign_file_id(count)
         cookie = random.getrandbits(32)
         fid = t.format_file_id(vid, key, cookie)
-        return {
+        result = {
             "fid": fid,
             "url": locations[0]["url"],
             "publicUrl": locations[0]["publicUrl"],
             "count": count,
         }
+        if self.guard.signing:
+            # JWT scoped to the assigned fid (master_server_handlers.go:150)
+            result["auth"] = gen_write_jwt(self.guard.signing, fid)
+        return result
 
     def _grow(self, collection: str, rp: ReplicaPlacement, ttl: TTL,
               target_count: Optional[int] = None,

@@ -1,0 +1,5 @@
+from .metrics import (Counter, Gauge, Histogram, Registry, REGISTRY,
+                      metrics_handler)
+
+__all__ = ["Counter", "Gauge", "Histogram", "Registry", "REGISTRY",
+           "metrics_handler"]

@@ -1,0 +1,287 @@
+"""Prometheus-style metrics registry with text exposition.
+
+The reference registers ~25 metric vectors (counters, gauges, histograms)
+covering master/filer/volume/s3 request counts, sizes and latencies
+(/root/reference/weed/stats/metrics.go:31-196) and serves them on a
+metrics port or pushes to a gateway.  This is a dependency-free registry
+producing the same text exposition format, served by ``metrics_handler``
+mounted at /metrics on every daemon.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional, Sequence
+
+_DEFAULT_BUCKETS = (
+    .0001, .0003, .001, .003, .01, .03, .1, .3, 1, 3, 10, 30, 100)
+
+
+def _fmt_labels(names: Sequence[str], values: Sequence[str]) -> str:
+    if not names:
+        return ""
+    inner = ",".join(
+        '%s="%s"' % (n, str(v).replace("\\", "\\\\").replace('"', '\\"'))
+        for n, v in zip(names, values))
+    return "{%s}" % inner
+
+
+def _fmt_value(v: float) -> str:
+    if v == float("inf"):
+        return "+Inf"
+    if float(v).is_integer():
+        return str(int(v))
+    return repr(float(v))
+
+
+class _Metric:
+    def __init__(self, name: str, help_: str, label_names: Sequence[str]):
+        self.name = name
+        self.help = help_
+        self.label_names = tuple(label_names)
+        self._lock = threading.Lock()
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def __init__(self, name, help_="", label_names=()):
+        super().__init__(name, help_, label_names)
+        self._values: dict[tuple, float] = {}
+
+    def labels(self, *values) -> "_CounterChild":
+        return _CounterChild(self, tuple(str(v) for v in values))
+
+    def inc(self, amount: float = 1.0, labels: tuple = ()):
+        with self._lock:
+            self._values[labels] = self._values.get(labels, 0.0) + amount
+
+    def expose(self) -> list[str]:
+        lines = ["# HELP %s %s" % (self.name, self.help),
+                 "# TYPE %s counter" % self.name]
+        with self._lock:
+            items = sorted(self._values.items())
+        for labels, v in items or [((), 0.0)] if not self.label_names else items:
+            lines.append("%s%s %s" % (
+                self.name, _fmt_labels(self.label_names, labels),
+                _fmt_value(v)))
+        return lines
+
+
+class _CounterChild:
+    __slots__ = ("_parent", "_labels")
+
+    def __init__(self, parent, labels):
+        self._parent, self._labels = parent, labels
+
+    def inc(self, amount: float = 1.0):
+        self._parent.inc(amount, self._labels)
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def __init__(self, name, help_="", label_names=(), fn=None):
+        super().__init__(name, help_, label_names)
+        self._values: dict[tuple, float] = {}
+        self._fn = fn  # callable -> float, for self-sampling gauges
+
+    def labels(self, *values) -> "_GaugeChild":
+        return _GaugeChild(self, tuple(str(v) for v in values))
+
+    def set(self, value: float, labels: tuple = ()):
+        with self._lock:
+            self._values[labels] = float(value)
+
+    def add(self, amount: float, labels: tuple = ()):
+        with self._lock:
+            self._values[labels] = self._values.get(labels, 0.0) + amount
+
+    def expose(self) -> list[str]:
+        lines = ["# HELP %s %s" % (self.name, self.help),
+                 "# TYPE %s gauge" % self.name]
+        if self._fn is not None:
+            lines.append("%s %s" % (self.name, _fmt_value(self._fn())))
+            return lines
+        with self._lock:
+            items = sorted(self._values.items())
+        if not items and not self.label_names:
+            items = [((), 0.0)]
+        for labels, v in items:
+            lines.append("%s%s %s" % (
+                self.name, _fmt_labels(self.label_names, labels),
+                _fmt_value(v)))
+        return lines
+
+
+class _GaugeChild:
+    __slots__ = ("_parent", "_labels")
+
+    def __init__(self, parent, labels):
+        self._parent, self._labels = parent, labels
+
+    def set(self, value: float):
+        self._parent.set(value, self._labels)
+
+    def add(self, amount: float):
+        self._parent.add(amount, self._labels)
+
+    def inc(self, amount: float = 1.0):
+        self._parent.add(amount, self._labels)
+
+    def dec(self, amount: float = 1.0):
+        self._parent.add(-amount, self._labels)
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name, help_="", label_names=(),
+                 buckets: Sequence[float] = _DEFAULT_BUCKETS):
+        super().__init__(name, help_, label_names)
+        self.buckets = tuple(sorted(buckets))
+        self._counts: dict[tuple, list[int]] = {}
+        self._sums: dict[tuple, float] = {}
+
+    def labels(self, *values) -> "_HistogramChild":
+        return _HistogramChild(self, tuple(str(v) for v in values))
+
+    def observe(self, value: float, labels: tuple = ()):
+        with self._lock:
+            counts = self._counts.setdefault(
+                labels, [0] * (len(self.buckets) + 1))
+            self._sums[labels] = self._sums.get(labels, 0.0) + value
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    counts[i] += 1
+                    return
+            counts[-1] += 1
+
+    def time(self, labels: tuple = ()):
+        return _Timer(self, labels)
+
+    def expose(self) -> list[str]:
+        lines = ["# HELP %s %s" % (self.name, self.help),
+                 "# TYPE %s histogram" % self.name]
+        with self._lock:
+            items = sorted(self._counts.items())
+            sums = dict(self._sums)
+        for labels, counts in items:
+            cumulative = 0
+            for b, c in zip(self.buckets, counts):
+                cumulative += c
+                lines.append('%s_bucket%s %d' % (
+                    self.name,
+                    _fmt_labels(self.label_names + ("le",),
+                                labels + (_fmt_value(b),)),
+                    cumulative))
+            cumulative += counts[-1]
+            lines.append('%s_bucket%s %d' % (
+                self.name,
+                _fmt_labels(self.label_names + ("le",), labels + ("+Inf",)),
+                cumulative))
+            lines.append("%s_sum%s %s" % (
+                self.name, _fmt_labels(self.label_names, labels),
+                _fmt_value(sums[labels])))
+            lines.append("%s_count%s %d" % (
+                self.name, _fmt_labels(self.label_names, labels), cumulative))
+        return lines
+
+
+class _HistogramChild:
+    __slots__ = ("_parent", "_labels")
+
+    def __init__(self, parent, labels):
+        self._parent, self._labels = parent, labels
+
+    def observe(self, value: float):
+        self._parent.observe(value, self._labels)
+
+    def time(self):
+        return _Timer(self._parent, self._labels)
+
+
+class _Timer:
+    def __init__(self, hist, labels):
+        self._hist, self._labels = hist, labels
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self._hist.observe(time.perf_counter() - self._t0, self._labels)
+        return False
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: dict[str, _Metric] = {}
+
+    def register(self, metric: _Metric) -> _Metric:
+        with self._lock:
+            existing = self._metrics.get(metric.name)
+            if existing is not None:
+                return existing
+            self._metrics[metric.name] = metric
+        return metric
+
+    def counter(self, name, help_="", label_names=()) -> Counter:
+        return self.register(Counter(name, help_, label_names))
+
+    def gauge(self, name, help_="", label_names=(), fn=None) -> Gauge:
+        return self.register(Gauge(name, help_, label_names, fn=fn))
+
+    def histogram(self, name, help_="", label_names=(),
+                  buckets=_DEFAULT_BUCKETS) -> Histogram:
+        return self.register(Histogram(name, help_, label_names, buckets))
+
+    def expose(self) -> str:
+        with self._lock:
+            metrics = list(self._metrics.values())
+        lines: list[str] = []
+        for m in metrics:
+            lines.extend(m.expose())
+        return "\n".join(lines) + "\n"
+
+
+REGISTRY = Registry()
+
+# The standard vectors the reference registers (stats/metrics.go:31-196),
+# shared by every daemon in-process.
+MasterReceivedHeartbeatCounter = REGISTRY.counter(
+    "SeaweedFS_master_received_heartbeats", "master received heartbeats",
+    ("type",))
+MasterVolumeLayoutWritable = REGISTRY.gauge(
+    "SeaweedFS_master_volume_layout_writable",
+    "writable volumes per layout", ("collection", "rp", "ttl"))
+MasterPickForWriteErrorCounter = REGISTRY.counter(
+    "SeaweedFS_master_pick_for_write_error", "pick-for-write errors")
+VolumeServerRequestCounter = REGISTRY.counter(
+    "SeaweedFS_volumeServer_request_total", "volume server requests",
+    ("type",))
+VolumeServerRequestHistogram = REGISTRY.histogram(
+    "SeaweedFS_volumeServer_request_seconds", "volume server request latency",
+    ("type",))
+VolumeServerVolumeCounter = REGISTRY.gauge(
+    "SeaweedFS_volumeServer_volumes", "volumes managed", ("collection", "type"))
+VolumeServerReadOnlyVolumeGauge = REGISTRY.gauge(
+    "SeaweedFS_volumeServer_read_only_volumes", "read-only volumes")
+FilerRequestCounter = REGISTRY.counter(
+    "SeaweedFS_filer_request_total", "filer requests", ("type",))
+FilerRequestHistogram = REGISTRY.histogram(
+    "SeaweedFS_filer_request_seconds", "filer request latency", ("type",))
+S3RequestCounter = REGISTRY.counter(
+    "SeaweedFS_s3_request_total", "s3 requests", ("action", "code"))
+S3RequestHistogram = REGISTRY.histogram(
+    "SeaweedFS_s3_request_seconds", "s3 request latency", ("action",))
+
+
+def metrics_handler(req):
+    """RpcServer route serving the registry in text exposition format."""
+    from ..rpc.http_rpc import Response
+
+    return Response(REGISTRY.expose().encode(),
+                    content_type="text/plain; version=0.0.4")

@@ -22,6 +22,8 @@ import time
 from typing import Optional
 
 from ..rpc.http_rpc import Request, Response, RpcError, RpcServer, call
+from ..security import Guard, gen_write_jwt, token_from_request
+from ..stats import metrics as stats
 from ..storage import types as t
 from ..storage.erasure_coding import TOTAL_SHARDS_COUNT, to_ext
 from ..storage.erasure_coding import decoder as ec_decoder
@@ -41,10 +43,12 @@ class VolumeServer:
                  host: str = "127.0.0.1", port: int = 0,
                  public_url: str = "", data_center: str = "",
                  rack: str = "", max_volume_counts: Optional[list[int]] = None,
-                 pulse_seconds: float = 5.0, ec_encoder_backend=None):
+                 pulse_seconds: float = 5.0, ec_encoder_backend=None,
+                 guard: Optional[Guard] = None):
         self.server = RpcServer(host, port)
         self.master_address = master_address
         self.pulse_seconds = pulse_seconds
+        self.guard = guard or Guard()
         self.store = Store(
             directories, max_volume_counts, ip=host,
             port=self.server.port, public_url=public_url,
@@ -92,24 +96,35 @@ class VolumeServer:
             self._stop.wait(self.pulse_seconds)
 
     # -- routing -------------------------------------------------------------
+    def _guarded(self, fn):
+        """IP allow-list on admin routes (guard.go WhiteList wrapper)."""
+        def wrapped(req: Request):
+            peer = req.handler.client_address[0]
+            if not self.guard.check_white_list(peer):
+                raise RpcError(f"ip {peer} not allowed", 403)
+            return fn(req)
+        return wrapped
+
     def _register_routes(self):
         s = self.server
-        s.add("GET", "/admin/status", lambda r: self.store.status())
-        s.add("POST", "/admin/assign_volume", self._h_assign_volume)
-        s.add("POST", "/admin/delete_volume", self._h_delete_volume)
-        s.add("POST", "/admin/readonly", self._h_readonly)
-        s.add("POST", "/admin/vacuum/check", self._h_vacuum_check)
-        s.add("POST", "/admin/vacuum/compact", self._h_vacuum_compact)
-        s.add("POST", "/admin/vacuum/commit", self._h_vacuum_commit)
-        s.add("POST", "/admin/ec/generate", self._h_ec_generate)
-        s.add("POST", "/admin/ec/rebuild", self._h_ec_rebuild)
-        s.add("POST", "/admin/ec/mount", self._h_ec_mount)
-        s.add("POST", "/admin/ec/unmount", self._h_ec_unmount)
-        s.add("POST", "/admin/ec/copy", self._h_ec_copy)
-        s.add("POST", "/admin/ec/delete_shards", self._h_ec_delete_shards)
-        s.add("POST", "/admin/ec/to_volume", self._h_ec_to_volume)
+        g = self._guarded
+        s.add("GET", "/admin/status", g(lambda r: self.store.status()))
+        s.add("POST", "/admin/assign_volume", g(self._h_assign_volume))
+        s.add("POST", "/admin/delete_volume", g(self._h_delete_volume))
+        s.add("POST", "/admin/readonly", g(self._h_readonly))
+        s.add("POST", "/admin/vacuum/check", g(self._h_vacuum_check))
+        s.add("POST", "/admin/vacuum/compact", g(self._h_vacuum_compact))
+        s.add("POST", "/admin/vacuum/commit", g(self._h_vacuum_commit))
+        s.add("POST", "/admin/ec/generate", g(self._h_ec_generate))
+        s.add("POST", "/admin/ec/rebuild", g(self._h_ec_rebuild))
+        s.add("POST", "/admin/ec/mount", g(self._h_ec_mount))
+        s.add("POST", "/admin/ec/unmount", g(self._h_ec_unmount))
+        s.add("POST", "/admin/ec/copy", g(self._h_ec_copy))
+        s.add("POST", "/admin/ec/delete_shards", g(self._h_ec_delete_shards))
+        s.add("POST", "/admin/ec/to_volume", g(self._h_ec_to_volume))
         s.add("GET", "/admin/ec/shard_file", self._h_ec_shard_file)
         s.add("GET", "/admin/ec/shard_read", self._h_ec_shard_read)
+        s.add("GET", "/metrics", stats.metrics_handler)
         s.default_route = self._handle_object
 
     # -- public object API ---------------------------------------------------
@@ -122,12 +137,34 @@ class VolumeServer:
         except ValueError as e:
             raise RpcError(str(e), 400)
         if method in ("GET", "HEAD"):
-            return self._read_object(vid, nid, cookie, method)
+            if self.guard.read_signing:
+                try:
+                    self.guard.verify_read(
+                        token_from_request(req.headers, req.query), fid)
+                except PermissionError as e:
+                    raise RpcError(str(e), 401)
+            stats.VolumeServerRequestCounter.labels("read").inc()
+            with stats.VolumeServerRequestHistogram.labels("read").time():
+                return self._read_object(vid, nid, cookie, method)
         if method in ("POST", "PUT"):
-            return self._write_object(vid, nid, cookie, req)
+            # JWT check before any byte is written
+            # (volume_server_handlers_write.go:30-38)
+            self._check_write_auth(req, fid)
+            stats.VolumeServerRequestCounter.labels("write").inc()
+            with stats.VolumeServerRequestHistogram.labels("write").time():
+                return self._write_object(vid, nid, cookie, req)
         if method == "DELETE":
+            self._check_write_auth(req, fid)
+            stats.VolumeServerRequestCounter.labels("delete").inc()
             return self._delete_object(vid, nid, cookie, req)
         raise RpcError(f"unsupported method {method}", 405)
+
+    def _check_write_auth(self, req: Request, fid: str):
+        try:
+            self.guard.verify_write(
+                token_from_request(req.headers, req.query), fid)
+        except PermissionError as e:
+            raise RpcError(str(e), 401)
 
     def _read_object(self, vid: int, nid: int, cookie: int, method: str):
         try:
@@ -203,6 +240,10 @@ class VolumeServer:
         headers = {canonical: lowered[canonical.lower()]
                    for canonical in ("Content-Type", "X-File-Name")
                    if canonical.lower() in lowered}
+        if self.guard.signing:
+            # replicas share security.toml; re-sign for the fan-out hop
+            headers["Authorization"] = "BEARER " + gen_write_jwt(
+                self.guard.signing, fid)
         for url in others:
             call(url, f"/{fid}?type=replicate", method=method, raw=body,
                  headers=headers, timeout=30)

@@ -1,0 +1,269 @@
+"""Cross-cutting substrate tests: JWT security, metrics, config, glog.
+
+Mirrors the reference's weed/security tests plus the metric-vector surface
+of weed/stats/metrics.go.
+"""
+
+import io
+import os
+import time
+
+import pytest
+
+from seaweedfs_tpu.security import (Guard, SigningKey, decode_jwt,
+                                    encode_jwt, gen_write_jwt,
+                                    token_from_request)
+from seaweedfs_tpu.stats.metrics import Counter, Gauge, Histogram, Registry
+from seaweedfs_tpu.util import glog
+from seaweedfs_tpu.util.config import Configuration, load_configuration, scaffold
+
+
+class TestJwt:
+    def test_roundtrip(self):
+        tok = encode_jwt(b"secret", {"fid": "3,ab12", "exp": time.time() + 60})
+        claims = decode_jwt(b"secret", tok)
+        assert claims["fid"] == "3,ab12"
+
+    def test_bad_signature(self):
+        tok = encode_jwt(b"secret", {"fid": "3,ab12"})
+        with pytest.raises(ValueError, match="signature"):
+            decode_jwt(b"other", tok)
+
+    def test_expired(self):
+        tok = encode_jwt(b"k", {"fid": "x", "exp": time.time() - 1})
+        with pytest.raises(ValueError, match="expired"):
+            decode_jwt(b"k", tok)
+
+    def test_tampered_payload(self):
+        tok = encode_jwt(b"k", {"fid": "3,ab"})
+        h, p, s = tok.split(".")
+        other = encode_jwt(b"k", {"fid": "4,cd"}).split(".")[1]
+        with pytest.raises(ValueError):
+            decode_jwt(b"k", f"{h}.{other}.{s}")
+
+    def test_guard_write_verification(self):
+        g = Guard(signing_key="topsecret")
+        tok = gen_write_jwt(g.signing, "3,01637037d6")
+        g.verify_write(tok, "3,01637037d6")  # ok
+        with pytest.raises(PermissionError):
+            g.verify_write(tok, "4,01637037d6")
+        with pytest.raises(PermissionError):
+            g.verify_write("", "3,01637037d6")
+
+    def test_guard_volume_scoped_token(self):
+        g = Guard(signing_key="k2")
+        tok = encode_jwt(g.signing.key, {"fid": "3,"})
+        g.verify_write(tok, "3,deadbeef01")
+
+    def test_inactive_guard_allows_all(self):
+        g = Guard()
+        g.verify_write("", "3,ab")  # no key configured: open access
+
+    def test_token_from_request(self):
+        class H(dict):
+            def get(self, k, d=""):
+                return super().get(k, d)
+
+        assert token_from_request(H({"Authorization": "BEARER abc"}),
+                                  {}) == "abc"
+        assert token_from_request(H(), {"jwt": "xyz"}) == "xyz"
+
+    def test_white_list(self):
+        g = Guard(white_list=["10.0.0.0/8", "127.0.0.1"])
+        assert g.check_white_list("10.1.2.3")
+        assert g.check_white_list("127.0.0.1")
+        assert not g.check_white_list("192.168.1.1")
+        assert Guard().check_white_list("8.8.8.8")  # empty list = allow
+
+
+class TestMetrics:
+    def test_counter_exposition(self):
+        r = Registry()
+        c = r.counter("test_total", "help text", ("op",))
+        c.labels("read").inc()
+        c.labels("read").inc(2)
+        c.labels("write").inc()
+        text = r.expose()
+        assert '# TYPE test_total counter' in text
+        assert 'test_total{op="read"} 3' in text
+        assert 'test_total{op="write"} 1' in text
+
+    def test_gauge_and_callback(self):
+        r = Registry()
+        g = r.gauge("g1")
+        g.set(5)
+        r.gauge("g2", fn=lambda: 42.5)
+        text = r.expose()
+        assert "g1 5" in text
+        assert "g2 42.5" in text
+
+    def test_histogram_buckets(self):
+        r = Registry()
+        h = r.histogram("lat", buckets=(0.1, 1, 10))
+        for v in (0.05, 0.5, 5, 50):
+            h.observe(v)
+        text = r.expose()
+        assert 'lat_bucket{le="0.1"} 1' in text
+        assert 'lat_bucket{le="1"} 2' in text
+        assert 'lat_bucket{le="10"} 3' in text
+        assert 'lat_bucket{le="+Inf"} 4' in text
+        assert 'lat_count 4' in text
+
+    def test_histogram_timer(self):
+        r = Registry()
+        h = r.histogram("t", buckets=(10,))
+        with h.time():
+            pass
+        assert "t_count 1" in r.expose()
+
+    def test_register_dedupes_by_name(self):
+        r = Registry()
+        a = r.counter("x")
+        b = r.counter("x")
+        assert a is b
+
+
+class TestConfig:
+    def test_dotted_get_and_env_override(self, monkeypatch):
+        c = Configuration({"jwt": {"signing": {"key": "abc"}}})
+        assert c.get("jwt.signing.key") == "abc"
+        assert c.get("jwt.missing", "dflt") == "dflt"
+        monkeypatch.setenv("WEED_JWT_SIGNING_KEY", "fromenv")
+        assert c.get("jwt.signing.key") == "fromenv"
+
+    def test_load_from_dir(self, tmp_path):
+        (tmp_path / "security.toml").write_text(
+            '[jwt.signing]\nkey = "k"\nexpires_after_seconds = 99\n')
+        c = load_configuration("security", search_dirs=[str(tmp_path)])
+        assert c.get("jwt.signing.key") == "k"
+        assert c.get_int("jwt.signing.expires_after_seconds") == 99
+
+    def test_missing_optional(self, tmp_path):
+        c = load_configuration("nonexistent", search_dirs=[str(tmp_path)])
+        assert c.get("anything") is None
+
+    def test_scaffold_templates_parse(self):
+        import tomllib
+
+        for name in ("security", "master", "filer", "replication",
+                     "notification"):
+            tomllib.loads(scaffold(name))
+
+
+class TestGlog:
+    def test_severity_format(self):
+        buf = io.StringIO()
+        glog.set_output(buf)
+        try:
+            glog.infof("hello %d", 42)
+            glog.warning("careful")
+        finally:
+            glog.set_output(os.sys.stderr)
+        lines = buf.getvalue().splitlines()
+        assert lines[0].startswith("I")
+        assert "hello 42" in lines[0]
+        assert "test_security_stats.py" in lines[0]
+        assert lines[1].startswith("W")
+
+    def test_verbosity_guard(self):
+        buf = io.StringIO()
+        glog.set_output(buf)
+        try:
+            glog.set_verbosity(0)
+            glog.v(2).info("hidden")
+            glog.set_verbosity(2)
+            glog.v(2).info("shown")
+        finally:
+            glog.set_verbosity(0)
+            glog.set_output(os.sys.stderr)
+        assert "hidden" not in buf.getvalue()
+        assert "shown" in buf.getvalue()
+
+    def test_vmodule(self):
+        glog.set_vmodule("test_security*=3")
+        try:
+            assert bool(glog.v(3))
+        finally:
+            glog.set_vmodule("")
+        assert not bool(glog.v(3))
+
+
+class TestClusterJwt:
+    """JWT enforced end-to-end: assign mints a token, writes need it."""
+
+    def test_write_requires_jwt(self, tmp_path):
+        from seaweedfs_tpu.master.server import MasterServer
+        from seaweedfs_tpu.rpc.http_rpc import RpcError, call
+        from seaweedfs_tpu.volume_server.server import VolumeServer
+
+        guard = Guard(signing_key="cluster-secret")
+        m = MasterServer(port=0, guard=guard)
+        m.start()
+        vs = VolumeServer([str(tmp_path)], m.address, port=0, guard=guard)
+        vs.start()
+        try:
+            vs.heartbeat_once()
+            a = call(m.address, "/dir/assign")
+            assert a.get("auth"), "assign must mint a jwt"
+            # write without token -> 401
+            with pytest.raises(RpcError) as ei:
+                call(a["url"], f"/{a['fid']}", raw=b"data", method="POST")
+            assert ei.value.status == 401
+            # with token -> ok
+            resp = call(a["url"], f"/{a['fid']}?jwt={a['auth']}",
+                        raw=b"data", method="POST")
+            assert resp["size"] > 0
+            # reads unaffected (no read key configured)
+            got = call(a["url"], f"/{a['fid']}")
+            assert got == b"data"
+        finally:
+            vs.stop()
+            m.stop()
+
+    def test_filer_roundtrip_with_jwt(self, tmp_path):
+        """Filer forwards write tokens and signs its own delete tokens."""
+        from seaweedfs_tpu.filer.server import FilerServer
+        from seaweedfs_tpu.master.server import MasterServer
+        from seaweedfs_tpu.rpc.http_rpc import call
+        from seaweedfs_tpu.volume_server.server import VolumeServer
+
+        guard = Guard(signing_key="filer-secret")
+        m = MasterServer(port=0, guard=guard)
+        m.start()
+        vs = VolumeServer([str(tmp_path)], m.address, port=0, guard=guard)
+        vs.start()
+        filer = FilerServer(m.address, port=0, guard=guard)
+        filer.start()
+        try:
+            vs.heartbeat_once()
+            body = os.urandom(100_000)  # large enough to chunk
+            call(filer.address, "/big.bin", raw=body, method="POST",
+                 headers={"Content-Type": "application/octet-stream"})
+            got = call(filer.address, "/big.bin")
+            assert got == body
+            call(filer.address, "/big.bin", method="DELETE")
+        finally:
+            filer.stop()
+            vs.stop()
+            m.stop()
+
+    def test_admin_whitelist_blocks(self, tmp_path):
+        from seaweedfs_tpu.master.server import MasterServer
+        from seaweedfs_tpu.rpc.http_rpc import RpcError, call
+        from seaweedfs_tpu.volume_server.server import VolumeServer
+
+        guard = Guard(white_list=["10.99.99.99"])  # loopback NOT allowed
+        m = MasterServer(port=0, guard=guard)
+        m.start()
+        vs = VolumeServer([str(tmp_path)], m.address, port=0, guard=guard)
+        vs.start()
+        try:
+            with pytest.raises(RpcError) as ei:
+                call(vs.address, "/admin/status")
+            assert ei.value.status == 403
+            with pytest.raises(RpcError) as ei:
+                call(m.address, "/vol/status")
+            assert ei.value.status == 403
+        finally:
+            vs.stop()
+            m.stop()

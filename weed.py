@@ -36,13 +36,31 @@ def _stop_all(stoppables):
             pass
 
 
+def _load_guard():
+    """Build a security Guard from security.toml (weed/security/guard.go)."""
+    from seaweedfs_tpu.security import Guard
+    from seaweedfs_tpu.util.config import load_configuration
+
+    conf = load_configuration("security")
+    return Guard(
+        white_list=[w for w in
+                    str(conf.get("access.ui", "") or "").split(",") if w],
+        signing_key=str(conf.get("jwt.signing.key", "") or ""),
+        expires_after_seconds=conf.get_int(
+            "jwt.signing.expires_after_seconds", 10),
+        read_signing_key=str(conf.get("jwt.signing.read.key", "") or ""),
+        read_expires_after_seconds=conf.get_int(
+            "jwt.signing.read.expires_after_seconds", 60))
+
+
 def cmd_master(args):
     from seaweedfs_tpu.master.server import MasterServer
 
     m = MasterServer(host=args.ip, port=args.port,
                      volume_size_limit_mb=args.volumeSizeLimitMB,
                      default_replication=args.defaultReplication,
-                     pulse_seconds=args.pulseSeconds)
+                     pulse_seconds=args.pulseSeconds,
+                     guard=_load_guard())
     m.start()
     print(f"master listening on {m.address}")
     _wait_forever([m])
@@ -58,7 +76,8 @@ def cmd_volume(args):
     vs = VolumeServer(dirs, args.mserver, host=args.ip, port=args.port,
                       rack=args.rack, data_center=args.dataCenter,
                       max_volume_counts=maxes,
-                      pulse_seconds=args.pulseSeconds)
+                      pulse_seconds=args.pulseSeconds,
+                      guard=_load_guard())
     vs.start()
     print(f"volume server listening on {vs.address}, dirs={dirs}")
     _wait_forever([vs])
@@ -72,7 +91,7 @@ def cmd_filer(args):
     f = FilerServer(args.master, host=args.ip, port=args.port, store=store,
                     chunk_size=args.maxMB * 1024 * 1024,
                     replication=args.replication,
-                    collection=args.collection)
+                    collection=args.collection, guard=_load_guard())
     f.start()
     print(f"filer listening on {f.address}")
     _wait_forever([f])
@@ -97,7 +116,8 @@ def cmd_s3(args):
     from seaweedfs_tpu.s3api.server import S3ApiServer
 
     store = SqliteStore(args.db) if args.db else None
-    filer = FilerServer(args.master, port=0, store=store)
+    filer = FilerServer(args.master, port=0, store=store,
+                        guard=_load_guard())
     filer.start()
     s3 = S3ApiServer(filer, host=args.ip, port=args.port,
                      identities=_load_identities(args.config))
@@ -116,9 +136,10 @@ def cmd_server(args):
     from seaweedfs_tpu.volume_server.server import VolumeServer
 
     stoppables = []
+    guard = _load_guard()
     master = MasterServer(host=args.ip, port=args.masterPort,
                           volume_size_limit_mb=args.volumeSizeLimitMB,
-                          pulse_seconds=args.pulseSeconds)
+                          pulse_seconds=args.pulseSeconds, guard=guard)
     master.start()
     stoppables.append(master)
     print(f"master on {master.address}")
@@ -126,7 +147,7 @@ def cmd_server(args):
     dirs = args.dir.split(",")
     vs = VolumeServer(dirs, master.address, host=args.ip,
                       port=args.volumePort, rack=args.rack,
-                      pulse_seconds=args.pulseSeconds)
+                      pulse_seconds=args.pulseSeconds, guard=guard)
     vs.start()
     vs.heartbeat_once()
     stoppables.append(vs)
@@ -135,7 +156,7 @@ def cmd_server(args):
     if args.filer or args.s3:
         store = SqliteStore(args.db) if args.db else None
         filer = FilerServer(master.address, host=args.ip,
-                            port=args.filerPort, store=store)
+                            port=args.filerPort, store=store, guard=guard)
         filer.start()
         stoppables.append(filer)
         print(f"filer on {filer.address}")
@@ -202,8 +223,11 @@ def cmd_upload(args):
     with open(args.file, "rb") as f:
         body = f.read()
     a = call(args.master, f"/dir/assign?replication={args.replication}")
+    headers = {"X-File-Name": os.path.basename(args.file)}
+    if a.get("auth"):
+        headers["Authorization"] = "BEARER " + a["auth"]
     resp = call(a["url"], f"/{a['fid']}", raw=body, method="POST",
-                headers={"X-File-Name": os.path.basename(args.file)})
+                headers=headers)
     print(json.dumps({"fid": a["fid"], "url": a["url"],
                       "size": resp.get("size")}))
 
@@ -218,8 +242,23 @@ def cmd_download(args):
     print(f"wrote {len(data)} bytes to {out}")
 
 
+def cmd_scaffold(args):
+    from seaweedfs_tpu.util.config import scaffold
+
+    text = scaffold(args.config)
+    if args.output:
+        path = os.path.join(args.output, args.config + ".toml")
+        with open(path, "w") as f:
+            f.write(text)
+        print(f"wrote {path}")
+    else:
+        print(text, end="")
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(prog="weed", description=__doc__)
+    parser.add_argument("-v", type=int, default=0,
+                        help="glog verbosity level")
     sub = parser.add_subparsers(dest="command", required=True)
 
     p = sub.add_parser("master", help="start a master server")
@@ -300,10 +339,20 @@ def main(argv=None):
     p.add_argument("-output", default="")
     p.set_defaults(fn=cmd_download)
 
+    p = sub.add_parser("scaffold", help="print a config template")
+    p.add_argument("-config", default="security",
+                   help="security|master|filer|replication|notification")
+    p.add_argument("-output", default="", help="write <name>.toml to dir")
+    p.set_defaults(fn=cmd_scaffold)
+
     p = sub.add_parser("version", help="print version")
     p.set_defaults(fn=lambda a: print(VERSION))
 
     args = parser.parse_args(argv)
+    if args.v:
+        from seaweedfs_tpu.util import glog
+
+        glog.set_verbosity(args.v)
     args.fn(args)
 
 
