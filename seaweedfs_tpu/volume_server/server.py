@@ -17,6 +17,8 @@ with a freshness window (store_ec.go:227-268).
 
 from __future__ import annotations
 
+import json
+import os
 import threading
 import time
 from typing import Optional
@@ -30,6 +32,7 @@ from ..storage.erasure_coding import decoder as ec_decoder
 from ..storage.erasure_coding.ec_volume import (EcDeletedError,
                                                 EcNotFoundError,
                                                 rebuild_ecx_file)
+from ..storage import volume_backup
 from ..storage.needle import Needle
 from ..storage.store import Store
 from ..storage.volume import (CookieMismatchError, DeletedError,
@@ -112,6 +115,14 @@ class VolumeServer:
         s.add("POST", "/admin/assign_volume", g(self._h_assign_volume))
         s.add("POST", "/admin/delete_volume", g(self._h_delete_volume))
         s.add("POST", "/admin/readonly", g(self._h_readonly))
+        s.add("POST", "/admin/volume/mount", g(self._h_volume_mount))
+        s.add("POST", "/admin/volume/unmount", g(self._h_volume_unmount))
+        s.add("POST", "/admin/volume/copy", g(self._h_volume_copy))
+        s.add("GET", "/admin/volume/status", g(self._h_volume_status))
+        s.add("GET", "/admin/volume/tail", g(self._h_volume_tail))
+        s.add("POST", "/admin/volume/sync", g(self._h_volume_sync))
+        s.add("GET", "/admin/volume/read_all", g(self._h_volume_read_all))
+        s.add("POST", "/admin/batch_delete", self._h_batch_delete)
         s.add("POST", "/admin/vacuum/check", g(self._h_vacuum_check))
         s.add("POST", "/admin/vacuum/compact", g(self._h_vacuum_compact))
         s.add("POST", "/admin/vacuum/commit", g(self._h_vacuum_commit))
@@ -286,6 +297,159 @@ class VolumeServer:
         self._volume_or_404(int(req.json()["volume"])).commit_compact()
         return {}
 
+    # -- volume copy/tail/backup (volume_grpc_copy.go, _tail.go, backup) -----
+    def _h_volume_mount(self, req: Request):
+        """VolumeMount: load an existing on-disk volume into the store."""
+        p = req.json()
+        vid = int(p["volume"])
+        collection = p.get("collection", "")
+        for loc in self.store.locations:
+            if os.path.exists(loc._base_name(collection, vid) + ".dat"):
+                loc.add_volume(vid, collection)
+                self._try_heartbeat()
+                return {}
+        raise RpcError(f"volume {vid} data file not found", 404)
+
+    def _h_volume_unmount(self, req: Request):
+        """VolumeUnmount: close + forget the volume, leave files on disk."""
+        vid = int(req.json()["volume"])
+        loc = self.store.location_of(vid)
+        if loc is None:
+            raise RpcError(f"volume {vid} not found", 404)
+        loc.unload_volume(vid)
+        self._try_heartbeat()
+        return {}
+
+    def _h_volume_copy(self, req: Request):
+        """VolumeCopy: pull .dat/.idx/.vif from a source server and mount
+        (volume_grpc_copy.go doCopyFile over the CopyFile stream)."""
+        p = req.json()
+        vid = int(p["volume"])
+        collection = p.get("collection", "")
+        source = p["source"]
+        if self.store.has_volume(vid):
+            raise RpcError(f"volume {vid} already exists", 409)
+        loc = self.store.locations[0]
+        base = loc._base_name(collection, vid)
+        if os.path.exists(base + ".dat"):
+            raise RpcError(f"volume {vid} files already on disk", 409)
+        # fetch to temp names; rename only once every file arrived, so a
+        # mid-copy failure leaves no stray .dat/.idx behind
+        fetched: list[str] = []
+        try:
+            for ext in (".dat", ".idx", ".vif"):
+                try:
+                    data = call(source,
+                                f"/admin/ec/shard_file?volume={vid}"
+                                f"&collection={collection}&ext={ext}",
+                                timeout=600)
+                except RpcError as e:
+                    if e.status == 404 and ext == ".vif":
+                        continue
+                    raise
+                if isinstance(data, dict):
+                    raise RpcError(f"unexpected response for {ext}", 500)
+                with open(base + ext + ".cpy", "wb") as f:
+                    f.write(data)
+                fetched.append(ext)
+        except RpcError:
+            for ext in fetched:
+                try:
+                    os.remove(base + ext + ".cpy")
+                except FileNotFoundError:
+                    pass
+            raise
+        for ext in fetched:
+            os.replace(base + ext + ".cpy", base + ext)
+        loc.add_volume(vid, collection)
+        self._try_heartbeat()
+        return {"last_append_at_ns":
+                self.store.find_volume(vid).last_append_at_ns}
+
+    def _h_volume_status(self, req: Request):
+        """VolumeStatus + ReadVolumeFileStatus."""
+        v = self._volume_or_404(int(req.param("volume", "0")))
+        with v.lock:
+            v.nm.flush()
+        return {
+            "volume": v.id,
+            "last_append_at_ns": v.last_append_at_ns,
+            "compaction_revision": v.super_block.compaction_revision,
+            "dat_size": v.data.size(),
+            "idx_size": v.index_file_size(),
+            "file_count": v.file_count(),
+            "read_only": v.read_only,
+        }
+
+    def _h_volume_tail(self, req: Request):
+        """VolumeTailSender: raw needle records appended after since_ns."""
+        v = self._volume_or_404(int(req.param("volume", "0")))
+        since_ns = int(req.param("since_ns", "0"))
+        limit = int(req.param("limit", str(64 << 20)))
+        blob, last_ns = volume_backup.read_appended_bytes(v, since_ns, limit)
+        return Response(blob, headers={"X-Last-Append-At-Ns": str(last_ns)})
+
+    def _h_volume_sync(self, req: Request):
+        """VolumeIncrementalCopy client side: catch this replica up from a
+        source replica (volume_backup.go IncrementalBackup)."""
+        p = req.json()
+        v = self._volume_or_404(int(p["volume"]))
+        source = p["source"]
+
+        def fetch(since_ns: int) -> bytes:
+            data = call(source,
+                        f"/admin/volume/tail?volume={v.id}"
+                        f"&since_ns={since_ns}", timeout=600)
+            return data if isinstance(data, (bytes, bytearray)) else b""
+
+        applied = volume_backup.incremental_backup(v, fetch)
+        return {"applied": applied,
+                "last_append_at_ns": v.last_append_at_ns}
+
+    def _h_volume_read_all(self, req: Request):
+        """ReadAllNeedles: stream every live needle's metadata as NDJSON
+        (volume_grpc_read_all.go; drives volume.fsck)."""
+        v = self._volume_or_404(int(req.param("volume", "0")))
+        include_deleted = req.param("deleted") == "true"
+        lines = []
+        for n, offset in v.scan():
+            if not include_deleted and not n.data and n.size == 0:
+                continue
+            lines.append(json.dumps({
+                "id": n.id, "cookie": n.cookie, "size": len(n.data),
+                "offset": offset, "crc": n.checksum,
+                "append_at_ns": n.append_at_ns}))
+        return Response(("\n".join(lines) + "\n").encode(),
+                        content_type="application/x-ndjson")
+
+    def _h_batch_delete(self, req: Request):
+        """BatchDelete (volume_grpc_batch_delete.go): many fids, one call.
+        On a jwt-secured cluster each fid needs write authorization."""
+        fids = req.json().get("fids", [])
+        token = token_from_request(req.headers, req.query)
+        results = []
+        for fid in fids:
+            try:
+                self.guard.verify_write(token, fid)
+            except PermissionError as e:
+                results.append({"fid": fid, "status": 401, "error": str(e)})
+                continue
+            try:
+                vid, nid, cookie = t.parse_file_id(fid)
+            except ValueError as e:
+                results.append({"fid": fid, "status": 400, "error": str(e)})
+                continue
+            try:
+                size = self.store.delete_needle(
+                    vid, Needle(id=nid, cookie=cookie))
+                results.append({"fid": fid, "status": 200, "size": size})
+            except NotFoundError:
+                results.append({"fid": fid, "status": 404,
+                                "error": "volume not found"})
+            except VolumeError as e:
+                results.append({"fid": fid, "status": 500, "error": str(e)})
+        return {"results": results}
+
     # -- EC handlers (volume_grpc_erasure_coding.go) -------------------------
     def _h_ec_generate(self, req: Request):
         self.store.ec_generate(int(req.json()["volume"]))
@@ -348,8 +512,6 @@ class VolumeServer:
         collection = p.get("collection", "")
         shard_ids = [int(s) for s in p["shard_ids"]]
         self.store.ec_unmount(vid, shard_ids)
-        import os
-
         for loc in self.store.locations:
             base = loc._base_name(collection, vid)
             for sid in shard_ids:
@@ -387,14 +549,18 @@ class VolumeServer:
         return {}
 
     def _h_ec_shard_file(self, req: Request):
-        import os
-
         vid = int(req.param("volume", "0"))
         collection = req.param("collection", "") or ""
         ext = req.param("ext", "")
         if not ext.startswith(".ec") and ext not in (".ecx", ".ecj", ".vif",
                                                      ".dat", ".idx"):
             raise RpcError(f"disallowed ext {ext}", 400)
+        if ext in (".dat", ".idx"):
+            v = self.store.find_volume(vid)
+            if v is not None:
+                with v.lock:
+                    v.nm.flush()
+                    v.data.sync()
         for loc in self.store.locations:
             path = loc._base_name(collection, vid) + ext
             if os.path.exists(path):

@@ -1,0 +1,201 @@
+"""Volume copy / tail / incremental backup / batch delete / read-all.
+
+Mirrors the reference's volume_backup_test.go (binary search by append
+timestamp) plus the copy/tail volume-server RPC surface
+(volume_grpc_copy.go, volume_grpc_tail.go, volume_grpc_batch_delete.go).
+"""
+
+import json
+import os
+import time
+
+import pytest
+
+from seaweedfs_tpu.storage.needle import Needle
+from seaweedfs_tpu.storage.volume import Volume
+from seaweedfs_tpu.storage import volume_backup as vb
+
+
+def make_needle(nid, data, cookie=0x1234):
+    n = Needle.create(data)
+    n.id, n.cookie = nid, cookie
+    return n
+
+
+class TestBinarySearch:
+    def test_finds_first_after_timestamp(self, tmp_path):
+        v = Volume(str(tmp_path), "", 1)
+        stamps = []
+        offsets = []
+        for i in range(1, 20):
+            off, _, _ = v.write_needle(make_needle(i, b"x%d" % i))
+            offsets.append(off)
+            stamps.append(v.last_append_at_ns)
+        # before everything -> first needle's offset
+        assert vb.binary_search_by_append_at_ns(v, 0) == offsets[0]
+        # mid: strictly-after semantics
+        for i in (0, 5, 17):
+            found = vb.binary_search_by_append_at_ns(v, stamps[i])
+            if i + 1 < len(offsets):
+                assert found == offsets[i + 1]
+        # after everything -> dat size (caught up)
+        assert vb.binary_search_by_append_at_ns(
+            v, stamps[-1]) == v.data.size()
+        v.close()
+
+    def test_with_tombstones(self, tmp_path):
+        v = Volume(str(tmp_path), "", 1)
+        for i in range(1, 10):
+            v.write_needle(make_needle(i, b"d%d" % i))
+        mark = v.last_append_at_ns
+        v.delete_needle(make_needle(3, b""))
+        v.write_needle(make_needle(10, b"new"))
+        found = vb.binary_search_by_append_at_ns(v, mark)
+        # the next record after `mark` is the tombstone append
+        blob, _ = vb.read_appended_bytes(v, mark)
+        assert len(blob) == v.data.size() - found
+        v.close()
+
+
+class TestTruncatedTail:
+    def test_cursor_points_at_last_included_record(self, tmp_path):
+        """A limit-truncated read must resume exactly where it stopped."""
+        v = Volume(str(tmp_path), "", 1)
+        for i in range(1, 51):
+            v.write_needle(make_needle(i, os.urandom(200)))
+        collected = []
+        cursor = 0
+        for _ in range(100):
+            blob, cursor = vb.read_appended_bytes(v, cursor, limit=1000)
+            if not blob:
+                break
+            collected.append(blob)
+        full, _ = vb.read_appended_bytes(v, 0, limit=1 << 30)
+        assert b"".join(collected) == full
+        v.close()
+
+
+class TestIncrementalBackup:
+    def test_replicate_appends_and_deletes(self, tmp_path):
+        (tmp_path / "src").mkdir()
+        (tmp_path / "dst").mkdir()
+        src = Volume(str(tmp_path / "src"), "", 1)
+        dst = Volume(str(tmp_path / "dst"), "", 1)
+        for i in range(1, 30):
+            src.write_needle(make_needle(i, os.urandom(50)))
+        src.delete_needle(make_needle(7, b""))
+
+        def fetch(since_ns):
+            blob, _ = vb.read_appended_bytes(src, since_ns)
+            return blob
+
+        applied = vb.incremental_backup(dst, fetch)
+        assert applied == 30  # 29 writes + 1 tombstone
+        assert dst.file_count() == src.file_count()
+        for i in range(1, 30):
+            if i == 7:
+                with pytest.raises(Exception):
+                    dst.read_needle(i)
+            else:
+                assert dst.read_needle(i).data == src.read_needle(i).data
+        # catch-up is idempotent
+        assert vb.incremental_backup(dst, fetch) == 0
+        # new appends flow incrementally
+        src.write_needle(make_needle(100, b"late"))
+        assert vb.incremental_backup(dst, fetch) == 1
+        assert dst.read_needle(100).data == b"late"
+        src.close()
+        dst.close()
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    from seaweedfs_tpu.master.server import MasterServer
+    from seaweedfs_tpu.volume_server.server import VolumeServer
+
+    m = MasterServer(port=0)
+    m.start()
+    servers = []
+    for sub in ("a", "b"):
+        d = tmp_path / sub
+        d.mkdir()
+        vs = VolumeServer([str(d)], m.address, port=0)
+        vs.start()
+        vs.heartbeat_once()
+        servers.append(vs)
+    yield m, servers
+    for vs in servers:
+        vs.stop()
+    m.stop()
+
+
+class TestVolumeServerRpcs:
+    def test_copy_tail_sync(self, cluster):
+        from seaweedfs_tpu.rpc.http_rpc import call
+
+        m, (a, b) = cluster
+        call(a.address, "/admin/assign_volume",
+             {"volume": 7, "collection": ""})
+        fids = []
+        for i in range(5):
+            fid = f"7,{i+1:x}00001234"
+            call(a.address, f"/{fid}", raw=b"payload%d" % i, method="POST")
+            fids.append(fid)
+        # copy the whole volume to server b
+        call(b.address, "/admin/volume/copy",
+             {"volume": 7, "source": a.address})
+        got = call(b.address, f"/{fids[0]}")
+        assert got == b"payload0"
+        # append more on a (type=replicate suppresses fan-out so b stays
+        # behind), then sync b incrementally
+        call(a.address, "/7,600001234?type=replicate", raw=b"late-write",
+             method="POST")
+        r = call(b.address, "/admin/volume/sync",
+                 {"volume": 7, "source": a.address})
+        assert r["applied"] >= 1
+        assert call(b.address, "/7,600001234") == b"late-write"
+
+    def test_status_and_read_all(self, cluster):
+        from seaweedfs_tpu.rpc.http_rpc import call
+
+        m, (a, _) = cluster
+        call(a.address, "/admin/assign_volume", {"volume": 9})
+        for i in range(3):
+            call(a.address, f"/9,{i+1:x}12345678", raw=b"z" * 10, method="POST")
+        st = call(a.address, "/admin/volume/status?volume=9")
+        assert st["file_count"] == 3
+        assert st["last_append_at_ns"] > 0
+        nd = call(a.address, "/admin/volume/read_all?volume=9")
+        lines = [json.loads(x) for x in nd.decode().strip().splitlines()]
+        assert {e["id"] for e in lines} == {1, 2, 3}
+
+    def test_batch_delete(self, cluster):
+        from seaweedfs_tpu.rpc.http_rpc import call
+
+        m, (a, _) = cluster
+        call(a.address, "/admin/assign_volume", {"volume": 11})
+        fids = []
+        for i in range(4):
+            fid = f"11,{i+1:x}12345678"
+            call(a.address, f"/{fid}", raw=b"del-me", method="POST")
+            fids.append(fid)
+        r = call(a.address, "/admin/batch_delete",
+                 {"fids": fids + ["999,112345678", "garbage"]})
+        by_fid = {x["fid"]: x for x in r["results"]}
+        for fid in fids:
+            assert by_fid[fid]["status"] == 200
+            assert by_fid[fid]["size"] > 0
+        assert by_fid["999,112345678"]["status"] == 404
+        assert by_fid["garbage"]["status"] == 400
+
+    def test_mount_unmount(self, cluster):
+        from seaweedfs_tpu.rpc.http_rpc import RpcError, call
+
+        m, (a, _) = cluster
+        call(a.address, "/admin/assign_volume", {"volume": 13})
+        call(a.address, "/13,112345678", raw=b"keep", method="POST")
+        call(a.address, "/admin/volume/unmount", {"volume": 13})
+        with pytest.raises(RpcError):
+            call(a.address, "/13,112345678")
+        call(a.address, "/admin/volume/mount", {"volume": 13})
+        assert call(a.address, "/13,112345678") == b"keep"
