@@ -48,6 +48,8 @@ class FilerServer:
         self.server = RpcServer(host, port)
         self.server.add("GET", "/metadata/subscribe", self._h_subscribe)
         self.server.default_route = self._handle
+        self._stop_event = threading.Event()
+        self._register_thread: Optional[threading.Thread] = None
 
     @property
     def address(self) -> str:
@@ -55,10 +57,29 @@ class FilerServer:
 
     def start(self):
         self.server.start()
+        self._register_thread = threading.Thread(
+            target=self._register_loop, daemon=True)
+        self._register_thread.start()
 
     def stop(self):
+        self._stop_event.set()
         self.server.stop()
         self.filer.store.close()
+
+    def _register_loop(self):
+        """Announce this filer in the master's cluster registry
+        (cluster.go KeepConnected membership).  The refresh interval tracks
+        the master's pulse so liveness cutoffs (pulse*3) always see us."""
+        interval = 5.0
+        while not self._stop_event.is_set():
+            try:
+                r = call(self.master_address, "/cluster/register",
+                         {"type": "filer", "address": self.address},
+                         timeout=10)
+                interval = min(5.0, float(r.get("pulse_seconds", 5.0)))
+            except RpcError:
+                pass
+            self._stop_event.wait(interval)
 
     # -- volume cluster plumbing ---------------------------------------------
     def _assign(self, count: int = 1) -> dict:

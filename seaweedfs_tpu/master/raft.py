@@ -1,0 +1,255 @@
+"""Raft consensus for the master control plane.
+
+The reference runs hashicorp/raft with a deliberately tiny FSM: the only
+replicated state is MaxVolumeId (weed/server/raft_server.go:52-100 — the
+FSM's Apply handles one command type, MaxVolumeIdCommand), persisted in
+boltdb with leader election deciding which master may assign volume ids.
+
+This implementation keeps that shape: full leader election (randomized
+timeouts, term voting) with the single-integer FSM shipped inline on every
+AppendEntries — because the state is one monotonically-increasing integer
+and only the leader mutates it, the heartbeat IS the log replication, and
+a majority ack of the new value before use gives the same linearizable
+volume-id allocation the reference gets from raft.Apply.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import threading
+import time
+from typing import Callable, Optional
+
+from ..rpc.http_rpc import RpcError, call
+from ..util import glog
+
+FOLLOWER, CANDIDATE, LEADER = "follower", "candidate", "leader"
+
+
+class RaftNode:
+    def __init__(self, self_address: str, peers: list[str],
+                 state_dir: str = "",
+                 election_timeout: float = 0.8,
+                 heartbeat_interval: float = 0.25):
+        """peers includes self_address."""
+        self.address = self_address
+        self.peers = sorted(set(peers) | {self_address})
+        self.state_dir = state_dir
+        self.election_timeout = election_timeout
+        self.heartbeat_interval = heartbeat_interval
+
+        self.lock = threading.RLock()
+        self.state = FOLLOWER
+        self.term = 0
+        self.voted_for: Optional[str] = None
+        self.leader: Optional[str] = None
+        self.max_volume_id = 0
+        self.on_become_leader: Optional[Callable[[], None]] = None
+
+        self._last_heard = time.monotonic()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._load_state()
+        if len(self.peers) > 1 and not self.state_dir:
+            # raft safety requires durable term/vote: a restarted node with
+            # amnesia can double-vote in one term and elect two leaders
+            glog.warningf(
+                "raft: %d-peer cluster without -mdir: term/vote state is "
+                "NOT persisted; a master restart can elect split leaders",
+                len(self.peers))
+
+    # -- persistence (raft_server.go boltdb store analogue) ------------------
+    def _state_path(self) -> str:
+        return os.path.join(self.state_dir, "raft_state.json")
+
+    def _load_state(self):
+        if not self.state_dir:
+            return
+        try:
+            with open(self._state_path()) as f:
+                d = json.load(f)
+            self.term = int(d.get("term", 0))
+            self.voted_for = d.get("voted_for")
+            self.max_volume_id = int(d.get("max_volume_id", 0))
+        except (OSError, ValueError):
+            pass
+
+    def _save_state(self):
+        if not self.state_dir:
+            return
+        tmp = self._state_path() + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"term": self.term, "voted_for": self.voted_for,
+                       "max_volume_id": self.max_volume_id}, f)
+        os.replace(tmp, self._state_path())
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self):
+        if len(self.peers) == 1:
+            # single-node cluster: immediately leader (no quorum needed)
+            with self.lock:
+                self.state = LEADER
+                self.leader = self.address
+            if self.on_become_leader:
+                self.on_become_leader()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+
+    @property
+    def is_leader(self) -> bool:
+        return self.state == LEADER
+
+    def quorum(self) -> int:
+        return len(self.peers) // 2 + 1
+
+    # -- main loop -----------------------------------------------------------
+    def _run(self):
+        while not self._stop.is_set():
+            if self.state == LEADER:
+                self._broadcast_heartbeat()
+                self._stop.wait(self.heartbeat_interval)
+            else:
+                timeout = self.election_timeout * (1 + random.random())
+                self._stop.wait(0.05)
+                if time.monotonic() - self._last_heard > timeout:
+                    self._campaign()
+
+    def _campaign(self):
+        with self.lock:
+            self.state = CANDIDATE
+            self.term += 1
+            self.voted_for = self.address
+            self.leader = None
+            term = self.term
+            self._save_state()
+        votes = 1
+        for peer in self.peers:
+            if peer == self.address:
+                continue
+            try:
+                r = call(peer, "/raft/request_vote",
+                         {"term": term, "candidate": self.address,
+                          "max_volume_id": self.max_volume_id},
+                         timeout=1)
+                if r.get("granted"):
+                    votes += 1
+                elif r.get("term", 0) > term:
+                    self._step_down(r["term"])
+                    return
+            except RpcError:
+                continue
+        with self.lock:
+            if self.state != CANDIDATE or self.term != term:
+                return
+            if votes >= self.quorum():
+                glog.infof("raft: %s elected leader for term %d (%d votes)",
+                           self.address, term, votes)
+                self.state = LEADER
+                self.leader = self.address
+            else:
+                self.state = FOLLOWER
+                self._last_heard = time.monotonic()
+                return
+        if self.on_become_leader:
+            self.on_become_leader()
+        self._broadcast_heartbeat()
+
+    def _step_down(self, term: int):
+        with self.lock:
+            if term > self.term:
+                self.term = term
+                self.voted_for = None
+                self._save_state()
+            if self.state != FOLLOWER:
+                glog.infof("raft: %s stepping down at term %d",
+                           self.address, term)
+            self.state = FOLLOWER
+            self._last_heard = time.monotonic()
+
+    def _broadcast_heartbeat(self) -> int:
+        """Returns the number of peers (incl. self) sharing our state."""
+        with self.lock:
+            payload = {"term": self.term, "leader": self.address,
+                       "max_volume_id": self.max_volume_id}
+        acked = 1
+        for peer in self.peers:
+            if peer == self.address:
+                continue
+            try:
+                r = call(peer, "/raft/append_entries", payload, timeout=1)
+                if r.get("term", 0) > payload["term"]:
+                    self._step_down(r["term"])
+                    return acked
+                if r.get("ok"):
+                    acked += 1
+            except RpcError:
+                continue
+        return acked
+
+    # -- RPC handlers --------------------------------------------------------
+    def handle_request_vote(self, req: dict) -> dict:
+        term = int(req["term"])
+        candidate = req["candidate"]
+        candidate_state = int(req.get("max_volume_id", 0))
+        with self.lock:
+            if term < self.term:
+                return {"granted": False, "term": self.term}
+            if term > self.term:
+                self.term = term
+                self.voted_for = None
+                if self.state != FOLLOWER:
+                    self.state = FOLLOWER
+            if (self.voted_for in (None, candidate)
+                    and candidate_state >= self.max_volume_id):
+                self.voted_for = candidate
+                self._last_heard = time.monotonic()
+                self._save_state()
+                return {"granted": True, "term": self.term}
+            self._save_state()
+            return {"granted": False, "term": self.term}
+
+    def handle_append_entries(self, req: dict) -> dict:
+        term = int(req["term"])
+        with self.lock:
+            if term < self.term:
+                return {"ok": False, "term": self.term}
+            if term > self.term:
+                self.term = term
+                self.voted_for = None
+                self._save_state()
+            self.state = FOLLOWER
+            self.leader = req["leader"]
+            self._last_heard = time.monotonic()
+            incoming = int(req.get("max_volume_id", 0))
+            if incoming > self.max_volume_id:
+                self.max_volume_id = incoming
+                self._save_state()
+            return {"ok": True, "term": self.term}
+
+    # -- the FSM: MaxVolumeId allocation (raft_server.go:78) -----------------
+    def next_volume_id(self) -> int:
+        """Allocate the next volume id, majority-replicated before use."""
+        with self.lock:
+            if self.state != LEADER:
+                raise RpcError("not raft leader", 409)
+            self.max_volume_id += 1
+            vid = self.max_volume_id
+            self._save_state()
+        if len(self.peers) > 1:
+            acked = self._broadcast_heartbeat()
+            if acked < self.quorum():
+                raise RpcError(
+                    f"volume id {vid} not replicated to quorum", 503)
+        return vid
+
+    def observe_volume_id(self, vid: int):
+        """Fold in a volume id seen in a heartbeat (SetMax semantics)."""
+        with self.lock:
+            if vid > self.max_volume_id:
+                self.max_volume_id = vid
+                self._save_state()

@@ -14,6 +14,7 @@ from __future__ import annotations
 import random
 import threading
 import time
+import urllib.parse
 from typing import Optional
 
 from ..rpc.http_rpc import RpcError, RpcServer, call
@@ -23,6 +24,7 @@ from ..storage import types as t
 from ..storage.super_block import ReplicaPlacement
 from ..storage.ttl import TTL
 from . import volume_growth
+from .raft import RaftNode
 from .topology import Topology
 from .volume_growth import VolumeGrowOption
 
@@ -33,7 +35,10 @@ class MasterServer:
                  default_replication: str = "000",
                  pulse_seconds: float = 5.0,
                  garbage_threshold: float = 0.3,
-                 guard: Optional[Guard] = None):
+                 guard: Optional[Guard] = None,
+                 peers: Optional[list[str]] = None,
+                 raft_dir: str = "",
+                 raft_election_timeout: float = 0.8):
         self.topo = Topology(
             volume_size_limit=volume_size_limit_mb * 1024 * 1024,
             pulse_seconds=pulse_seconds)
@@ -41,6 +46,24 @@ class MasterServer:
         self.garbage_threshold = garbage_threshold
         self.guard = guard or Guard()
         self.server = RpcServer(host, port)
+        self.raft = RaftNode(self.server.address,
+                             (peers or []) + [self.server.address],
+                             state_dir=raft_dir,
+                             election_timeout=raft_election_timeout)
+        self.topo.vid_allocator = self.raft.next_volume_id
+        self.topo.max_volume_id = self.raft.max_volume_id
+        # location-change feed for /dir/watch long-polls (KeepConnected).
+        # feed_id identifies THIS master's sequence space: watch clients
+        # must reset their cursor when it changes (failover to a peer)
+        self._changes: list[tuple[int, dict]] = []
+        self._change_seq = 0
+        self._change_cond = threading.Condition()
+        self._feed_id = f"{self.server.address}/{random.getrandbits(32):08x}"
+        self.topo.on_change = self._record_change
+        # cluster membership registry (cluster/cluster.go) + admin locks
+        self._members: dict[tuple[str, str], dict] = {}
+        self._admin_locks: dict[str, dict] = {}
+        self._admin_locks_mutex = threading.Lock()
         self._register_routes()
         self._reaper: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -53,11 +76,15 @@ class MasterServer:
     # -- lifecycle -----------------------------------------------------------
     def start(self):
         self.server.start()
+        self.raft.start()
         self._reaper = threading.Thread(target=self._reap_loop, daemon=True)
         self._reaper.start()
 
     def stop(self):
         self._stop.set()
+        self.raft.stop()
+        with self._change_cond:
+            self._change_cond.notify_all()
         self.server.stop()
 
     def _reap_loop(self):
@@ -88,19 +115,76 @@ class MasterServer:
         s.add("GET", "/vol/status", g(lambda r: self.topo.to_dict()))
         s.add("GET", "/ec/lookup", self._handle_ec_lookup)
         s.add("GET", "/metrics", stats.metrics_handler)
+        s.add("POST", "/raft/request_vote",
+              lambda r: self.raft.handle_request_vote(r.json()))
+        s.add("POST", "/raft/append_entries",
+              lambda r: self.raft.handle_append_entries(r.json()))
+        s.add("GET", "/raft/status", self._handle_raft_status)
+        s.add("GET", "/dir/watch", self._handle_watch)
+        s.add("POST", "/cluster/register", self._handle_cluster_register)
+        s.add("GET", "/cluster/nodes", self._handle_cluster_nodes)
+        s.add("POST", "/admin/lock", g(self._handle_admin_lock))
+        s.add("POST", "/admin/unlock", g(self._handle_admin_unlock))
 
     # -- heartbeat (master_grpc_server.go:60-170) ----------------------------
     def _handle_heartbeat(self, req):
         hb = req.json()
         stats.MasterReceivedHeartbeatCounter.labels("total").inc()
         self.topo.process_heartbeat(hb)
+        # keep the raft FSM aware of ids observed on disk (SetMax analogue)
+        self.raft.observe_volume_id(self.topo.max_volume_id)
         return {
             "volume_size_limit": self.topo.volume_size_limit,
-            "leader": True,
+            "leader": self.raft.is_leader,
+            "leader_address": self.raft.leader or self.address,
         }
+
+    def _record_change(self, delta: dict):
+        with self._change_cond:
+            self._change_seq += 1
+            self._changes.append((self._change_seq, delta))
+            if len(self._changes) > 10000:
+                del self._changes[:5000]
+            self._change_cond.notify_all()
+
+    def _handle_watch(self, req):
+        """KeepConnected analogue: long-poll volume-location deltas
+        (master_grpc_server.go broadcasts VolumeLocation to subscribers)."""
+        since = int(req.param("since", "0"))
+        timeout = min(float(req.param("timeout", "30")), 60.0)
+        deadline = time.time() + timeout
+        with self._change_cond:
+            while (not self._stop.is_set()
+                   and self._change_seq <= since
+                   and time.time() < deadline):
+                self._change_cond.wait(min(1.0, deadline - time.time()))
+            # snapshot seq INSIDE the lock: reporting a seq newer than the
+            # delta list would make the client skip that delta forever
+            deltas = [{"seq": s, **d} for s, d in self._changes if s > since]
+            seq = self._change_seq
+            oldest = self._changes[0][0] if self._changes else 0
+        return {"seq": seq, "deltas": deltas,
+                "feed_id": self._feed_id,
+                "leader": self.raft.leader or self.address,
+                # a client whose `since` predates the retained window must
+                # do a full resync via /dir/lookup
+                "resync": bool(since and oldest and since + 1 < oldest)}
+
+    def _proxy_to_leader(self, req, path: str):
+        """Non-leader masters forward to the raft leader
+        (master_server.go proxyToLeader)."""
+        leader = self.raft.leader
+        if not leader or leader == self.address:
+            raise RpcError("no raft leader elected yet", 503)
+        query = urllib.parse.urlencode(req.query)
+        return call(leader, path + ("?" + query if query else ""),
+                    method="POST" if req.body else "GET",
+                    raw=req.body or None, timeout=30)
 
     # -- assign (master_server_handlers.go:102-165) --------------------------
     def _handle_assign(self, req):
+        if not self.raft.is_leader:
+            return self._proxy_to_leader(req, "/dir/assign")
         count = int(req.param("count", "1"))
         collection = req.param("collection", "") or ""
         replication = req.param("replication") or self.default_replication
@@ -155,6 +239,8 @@ class MasterServer:
             return grown
 
     def _handle_grow(self, req):
+        if not self.raft.is_leader:
+            return self._proxy_to_leader(req, "/vol/grow")
         collection = req.param("collection", "") or ""
         replication = req.param("replication") or self.default_replication
         count = req.param("count")
@@ -190,10 +276,76 @@ class MasterServer:
 
     def _handle_cluster_status(self, req):
         return {
-            "IsLeader": True,
-            "Leader": self.address,
+            "IsLeader": self.raft.is_leader,
+            "Leader": self.raft.leader or "",
+            "Peers": self.raft.peers,
             "MaxVolumeId": self.topo.max_volume_id,
         }
+
+    def _handle_raft_status(self, req):
+        """cluster.raft.ps surface (shell/command_cluster_raft_ps.go)."""
+        return {
+            "id": self.raft.address,
+            "state": self.raft.state,
+            "term": self.raft.term,
+            "leader": self.raft.leader or "",
+            "peers": self.raft.peers,
+            "max_volume_id": self.raft.max_volume_id,
+        }
+
+    # -- cluster membership (cluster/cluster.go, KeepConnected registry) -----
+    def _handle_cluster_register(self, req):
+        p = req.json()
+        key = (p.get("type", "filer"), p["address"])
+        self._members[key] = {
+            "type": key[0], "address": key[1],
+            "group": p.get("group", ""),
+            "last_seen": time.time(),
+        }
+        return {"leader": self.raft.leader or self.address,
+                "pulse_seconds": self.topo.pulse_seconds}
+
+    def _handle_cluster_nodes(self, req):
+        kind = req.param("type", "filer")
+        cutoff = time.time() - self.topo.pulse_seconds * 3
+        alive = [dict(m) for (k, _), m in self._members.items()
+                 if k == kind and m["last_seen"] >= cutoff]
+        for m in alive:
+            m.pop("last_seen", None)
+        return {"cluster_nodes": alive}
+
+    # -- admin locks (LeaseAdminToken, master_grpc_server_admin.go) ----------
+    ADMIN_LOCK_TTL = 10.0
+
+    def _handle_admin_lock(self, req):
+        p = req.json()
+        name = p.get("name", "admin")
+        client = p.get("client", "")
+        prev_token = int(p.get("token", 0))
+        now = time.time()
+        with self._admin_locks_mutex:
+            lock = self._admin_locks.get(name)
+            if (lock is not None and lock["expires"] > now
+                    and lock["token"] != prev_token):
+                raise RpcError(
+                    f"lock {name} held by {lock['client']}", 423)
+            token = prev_token if (lock is not None
+                                   and lock.get("token") == prev_token
+                                   ) else random.getrandbits(63)
+            self._admin_locks[name] = {
+                "token": token, "client": client,
+                "expires": now + self.ADMIN_LOCK_TTL,
+            }
+        return {"token": token, "expires_at": now + self.ADMIN_LOCK_TTL}
+
+    def _handle_admin_unlock(self, req):
+        p = req.json()
+        name = p.get("name", "admin")
+        with self._admin_locks_mutex:
+            lock = self._admin_locks.get(name)
+            if lock is not None and lock["token"] == int(p.get("token", 0)):
+                del self._admin_locks[name]
+        return {}
 
     # -- vacuum orchestration (topology_vacuum.go) ---------------------------
     def _handle_vacuum(self, req):

@@ -12,7 +12,7 @@ from __future__ import annotations
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Optional
+from typing import Callable, Optional
 
 from ..storage.erasure_coding.ec_volume import ShardBits
 from ..storage.super_block import ReplicaPlacement
@@ -165,6 +165,10 @@ class Topology:
         self.max_volume_id = 0
         self.volume_size_limit = volume_size_limit
         self.pulse_seconds = pulse_seconds
+        # optional hooks: raft-backed id allocation and location-change
+        # notifications (KeepConnected push, master_grpc_server.go:63-93)
+        self.vid_allocator: Optional[Callable[[], int]] = None
+        self.on_change: Optional[Callable[[dict], None]] = None
 
     # -- registration (master_grpc_server.go heartbeat ingest) ---------------
     def process_heartbeat(self, hb: dict) -> DataNode:
@@ -211,14 +215,21 @@ class Topology:
             return node
 
     def _register_volume(self, v: VolumeInfo, node: DataNode):
+        is_new = v.id not in node.volumes
         node.volumes[v.id] = v
         layout = self._layout_for(v.collection, v.replica_placement, v.ttl)
         layout.register(v, node)
+        if is_new and self.on_change:
+            self.on_change({"op": "add", "volume": v.id,
+                            "url": node.url, "publicUrl": node.public_url})
 
     def _unregister_volume(self, v: VolumeInfo, node: DataNode):
         node.volumes.pop(v.id, None)
         layout = self._layout_for(v.collection, v.replica_placement, v.ttl)
         layout.unregister(v.id, node)
+        if self.on_change:
+            self.on_change({"op": "remove", "volume": v.id,
+                            "url": node.url, "publicUrl": node.public_url})
 
     def _register_ec(self, vid: int, collection: str, bits: ShardBits,
                      node: DataNode):
@@ -337,6 +348,11 @@ class Topology:
                                     ttl).active_writable_count()
 
     def next_volume_id(self) -> int:
+        if self.vid_allocator is not None:
+            vid = self.vid_allocator()  # raft boundary (topology.go:138)
+            with self.lock:
+                self.max_volume_id = max(self.max_volume_id, vid)
+            return vid
         with self.lock:
             self.max_volume_id += 1
             return self.max_volume_id

@@ -49,7 +49,10 @@ class VolumeServer:
                  pulse_seconds: float = 5.0, ec_encoder_backend=None,
                  guard: Optional[Guard] = None):
         self.server = RpcServer(host, port)
-        self.master_address = master_address
+        # the configured seed list survives leader redirects so a dead
+        # leader never strands the heartbeat loop
+        self._seed_masters = [m for m in master_address.split(",") if m]
+        self.master_address = self._seed_masters[0]
         self.pulse_seconds = pulse_seconds
         self.guard = guard or Guard()
         self.store = Store(
@@ -85,10 +88,24 @@ class VolumeServer:
 
     def heartbeat_once(self):
         hb = self.store.collect_heartbeat()
-        resp = call(self.master_address, "/api/heartbeat", hb,
-                    timeout=10)
-        self.store.volume_size_limit = resp.get("volume_size_limit", 0)
-        return resp
+        targets = [self.master_address] + [
+            m for m in self._seed_masters if m != self.master_address]
+        last_err = None
+        for target in targets:
+            try:
+                resp = call(target, "/api/heartbeat", hb, timeout=10)
+            except RpcError as e:
+                last_err = e
+                continue
+            self.master_address = target
+            self.store.volume_size_limit = resp.get("volume_size_limit", 0)
+            # raft leader failover (volume_grpc_client_to_master.go:46-76):
+            # keep heartbeating the leader so assigns see our volumes
+            leader = resp.get("leader_address")
+            if leader and not resp.get("leader", True):
+                self.master_address = leader
+            return resp
+        raise last_err or RpcError("no master reachable", 503)
 
     def _heartbeat_loop(self):
         while not self._stop.is_set():
@@ -334,10 +351,14 @@ class VolumeServer:
         if os.path.exists(base + ".dat"):
             raise RpcError(f"volume {vid} files already on disk", 409)
         # fetch to temp names; rename only once every file arrived, so a
-        # mid-copy failure leaves no stray .dat/.idx behind
+        # mid-copy failure leaves no stray .dat/.idx behind.  .idx first:
+        # writes that land between the two fetches then only extend the
+        # .dat, and the integrity check truncates that unreferenced tail
+        # on mount — the reverse order would leave the .idx pointing past
+        # the copied .dat's EOF
         fetched: list[str] = []
         try:
-            for ext in (".dat", ".idx", ".vif"):
+            for ext in (".idx", ".dat", ".vif"):
                 try:
                     data = call(source,
                                 f"/admin/ec/shard_file?volume={vid}"

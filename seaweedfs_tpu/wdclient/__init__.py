@@ -1,0 +1,3 @@
+from .masterclient import MasterClient, VidMap
+
+__all__ = ["MasterClient", "VidMap"]

@@ -1,0 +1,157 @@
+"""Master client with a vid→locations cache kept fresh by the watch feed.
+
+Parity with weed/wdclient: MasterClient holds a vidMap refreshed by the
+KeepConnected stream's VolumeLocation deltas (masterclient.go:20-120); here
+the stream is the master's /dir/watch long-poll.  Lookup misses fall back
+to /dir/lookup and populate the cache (vid_map.go:38-120).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from typing import Optional
+
+from ..rpc.http_rpc import RpcError, call
+from ..util import glog
+
+
+class VidMap:
+    """vid -> [location dicts]; thread-safe."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._map: dict[int, list[dict]] = {}
+
+    def get(self, vid: int) -> list[dict]:
+        with self._lock:
+            return list(self._map.get(vid, []))
+
+    def set(self, vid: int, locations: list[dict]):
+        with self._lock:
+            self._map[vid] = list(locations)
+
+    def add(self, vid: int, url: str, public_url: str):
+        with self._lock:
+            locs = self._map.setdefault(vid, [])
+            if not any(l["url"] == url for l in locs):
+                locs.append({"url": url, "publicUrl": public_url})
+
+    def remove(self, vid: int, url: str):
+        with self._lock:
+            locs = self._map.get(vid)
+            if locs is None:
+                return
+            self._map[vid] = [l for l in locs if l["url"] != url]
+            if not self._map[vid]:
+                del self._map[vid]
+
+    def clear(self):
+        with self._lock:
+            self._map.clear()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._map)
+
+
+class MasterClient:
+    def __init__(self, masters: list[str] | str, name: str = "client"):
+        self.masters = ([masters] if isinstance(masters, str)
+                        else list(masters))
+        self.name = name
+        self.vid_map = VidMap()
+        self.current_master = self.masters[0]
+        self._seq = 0
+        self._feed_id = ""  # sequence-space identity of the watched master
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lookup (vid_map.go LookupVolumeServerUrl) ---------------------------
+    def lookup(self, vid: int) -> list[dict]:
+        cached = self.vid_map.get(vid)
+        if cached:
+            return cached
+        found = self._call_any(f"/dir/lookup?volumeId={vid}")
+        locations = found.get("locations", [])
+        if locations:
+            self.vid_map.set(vid, locations)
+        return locations
+
+    def lookup_file_id(self, fid: str) -> list[str]:
+        vid = int(fid.split(",")[0])
+        locations = self.lookup(vid)
+        if not locations:
+            raise RpcError(f"volume {vid} not found", 404)
+        return [f"{l['url']}/{fid}" for l in locations]
+
+    def assign(self, count: int = 1, replication: str = "",
+               collection: str = "", ttl: str = "") -> dict:
+        query = f"count={count}"
+        if replication:
+            query += f"&replication={replication}"
+        if collection:
+            query += f"&collection={collection}"
+        if ttl:
+            query += f"&ttl={ttl}"
+        return self._call_any(f"/dir/assign?{query}")
+
+    def _call_any(self, path: str, payload: Optional[dict] = None,
+                  timeout: float = 30):
+        """Try current master first, fail over through the list
+        (masterclient.go tryAllMasters)."""
+        masters = [self.current_master] + [
+            m for m in self.masters if m != self.current_master]
+        last_err: Optional[RpcError] = None
+        for m in masters:
+            try:
+                result = call(m, path, payload, timeout=timeout)
+                self.current_master = m
+                return result
+            except RpcError as e:
+                last_err = e
+                continue
+        raise last_err or RpcError("no master reachable", 503)
+
+    # -- keep-connected watch loop (masterclient.go KeepConnected) -----------
+    def start(self):
+        self._thread = threading.Thread(target=self._watch_loop, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+
+    def _watch_loop(self):
+        while not self._stop.is_set():
+            try:
+                r = call(self.current_master,
+                         f"/dir/watch?since={self._seq}&timeout=15",
+                         timeout=20)
+            except RpcError:
+                self.current_master = random.choice(self.masters)
+                self._stop.wait(1.0)
+                continue
+            feed_id = r.get("feed_id", "")
+            if feed_id != self._feed_id:
+                # different master (failover) = different sequence space:
+                # restart the cursor and drop everything cached
+                if self._feed_id:
+                    self.vid_map.clear()
+                    self._seq = 0
+                    self._feed_id = feed_id
+                    continue  # re-poll from 0 on the new feed
+                self._feed_id = feed_id
+            if r.get("resync"):
+                # fell off the retained delta window: drop the cache and
+                # let lookups repopulate it
+                self.vid_map.clear()
+            for d in r.get("deltas", []):
+                if d["op"] == "add":
+                    self.vid_map.add(d["volume"], d["url"],
+                                     d.get("publicUrl", d["url"]))
+                else:
+                    self.vid_map.remove(d["volume"], d["url"])
+            self._seq = max(self._seq, r.get("seq", self._seq))
+            leader = r.get("leader")
+            if leader and leader not in self.masters:
+                glog.v(1).infof("watch leader %s outside master list", leader)

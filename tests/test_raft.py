@@ -1,0 +1,221 @@
+"""Raft consensus, admin locks, cluster membership, watch feed, follower.
+
+Mirrors the reference's control-plane behavior: hashicorp/raft with a
+MaxVolumeId-only FSM (raft_server.go), LeaseAdminToken locks, the
+KeepConnected location stream, and the master_follower command.
+"""
+
+import socket
+import time
+
+import pytest
+
+from seaweedfs_tpu.master.server import MasterServer
+from seaweedfs_tpu.rpc.http_rpc import RpcError, call
+
+
+def free_ports(n):
+    socks = []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+def wait_for(pred, timeout=10.0, interval=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture
+def trio(tmp_path):
+    ports = free_ports(3)
+    addrs = [f"127.0.0.1:{p}" for p in ports]
+    masters = []
+    for i, p in enumerate(ports):
+        d = tmp_path / f"m{i}"
+        d.mkdir()
+        m = MasterServer(port=p, peers=[a for a in addrs],
+                         raft_dir=str(d), raft_election_timeout=0.3,
+                         pulse_seconds=1.0)
+        m.start()
+        masters.append(m)
+    yield masters
+    for m in masters:
+        m.stop()
+
+
+def leaders(masters):
+    return [m for m in masters if m.raft.is_leader]
+
+
+class TestRaftElection:
+    def test_exactly_one_leader(self, trio):
+        assert wait_for(lambda: len(leaders(trio)) == 1)
+        time.sleep(0.5)
+        assert len(leaders(trio)) == 1
+        leader = leaders(trio)[0]
+        for m in trio:
+            assert m.raft.leader == leader.address
+
+    def test_leader_failover_and_monotonic_vids(self, trio, tmp_path):
+        assert wait_for(lambda: len(leaders(trio)) == 1)
+        leader = leaders(trio)[0]
+        vids = [leader.raft.next_volume_id() for _ in range(5)]
+        assert vids == sorted(vids)
+        leader.stop()
+        rest = [m for m in trio if m is not leader]
+        assert wait_for(lambda: len(leaders(rest)) == 1, timeout=15)
+        new_leader = leaders(rest)[0]
+        v6 = new_leader.raft.next_volume_id()
+        assert v6 > vids[-1], "allocation must survive failover monotonically"
+
+    def test_non_leader_rejects_allocation(self, trio):
+        assert wait_for(lambda: len(leaders(trio)) == 1)
+        follower = next(m for m in trio if not m.raft.is_leader)
+        with pytest.raises(RpcError):
+            follower.raft.next_volume_id()
+
+    def test_assign_proxies_to_leader(self, trio, tmp_path):
+        from seaweedfs_tpu.volume_server.server import VolumeServer
+
+        assert wait_for(lambda: len(leaders(trio)) == 1)
+        leader = leaders(trio)[0]
+        vdir = tmp_path / "vol"
+        vdir.mkdir()
+        vs = VolumeServer([str(vdir)], leader.address, port=0,
+                          pulse_seconds=0.5)
+        vs.start()
+        try:
+            vs.heartbeat_once()
+            follower = next(m for m in trio if not m.raft.is_leader)
+            a = call(follower.address, "/dir/assign")
+            assert "fid" in a
+            call(a["url"], f"/{a['fid']}", raw=b"via-proxy", method="POST")
+            assert call(a["url"], f"/{a['fid']}") == b"via-proxy"
+        finally:
+            vs.stop()
+
+    def test_state_survives_restart(self, tmp_path):
+        d = tmp_path / "solo"
+        d.mkdir()
+        port = free_ports(1)[0]
+        m = MasterServer(port=port, raft_dir=str(d))
+        m.start()
+        for _ in range(7):
+            m.raft.next_volume_id()
+        m.stop()
+        time.sleep(0.2)
+        m2 = MasterServer(port=free_ports(1)[0], raft_dir=str(d))
+        m2.start()
+        try:
+            assert m2.raft.max_volume_id == 7
+            assert m2.raft.next_volume_id() == 8
+        finally:
+            m2.stop()
+
+
+class TestAdminLocks:
+    def test_lease_conflict_renew_release(self, tmp_path):
+        m = MasterServer(port=0)
+        m.start()
+        try:
+            r = call(m.address, "/admin/lock",
+                     {"name": "shell", "client": "alice"})
+            token = r["token"]
+            with pytest.raises(RpcError) as ei:
+                call(m.address, "/admin/lock",
+                     {"name": "shell", "client": "bob"})
+            assert ei.value.status == 423
+            # renewal with the same token succeeds and keeps the token
+            r2 = call(m.address, "/admin/lock",
+                      {"name": "shell", "client": "alice", "token": token})
+            assert r2["token"] == token
+            call(m.address, "/admin/unlock",
+                 {"name": "shell", "token": token})
+            r3 = call(m.address, "/admin/lock",
+                      {"name": "shell", "client": "bob"})
+            assert r3["token"] != token
+        finally:
+            m.stop()
+
+
+class TestClusterMembership:
+    def test_register_and_list(self):
+        m = MasterServer(port=0, pulse_seconds=1.0)
+        m.start()
+        try:
+            call(m.address, "/cluster/register",
+                 {"type": "filer", "address": "127.0.0.1:8888"})
+            nodes = call(m.address, "/cluster/nodes?type=filer")
+            assert {"type": "filer", "address": "127.0.0.1:8888",
+                    "group": ""} in nodes["cluster_nodes"]
+            assert call(m.address,
+                        "/cluster/nodes?type=broker")["cluster_nodes"] == []
+        finally:
+            m.stop()
+
+
+class TestWatchAndClient:
+    def test_watch_delivers_volume_deltas(self, tmp_path):
+        from seaweedfs_tpu.volume_server.server import VolumeServer
+
+        m = MasterServer(port=0, pulse_seconds=0.5)
+        m.start()
+        vs = VolumeServer([str(tmp_path)], m.address, port=0,
+                          pulse_seconds=0.3)
+        vs.start()
+        try:
+            call(vs.address, "/admin/assign_volume", {"volume": 42})
+            assert wait_for(lambda: call(
+                m.address, "/dir/watch?since=0&timeout=0.2"
+            ).get("deltas"))
+            deltas = call(m.address, "/dir/watch?since=0&timeout=0.2")
+            assert any(d["volume"] == 42 and d["op"] == "add"
+                       for d in deltas["deltas"])
+        finally:
+            vs.stop()
+            m.stop()
+
+    def test_master_client_cache_and_follower(self, tmp_path):
+        from seaweedfs_tpu.master.follower import MasterFollower
+        from seaweedfs_tpu.volume_server.server import VolumeServer
+        from seaweedfs_tpu.wdclient import MasterClient
+
+        m = MasterServer(port=0, pulse_seconds=0.5)
+        m.start()
+        vs = VolumeServer([str(tmp_path)], m.address, port=0,
+                          pulse_seconds=0.3)
+        vs.start()
+        mc = MasterClient(m.address)
+        mc.start()
+        follower = MasterFollower([m.address], port=0)
+        follower.start()
+        try:
+            vs.heartbeat_once()
+            a = mc.assign()
+            call(a["url"], f"/{a['fid']}", raw=b"cached", method="POST")
+            vid = int(a["fid"].split(",")[0])
+            # client lookup populates/uses the cache
+            urls = mc.lookup_file_id(a["fid"])
+            assert urls and urls[0].endswith(a["fid"])
+            # watch loop fills the cache without lookup
+            assert wait_for(lambda: len(mc.vid_map) > 0)
+            # follower serves lookups from its own cache
+            found = call(follower.address, f"/dir/lookup?volumeId={vid}")
+            assert found["locations"][0]["url"] == vs.store.url
+            fa = call(follower.address, "/dir/assign")
+            assert "fid" in fa
+        finally:
+            follower.stop()
+            mc.stop()
+            vs.stop()
+            m.stop()

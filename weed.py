@@ -56,14 +56,26 @@ def _load_guard():
 def cmd_master(args):
     from seaweedfs_tpu.master.server import MasterServer
 
+    peers = [p for p in args.peers.split(",") if p]
     m = MasterServer(host=args.ip, port=args.port,
                      volume_size_limit_mb=args.volumeSizeLimitMB,
                      default_replication=args.defaultReplication,
                      pulse_seconds=args.pulseSeconds,
-                     guard=_load_guard())
+                     guard=_load_guard(),
+                     peers=peers, raft_dir=args.mdir)
     m.start()
-    print(f"master listening on {m.address}")
+    print(f"master listening on {m.address}" +
+          (f", raft peers {m.raft.peers}" if peers else ""))
     _wait_forever([m])
+
+
+def cmd_master_follower(args):
+    from seaweedfs_tpu.master.follower import MasterFollower
+
+    f = MasterFollower(args.masters.split(","), host=args.ip, port=args.port)
+    f.start()
+    print(f"master follower on {f.address} tracking {args.masters}")
+    _wait_forever([f])
 
 
 def cmd_volume(args):
@@ -267,7 +279,17 @@ def main(argv=None):
     p.add_argument("-volumeSizeLimitMB", type=int, default=1024)
     p.add_argument("-defaultReplication", default="000")
     p.add_argument("-pulseSeconds", type=float, default=5.0)
+    p.add_argument("-peers", default="",
+                   help="comma-separated other master addresses (raft)")
+    p.add_argument("-mdir", default="", help="raft state directory")
     p.set_defaults(fn=cmd_master)
+
+    p = sub.add_parser("master.follower",
+                       help="read-only lookup/assign cache master")
+    p.add_argument("-masters", default="127.0.0.1:9333")
+    p.add_argument("-ip", default="127.0.0.1")
+    p.add_argument("-port", type=int, default=9334)
+    p.set_defaults(fn=cmd_master_follower)
 
     p = sub.add_parser("volume", help="start a volume server")
     p.add_argument("-dir", default="./data")
