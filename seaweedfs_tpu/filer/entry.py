@@ -22,16 +22,21 @@ class FileChunk:
     size: int
     etag: str = ""
     modified_ts_ns: int = 0
+    is_chunk_manifest: bool = False  # chunk holds a serialized chunk list
 
     def to_dict(self) -> dict:
-        return {"fid": self.fid, "offset": self.offset, "size": self.size,
-                "etag": self.etag, "modified_ts_ns": self.modified_ts_ns}
+        d = {"fid": self.fid, "offset": self.offset, "size": self.size,
+             "etag": self.etag, "modified_ts_ns": self.modified_ts_ns}
+        if self.is_chunk_manifest:
+            d["is_chunk_manifest"] = True
+        return d
 
     @classmethod
     def from_dict(cls, d: dict) -> "FileChunk":
         return cls(fid=d["fid"], offset=d["offset"], size=d["size"],
                    etag=d.get("etag", ""),
-                   modified_ts_ns=d.get("modified_ts_ns", 0))
+                   modified_ts_ns=d.get("modified_ts_ns", 0),
+                   is_chunk_manifest=d.get("is_chunk_manifest", False))
 
 
 @dataclass

@@ -2,29 +2,37 @@
 
 Parity with weed/filer/filer.go:34-105: auto-creation of parent
 directories on insert, recursive delete with chunk reclamation hooks,
-rename, and the metadata change log (filer_notify.go:19-111): every
-mutation appends an EventNotification that subscribers can replay/tail
+rename, hardlink indirection (filer/filerstore_wrapper.go), and the
+metadata change log (filer_notify.go:19-111): every mutation appends an
+EventNotification to a LogBuffer that is flushed into date-partitioned
+segment files under /topics/.system/log stored in the filer itself;
+subscribers replay the persisted log then tail the in-RAM buffer
 (filer_grpc_server_sub_meta.go).
 """
 
 from __future__ import annotations
 
+import json
 import threading
 import time
-from typing import Callable, Iterator, Optional
+import uuid
+from typing import Callable, Optional
 
+from ..util.log_buffer import LogBuffer
 from .entry import Attr, Entry, FileChunk, new_directory_entry
 from .filer_store import FilerStore, MemoryStore, NotFoundError
 
 LOG_BUFFER_CAPACITY = 10000
+SYSTEM_LOG_DIR = "/topics/.system/log"  # filer_notify.go SystemLogDir
+HARDLINK_DIR = "/etc/.hardlinks"  # hardlink indirection records
 
 
 class MetaEvent:
     __slots__ = ("ts_ns", "directory", "old_entry", "new_entry")
 
     def __init__(self, directory: str, old_entry: Optional[dict],
-                 new_entry: Optional[dict]):
-        self.ts_ns = time.time_ns()
+                 new_entry: Optional[dict], ts_ns: Optional[int] = None):
+        self.ts_ns = ts_ns if ts_ns is not None else time.time_ns()
         self.directory = directory
         self.old_entry = old_entry
         self.new_entry = new_entry
@@ -35,35 +43,196 @@ class MetaEvent:
 
 
 class Filer:
-    def __init__(self, store: Optional[FilerStore] = None):
+    def __init__(self, store: Optional[FilerStore] = None,
+                 meta_log_flush_interval: float = 60.0):
         self.store = store or MemoryStore()
         self.lock = threading.RLock()
-        # ring buffer of change events (util/log_buffer analogue)
-        self._log: list[MetaEvent] = []
-        self._log_lock = threading.Lock()
         self.on_delete_chunks: Optional[Callable[[list[FileChunk]], None]] \
             = None
+        # change-log buffer; flushed into /topics/.system/log segments.
+        # Until persistence is enabled it acts as a capped ring buffer.
+        self.meta_log_enabled = False
+        self._log_buffer = LogBuffer(self._flush_meta_segment,
+                                     meta_log_flush_interval,
+                                     max_entries=LOG_BUFFER_CAPACITY)
+        self._last_event_ns = 0
 
     # -- change log (filer_notify.go NotifyUpdateEvent) ----------------------
     def _notify(self, directory: str, old_entry: Optional[Entry],
                 new_entry: Optional[Entry]):
+        if (directory + "/").startswith(SYSTEM_LOG_DIR + "/"):
+            return  # never log the log (filer_notify.go:21 guard)
+        # strictly-monotonic event timestamps so since_ns cursors never skip
+        ts = time.time_ns()
+        if ts <= self._last_event_ns:
+            ts = self._last_event_ns + 1
+        self._last_event_ns = ts
         event = MetaEvent(
             directory,
             old_entry.to_dict() if old_entry else None,
-            new_entry.to_dict() if new_entry else None)
-        with self._log_lock:
-            self._log.append(event)
-            if len(self._log) > LOG_BUFFER_CAPACITY:
-                self._log = self._log[-LOG_BUFFER_CAPACITY:]
+            new_entry.to_dict() if new_entry else None, ts_ns=ts)
+        self._log_buffer.add(ts, event.to_dict())
+
+    def enable_meta_log(self, background: bool = True):
+        """Turn on persistence of the change log into date-partitioned
+        segment files under /topics/.system/log (filer_notify.go:62-111)."""
+        self.meta_log_enabled = True
+        self._log_buffer.max_entries = None  # flushes bound RAM instead
+        if background:
+            self._log_buffer.start()
+
+    def flush_meta_log(self) -> int:
+        return self._log_buffer.flush()
+
+    def _flush_meta_segment(self, start_ns: int, stop_ns: int,
+                            events: list[dict]):
+        if not self.meta_log_enabled:
+            return
+        # /topics/.system/log/2026-07-29/11-30-05.123456 (one file per flush)
+        t = time.gmtime(start_ns / 1e9)
+        day = time.strftime("%Y-%m-%d", t)
+        name = time.strftime("%H-%M-%S", t) + f".{start_ns % 10**9:09d}"
+        body = "\n".join(json.dumps(e) for e in events).encode()
+        entry = Entry(
+            full_path=f"{SYSTEM_LOG_DIR}/{day}/{name}",
+            attr=Attr(mtime=time.time(), crtime=time.time(),
+                      file_size=len(body)),
+            content=body,
+            extended={"start_ns": start_ns, "stop_ns": stop_ns})
+        self.create_entry(entry)
+
+    def read_persisted_meta(self, since_ns: int = 0) -> list[dict]:
+        """Replay flushed events from the date-partitioned segment files
+        (ReadPersistedLogBuffer, filer_notify.go:88-111).  Whole days older
+        than the cursor's date are skipped without listing their segments."""
+        out: list[dict] = []
+        try:
+            days = self.store.list_directory(SYSTEM_LOG_DIR, limit=100000)
+        except NotFoundError:
+            return out
+        since_day = time.strftime("%Y-%m-%d",
+                                  time.gmtime(since_ns / 1e9)) \
+            if since_ns else ""
+        for day in sorted(days, key=lambda e: e.name):
+            if day.name < since_day:
+                continue
+            segments = self.store.list_directory(day.full_path, limit=100000)
+            for seg in sorted(segments, key=lambda e: e.name):
+                if seg.extended.get("stop_ns", 1 << 63) <= since_ns:
+                    continue
+                for line in seg.content.decode().splitlines():
+                    event = json.loads(line)
+                    if event["ts_ns"] > since_ns:
+                        out.append(event)
+        return out
 
     def subscribe_metadata(self, since_ns: int = 0,
                            path_prefix: str = "/") -> list[dict]:
-        """Replay change events newer than since_ns under path_prefix."""
-        with self._log_lock:
-            return [e.to_dict() for e in self._log
-                    if e.ts_ns > since_ns
-                    and (e.directory + "/").startswith(
-                        path_prefix.rstrip("/") + "/")]
+        """Replay persisted segments, then the in-RAM tail — the reference's
+        replay-then-tail subscription contract
+        (filer_grpc_server_sub_meta.go).  Events stay visible in RAM while
+        a flush is persisting them, so dedupe on the (unique, strictly
+        monotonic) ts_ns."""
+        events = self.read_persisted_meta(since_ns) \
+            + self._log_buffer.read_since(since_ns)
+        prefix = path_prefix.rstrip("/") + "/"
+        seen: set[int] = set()
+        out = []
+        for e in events:
+            if e["ts_ns"] in seen or \
+                    not (e["directory"] + "/").startswith(prefix):
+                continue
+            seen.add(e["ts_ns"])
+            out.append(e)
+        return out
+
+    def close(self):
+        """Flush any buffered change-log events and stop the flusher."""
+        self._log_buffer.stop()
+
+    # -- hardlinks (filerstore_wrapper.go hardlink indirection) --------------
+    def create_hard_link(self, src_path: str, dst_path: str):
+        """Make dst share src's content: both entries carry the same
+        hard_link_id pointing at a shared record holding attr+chunks with a
+        refcount; deletes reclaim chunks only at refcount zero."""
+        src_path = self._norm(src_path)
+        dst_path = self._norm(dst_path)
+        with self.lock:
+            src = self.store.find_entry(src_path)
+            if src.is_directory:
+                raise ValueError("cannot hardlink a directory")
+            existing_dst = self._find_or_none(dst_path)
+            if existing_dst is not None and existing_dst.is_directory:
+                raise ValueError(f"{dst_path} is a directory")
+            if not src.hard_link_id:
+                src.hard_link_id = uuid.uuid4().hex
+                self._write_hardlink(src.hard_link_id, src, refcount=1)
+                # the entry itself becomes a pointer
+                src.chunks, src.content = [], b""
+                self.store.update_entry(src)
+            record = self._read_hardlink(src.hard_link_id)
+            record["refcount"] += 1
+            self._put_hardlink(src.hard_link_id, record)
+            try:
+                dst = Entry(full_path=dst_path,
+                            attr=Attr(mtime=time.time(), crtime=time.time(),
+                                      mode=src.attr.mode),
+                            hard_link_id=src.hard_link_id)
+                self.create_entry(dst)
+            except Exception:
+                record["refcount"] -= 1  # roll back the reference bump
+                self._put_hardlink(src.hard_link_id, record)
+                raise
+
+    def _hardlink_path(self, link_id: str) -> str:
+        return f"{HARDLINK_DIR}/{link_id}"
+
+    def _write_hardlink(self, link_id: str, src: Entry, refcount: int):
+        self._put_hardlink(link_id, {
+            "refcount": refcount,
+            "attr": src.to_dict()["attr"],
+            "chunks": [c.to_dict() for c in src.chunks],
+            "content": src.content.hex() if src.content else "",
+            "extended": src.extended,
+        })
+
+    def _put_hardlink(self, link_id: str, record: dict):
+        body = json.dumps(record).encode()
+        self._ensure_parents(HARDLINK_DIR)
+        entry = Entry(full_path=self._hardlink_path(link_id),
+                      attr=Attr(mtime=time.time(), crtime=time.time(),
+                                file_size=len(body)),
+                      content=body)
+        old = self._find_or_none(entry.full_path)
+        self.store.insert_entry(entry)
+        # shared records ride the change log so feed replicas can resolve
+        # hardlinked entries (they'd otherwise read back empty)
+        self._notify(HARDLINK_DIR, old, entry)
+
+    def _read_hardlink(self, link_id: str) -> dict:
+        return json.loads(
+            self.store.find_entry(self._hardlink_path(link_id)).content)
+
+    def _resolve_hardlink(self, entry: Entry) -> Entry:
+        """Materialize a hardlink pointer entry from its shared record.
+        Returns a fresh Entry — never mutates the store's object (the
+        MemoryStore hands out its stored instances)."""
+        if not entry.hard_link_id:
+            return entry
+        try:
+            record = self._read_hardlink(entry.hard_link_id)
+        except NotFoundError:
+            return entry
+        resolved = Entry.from_dict(entry.to_dict())
+        a = record["attr"]
+        resolved.attr.mime = a.get("mime", resolved.attr.mime)
+        resolved.attr.md5 = a.get("md5", "")
+        resolved.attr.file_size = a.get("file_size", 0)
+        resolved.chunks = [FileChunk.from_dict(c) for c in record["chunks"]]
+        resolved.content = bytes.fromhex(record["content"]) \
+            if record.get("content") else b""
+        resolved.extended = record.get("extended", {}) or resolved.extended
+        return resolved
 
     # -- CRUD ----------------------------------------------------------------
     def create_entry(self, entry: Entry):
@@ -75,8 +244,14 @@ class Filer:
                     f"{entry.full_path} is a directory")
             self.store.insert_entry(entry)
             self._notify(entry.parent, old, entry)
-            if (old is not None and self.on_delete_chunks
-                    and old.chunks):
+            if old is None:
+                return
+            if old.hard_link_id:
+                # overwrote a hardlink pointer: drop its reference (even
+                # when both point at the same record — the new entry holds
+                # its own freshly-counted reference from create_hard_link)
+                self._release_file(old)
+            elif self.on_delete_chunks and old.chunks:
                 # overwritten file: reclaim chunks no longer referenced
                 kept = {c.fid for c in entry.chunks}
                 orphaned = [c for c in old.chunks if c.fid not in kept]
@@ -99,7 +274,8 @@ class Filer:
         self._notify(d.parent, None, d)
 
     def find_entry(self, path: str) -> Entry:
-        return self.store.find_entry(self._norm(path))
+        return self._resolve_hardlink(
+            self.store.find_entry(self._norm(path)))
 
     def _find_or_none(self, path: str) -> Optional[Entry]:
         try:
@@ -110,6 +286,15 @@ class Filer:
     def update_entry(self, entry: Entry):
         with self.lock:
             old = self._find_or_none(entry.full_path)
+            if old is not None and old.hard_link_id:
+                # write-through to the shared record so every link sees it
+                entry.hard_link_id = old.hard_link_id
+                record = self._read_hardlink(old.hard_link_id)
+                self._write_hardlink(old.hard_link_id, entry,
+                                     refcount=record["refcount"])
+                entry = Entry(full_path=entry.full_path, attr=entry.attr,
+                              extended=entry.extended,
+                              hard_link_id=old.hard_link_id)
             self.store.update_entry(entry)
             self._notify(entry.parent, old, entry)
 
@@ -128,9 +313,30 @@ class Filer:
                 self.store.delete_entry(path)
             else:
                 self.store.delete_entry(path)
-                if self.on_delete_chunks and entry.chunks:
-                    self.on_delete_chunks(entry.chunks)
+                self._release_file(entry)
             self._notify(entry.parent, entry, None)
+
+    def _release_file(self, entry: Entry):
+        """Reclaim a deleted file's chunks, honoring hardlink refcounts."""
+        if entry.hard_link_id:
+            try:
+                record = self._read_hardlink(entry.hard_link_id)
+            except NotFoundError:
+                return
+            record["refcount"] -= 1
+            if record["refcount"] > 0:
+                self._put_hardlink(entry.hard_link_id, record)
+                return
+            record_path = self._hardlink_path(entry.hard_link_id)
+            record_entry = self._find_or_none(record_path)
+            self.store.delete_entry(record_path)
+            if record_entry is not None:
+                self._notify(HARDLINK_DIR, record_entry, None)
+            if self.on_delete_chunks and record["chunks"]:
+                self.on_delete_chunks(
+                    [FileChunk.from_dict(c) for c in record["chunks"]])
+        elif self.on_delete_chunks and entry.chunks:
+            self.on_delete_chunks(entry.chunks)
 
     def _delete_recursive(self, dir_path: str):
         while True:
@@ -143,19 +349,21 @@ class Filer:
                     self.store.delete_entry(child.full_path)
                 else:
                     self.store.delete_entry(child.full_path)
-                    if self.on_delete_chunks and child.chunks:
-                        self.on_delete_chunks(child.chunks)
+                    self._release_file(child)
 
     def list_directory(self, path: str, start_file: str = "",
                        limit: int = 1024, prefix: str = "",
                        include_start: bool = False) -> list[Entry]:
-        return self.store.list_directory(
+        entries = self.store.list_directory(
             self._norm(path), start_file=start_file, limit=limit,
             prefix=prefix, include_start=include_start)
+        return [self._resolve_hardlink(e) if e.hard_link_id else e
+                for e in entries]
 
     def rename(self, old_path: str, new_path: str):
         """Atomic single-entry rename + recursive subtree move
-        (filer_rename.go)."""
+        (filer_rename.go).  The change event carries both the old and new
+        entry so feed replicas delete the old path (meta_replay.go)."""
         old_path, new_path = self._norm(old_path), self._norm(new_path)
         with self.lock:
             entry = self.store.find_entry(old_path)
@@ -163,7 +371,9 @@ class Filer:
             if dst is not None:
                 if dst.is_directory and not entry.is_directory:
                     raise ValueError(f"{new_path} is a directory")
-                if self.on_delete_chunks and dst.chunks:
+                if dst.hard_link_id:
+                    self._release_file(dst)  # overwrite drops one reference
+                elif self.on_delete_chunks and dst.chunks:
                     self.on_delete_chunks(dst.chunks)
             self._ensure_parents(new_path.rsplit("/", 1)[0] or "/")
             if entry.is_directory:
@@ -171,10 +381,11 @@ class Filer:
                                                        limit=100000):
                     self.rename(child.full_path,
                                 new_path + "/" + child.name)
+            old_snapshot = Entry.from_dict(entry.to_dict())
             entry.full_path = new_path
             self.store.insert_entry(entry)
             self.store.delete_entry(old_path)
-            self._notify(entry.parent, None, entry)
+            self._notify(entry.parent, old_snapshot, entry)
 
     @staticmethod
     def _norm(path: str) -> str:

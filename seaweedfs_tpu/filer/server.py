@@ -24,9 +24,14 @@ from typing import Optional
 from ..rpc.http_rpc import Request, Response, RpcError, RpcServer, call
 from ..security import Guard, gen_read_jwt, gen_write_jwt
 from .entry import Attr, Entry, FileChunk, total_size
+from .filechunk_manifest import (MANIFEST_BATCH, has_chunk_manifest,
+                                 maybe_manifestize, resolve_chunk_manifest)
 from .filechunks import etag_of_chunks, read_chunk_views
 from .filer import Filer
+from .filer_conf import FilerConf
 from .filer_store import FilerStore, NotFoundError
+from .meta_aggregator import MetaAggregator
+from .reader_cache import ChunkCache
 
 DEFAULT_CHUNK_SIZE = 4 * 1024 * 1024  # filer -maxMB default (4MB)
 INLINE_LIMIT = 2048  # small-content inlining threshold
@@ -37,7 +42,11 @@ class FilerServer:
                  port: int = 0, store: Optional[FilerStore] = None,
                  chunk_size: int = DEFAULT_CHUNK_SIZE,
                  replication: str = "", collection: str = "",
-                 guard: Optional[Guard] = None):
+                 guard: Optional[Guard] = None,
+                 peers: Optional[list[str]] = None,
+                 persist_meta_log: bool = False,
+                 chunk_cache_bytes: int = 64 << 20,
+                 manifest_batch: int = MANIFEST_BATCH):
         self.master_address = master_address
         self.chunk_size = chunk_size
         self.replication = replication
@@ -45,8 +54,18 @@ class FilerServer:
         self.guard = guard or Guard()
         self.filer = Filer(store)
         self.filer.on_delete_chunks = self._delete_chunks
+        if persist_meta_log:
+            self.filer.enable_meta_log()
+        self.chunk_cache = ChunkCache(chunk_cache_bytes)
+        self.manifest_batch = manifest_batch
+        self.meta_aggregator: Optional[MetaAggregator] = None
+        if peers:
+            self.meta_aggregator = MetaAggregator(
+                [p for p in peers if p])
+        self._conf_cache: tuple[float, FilerConf] = (0.0, FilerConf())
         self.server = RpcServer(host, port)
         self.server.add("GET", "/metadata/subscribe", self._h_subscribe)
+        self.server.add("GET", "/metadata/aggregate", self._h_aggregate)
         self.server.default_route = self._handle
         self._stop_event = threading.Event()
         self._register_thread: Optional[threading.Thread] = None
@@ -57,14 +76,28 @@ class FilerServer:
 
     def start(self):
         self.server.start()
+        if self.meta_aggregator is not None:
+            self.meta_aggregator.start()
         self._register_thread = threading.Thread(
             target=self._register_loop, daemon=True)
         self._register_thread.start()
 
     def stop(self):
         self._stop_event.set()
+        if self.meta_aggregator is not None:
+            self.meta_aggregator.stop()
         self.server.stop()
+        self.filer.close()  # flush buffered change-log events
         self.filer.store.close()
+
+    # -- per-path configuration (filer_conf.go, 1s refresh) ------------------
+    def filer_conf(self) -> FilerConf:
+        ts, conf = self._conf_cache
+        now = time.time()
+        if now - ts > 1.0:
+            conf = FilerConf.load(self.filer)
+            self._conf_cache = (now, conf)
+        return conf
 
     def _register_loop(self):
         """Announce this filer in the master's cluster registry
@@ -82,12 +115,13 @@ class FilerServer:
             self._stop_event.wait(interval)
 
     # -- volume cluster plumbing ---------------------------------------------
-    def _assign(self, count: int = 1) -> dict:
+    def _assign(self, count: int = 1, replication: str = "",
+                collection: str = "") -> dict:
         query = f"count={count}"
-        if self.replication:
-            query += f"&replication={self.replication}"
-        if self.collection:
-            query += f"&collection={self.collection}"
+        if replication or self.replication:
+            query += f"&replication={replication or self.replication}"
+        if collection or self.collection:
+            query += f"&collection={collection or self.collection}"
         return call(self.master_address, f"/dir/assign?{query}", timeout=30)
 
     def _lookup_url(self, fid: str) -> str:
@@ -97,6 +131,15 @@ class FilerServer:
         return found["locations"][0]["url"]
 
     def _delete_chunks(self, chunks: list[FileChunk]):
+        # expand manifest chunks so the data chunks they list are deleted
+        # too (manifest blobs themselves, at every level, are also chunks
+        # to reclaim)
+        if has_chunk_manifest(chunks):
+            try:
+                chunks = resolve_chunk_manifest(
+                    self._fetch_chunk, chunks, keep_manifests=True)
+            except (RpcError, ValueError):
+                pass  # a manifest blob is already gone; delete what we have
         for chunk in chunks:
             headers = {}
             if self.guard.signing:
@@ -120,10 +163,17 @@ class FilerServer:
             return self._h_delete(path, req)
         raise RpcError(f"unsupported method {method}", 405)
 
+    def _check_writable(self, path: str):
+        """Reject mutation of a read-only prefix (filer_conf.go rules)."""
+        if self.filer_conf().match_path(self.filer._norm(path)).read_only:
+            raise RpcError(f"{path} is read-only", 403)
+
     # -- write (auto-chunk) --------------------------------------------------
     def _h_write(self, path: str, req: Request):
         move_from = req.param("mv.from")
         if move_from:
+            self._check_writable(move_from)
+            self._check_writable(path)
             try:
                 self.filer.rename(move_from, path)
             except NotFoundError:
@@ -134,6 +184,7 @@ class FilerServer:
             # mkdir-style: create the directory entry
             from .entry import new_directory_entry
 
+            self._check_writable(path)
             self.filer.create_entry(new_directory_entry(
                 self.filer._norm(path)))
             return {"name": path}
@@ -144,15 +195,39 @@ class FilerServer:
         return {"name": entry.name, "size": len(body),
                 "md5": entry.attr.md5}
 
+    def _upload_blob(self, piece: bytes, replication: str = "",
+                     collection: str = "") -> FileChunk:
+        """Assign a fid and upload one blob to the volume cluster."""
+        assign = self._assign(replication=replication, collection=collection)
+        fid, url = assign["fid"], assign["url"]
+        headers = {"Content-Type": "application/octet-stream"}
+        if assign.get("auth"):
+            # forward the assign-minted write JWT (jwt-enabled cluster)
+            headers["Authorization"] = "BEARER " + assign["auth"]
+        up = call(url, f"/{fid}", raw=piece, method="POST",
+                  headers=headers, timeout=60)
+        return FileChunk(fid=fid, offset=0, size=len(piece),
+                         etag=up.get("eTag", ""),
+                         modified_ts_ns=time.time_ns())
+
     def save_bytes(self, path: str, body: bytes, mime: str = "",
                    extended: Optional[dict] = None) -> Entry:
         """Auto-chunked write used by both the filer HTTP API and the S3
         gateway: small bodies inline, larger ones chunk to the volume
-        cluster (doPutAutoChunk, _write_upload.go)."""
+        cluster (doPutAutoChunk, _write_upload.go); per-path rules from
+        /etc/seaweedfs/filer.conf pick collection/replication and enforce
+        read-only prefixes."""
+        path = self.filer._norm(path)
+        rule = self.filer_conf().match_path(path)
+        if rule.read_only:
+            raise RpcError(f"{rule.location_prefix} is read-only", 403)
+        if rule.max_file_name_length and \
+                len(path.rsplit("/", 1)[-1]) > rule.max_file_name_length:
+            raise RpcError("file name too long", 400)
         now = time.time()
         md5 = hashlib.md5(body).hexdigest()
         entry = Entry(
-            full_path=self.filer._norm(path),
+            full_path=path,
             attr=Attr(mtime=now, crtime=now, mime=mime, md5=md5,
                       file_size=len(body)),
             extended=extended or {})
@@ -162,21 +237,35 @@ class FilerServer:
             offset = 0
             while offset < len(body):
                 piece = body[offset:offset + self.chunk_size]
-                assign = self._assign()
-                fid, url = assign["fid"], assign["url"]
-                headers = {"Content-Type": "application/octet-stream"}
-                if assign.get("auth"):
-                    # forward the assign-minted write JWT (jwt-enabled cluster)
-                    headers["Authorization"] = "BEARER " + assign["auth"]
-                up = call(url, f"/{fid}", raw=piece, method="POST",
-                          headers=headers, timeout=60)
-                entry.chunks.append(FileChunk(
-                    fid=fid, offset=offset, size=len(piece),
-                    etag=up.get("eTag", ""),
-                    modified_ts_ns=time.time_ns()))
+                chunk = self._upload_blob(piece, rule.replication,
+                                          rule.collection)
+                chunk.offset = offset
+                entry.chunks.append(chunk)
                 offset += len(piece)
+            entry.chunks = maybe_manifestize(
+                lambda blob: self._upload_blob(blob, rule.replication,
+                                               rule.collection),
+                entry.chunks, self.manifest_batch)
         self.filer.create_entry(entry)
         return entry
+
+    def _fetch_chunk(self, fid: str) -> bytes:
+        """Whole-chunk fetch through the LRU chunk cache
+        (reader_cache.go)."""
+        cached = self.chunk_cache.get(fid)
+        if cached is not None:
+            return cached
+        url = self._lookup_url(fid)
+        headers = {}
+        if self.guard.read_signing:
+            headers["Authorization"] = "BEARER " + gen_read_jwt(
+                self.guard.read_signing, fid)
+        data = call(url, f"/{fid}", headers=headers, timeout=60)
+        if isinstance(data, dict):
+            raise RpcError(f"chunk {fid} fetch failed", 500)
+        data = bytes(data)
+        self.chunk_cache.put(fid, data)
+        return data
 
     def read_bytes(self, entry: Entry, start: int = 0,
                    length: Optional[int] = None) -> bytes:
@@ -186,18 +275,14 @@ class FilerServer:
             length = size - start
         if entry.content:
             return entry.content[start:start + length]
+        chunks = entry.chunks
+        if has_chunk_manifest(chunks):
+            chunks = resolve_chunk_manifest(self._fetch_chunk, chunks)
         parts = []
-        for view in read_chunk_views(entry.chunks, start, length):
-            url = self._lookup_url(view.fid)
-            headers = {}
-            if self.guard.read_signing:
-                headers["Authorization"] = "BEARER " + gen_read_jwt(
-                    self.guard.read_signing, view.fid)
-            data = call(url, f"/{view.fid}", headers=headers, timeout=60)
-            if isinstance(data, dict):
-                raise RpcError(f"chunk {view.fid} fetch failed", 500)
-            parts.append(bytes(data)[view.offset_in_chunk:
-                                     view.offset_in_chunk + view.size])
+        for view in read_chunk_views(chunks, start, length):
+            data = self._fetch_chunk(view.fid)
+            parts.append(data[view.offset_in_chunk:
+                              view.offset_in_chunk + view.size])
         return b"".join(parts)
 
     # -- read ----------------------------------------------------------------
@@ -269,6 +354,7 @@ class FilerServer:
 
     # -- delete --------------------------------------------------------------
     def _h_delete(self, path: str, req: Request):
+        self._check_writable(path)
         recursive = req.param("recursive") == "true"
         try:
             self.filer.delete_entry(path, recursive=recursive)
@@ -283,3 +369,12 @@ class FilerServer:
         since = int(req.param("since", "0"))
         prefix = req.param("pathPrefix", "/") or "/"
         return {"events": self.filer.subscribe_metadata(since, prefix)}
+
+    def _h_aggregate(self, req: Request):
+        """Merged peer feed (meta_aggregator.go MetaAggregator)."""
+        since = int(req.param("since", "0"))
+        events = self.filer.subscribe_metadata(since)
+        if self.meta_aggregator is not None:
+            events = sorted(events + self.meta_aggregator.events(since),
+                            key=lambda e: e["ts_ns"])
+        return {"events": events}

@@ -1,0 +1,391 @@
+"""Filer depth: persisted metadata log, hardlinks, chunk manifests,
+reader cache, per-path conf, meta aggregation."""
+
+import json
+import time
+
+import pytest
+
+from seaweedfs_tpu.filer.entry import Attr, Entry, FileChunk
+from seaweedfs_tpu.filer.filechunk_manifest import (
+    has_chunk_manifest, maybe_manifestize, resolve_chunk_manifest)
+from seaweedfs_tpu.filer.filer import SYSTEM_LOG_DIR, Filer
+from seaweedfs_tpu.filer.filer_conf import FILER_CONF_PATH, FilerConf, PathConf
+from seaweedfs_tpu.filer.filer_store import NotFoundError
+from seaweedfs_tpu.filer.meta_aggregator import (MetaAggregator,
+                                                 apply_meta_event)
+from seaweedfs_tpu.filer.reader_cache import ChunkCache
+from seaweedfs_tpu.util.log_buffer import LogBuffer
+
+
+def file_entry(path, content=b"", chunks=None):
+    now = time.time()
+    return Entry(full_path=path,
+                 attr=Attr(mtime=now, crtime=now, file_size=len(content)),
+                 content=content, chunks=chunks or [])
+
+
+class TestLogBuffer:
+    def test_flush_moves_entries(self):
+        flushed = []
+        buf = LogBuffer(lambda a, b, items: flushed.append((a, b, items)))
+        buf.add(1, "x")
+        buf.add(2, "y")
+        assert buf.read_since(0) == ["x", "y"]
+        assert buf.flush() == 2
+        assert flushed == [(1, 2, ["x", "y"])]
+        assert buf.read_since(0) == []
+        assert buf.last_flushed_ns == 2
+
+    def test_read_since_filters(self):
+        buf = LogBuffer()
+        buf.add(10, "a")
+        buf.add(20, "b")
+        assert buf.read_since(10) == ["b"]
+
+    def test_ring_cap(self):
+        buf = LogBuffer(max_entries=3)
+        for i in range(10):
+            buf.add(i, i)
+        assert buf.read_since(-1) == [7, 8, 9]
+
+    def test_failed_flush_requeues_entries(self):
+        calls = []
+
+        def flaky(start, stop, items):
+            calls.append(items)
+            if len(calls) == 1:
+                raise RuntimeError("persist hiccup")
+
+        buf = LogBuffer(flaky)
+        buf.add(1, "x")
+        with pytest.raises(RuntimeError):
+            buf.flush()
+        assert buf.read_since(0) == ["x"]  # still buffered
+        assert buf.flush() == 1  # retry succeeds
+        assert buf.read_since(0) == []
+
+
+class TestMetaLogPersistence:
+    def test_flush_writes_dated_segment(self):
+        f = Filer()
+        f.enable_meta_log(background=False)
+        f.create_entry(file_entry("/a/b.txt", b"hi"))
+        assert f.flush_meta_log() >= 1
+        days = f.store.list_directory(SYSTEM_LOG_DIR, limit=10)
+        assert len(days) == 1
+        segments = f.store.list_directory(days[0].full_path, limit=10)
+        assert len(segments) == 1
+        events = [json.loads(line) for line in
+                  segments[0].content.decode().splitlines()]
+        assert any(e["new_entry"] and e["new_entry"]["full_path"] == "/a/b.txt"
+                   for e in events)
+
+    def test_subscribe_replays_persisted_then_tails(self):
+        f = Filer()
+        f.enable_meta_log(background=False)
+        f.create_entry(file_entry("/a/1.txt", b"1"))
+        f.flush_meta_log()
+        f.create_entry(file_entry("/a/2.txt", b"2"))  # unflushed tail
+        paths = [e["new_entry"]["full_path"]
+                 for e in f.subscribe_metadata(0, "/a")]
+        assert paths == ["/a/1.txt", "/a/2.txt"]
+
+    def test_since_cursor_resumes_without_duplicates(self):
+        f = Filer()
+        f.enable_meta_log(background=False)
+        f.create_entry(file_entry("/a/1.txt", b"1"))
+        events = f.subscribe_metadata(0, "/a")
+        cursor = events[-1]["ts_ns"]
+        f.flush_meta_log()
+        f.create_entry(file_entry("/a/2.txt", b"2"))
+        more = f.subscribe_metadata(cursor, "/a")
+        assert [e["new_entry"]["full_path"] for e in more] == ["/a/2.txt"]
+
+    def test_log_dir_itself_not_logged(self):
+        f = Filer()
+        f.enable_meta_log(background=False)
+        f.create_entry(file_entry("/x.txt", b"x"))
+        f.flush_meta_log()
+        f.flush_meta_log()  # second flush must be a no-op (no new events)
+        events = f.subscribe_metadata(0)
+        assert all(not e["directory"].startswith(SYSTEM_LOG_DIR)
+                   for e in events)
+
+
+class TestHardlinks:
+    def test_links_share_content(self):
+        f = Filer()
+        f.create_entry(file_entry("/f1", b"shared"))
+        f.create_hard_link("/f1", "/f2")
+        assert f.find_entry("/f1").content == b"shared"
+        assert f.find_entry("/f2").content == b"shared"
+        assert f.find_entry("/f1").hard_link_id == \
+            f.find_entry("/f2").hard_link_id
+
+    def test_update_via_one_link_visible_in_other(self):
+        f = Filer()
+        f.create_entry(file_entry("/f1", b"v1"))
+        f.create_hard_link("/f1", "/f2")
+        e = f.find_entry("/f2")
+        e.content = b"v2"
+        e.attr.file_size = 2
+        f.update_entry(e)
+        assert f.find_entry("/f1").content == b"v2"
+
+    def test_overwrite_of_hardlink_pointer_releases_reference(self):
+        reclaimed = []
+        f = Filer()
+        f.on_delete_chunks = reclaimed.extend
+        chunks = [FileChunk(fid="7,bb", offset=0, size=5)]
+        e = file_entry("/f1", chunks=chunks)
+        e.attr.file_size = 5
+        f.create_entry(e)
+        f.create_hard_link("/f1", "/f2")
+        # overwrite the pointer at /f2 with brand-new content
+        f.create_entry(file_entry("/f2", b"new"))
+        assert reclaimed == []  # /f1 still references the shared record
+        f.delete_entry("/f1")  # last reference -> chunks reclaimed
+        assert [c.fid for c in reclaimed] == ["7,bb"]
+
+    def test_listing_resolves_hardlink_sizes(self):
+        f = Filer()
+        e = file_entry("/d/a", b"hello")
+        e.attr.file_size = 5
+        f.create_entry(e)
+        f.create_hard_link("/d/a", "/d/b")
+        sizes = {x.name: x.size() for x in f.list_directory("/d")}
+        assert sizes == {"a": 5, "b": 5}
+        # resolution must not mutate the store's own entry
+        raw = f.store.find_entry("/d/b")
+        assert raw.content == b"" and raw.chunks == []
+
+    def test_update_preserves_extended(self):
+        f = Filer()
+        e = file_entry("/f1", b"x")
+        e.extended = {"k": "v"}
+        f.create_entry(e)
+        f.create_hard_link("/f1", "/f2")
+        upd = f.find_entry("/f1")
+        upd.content = b"y"
+        f.update_entry(upd)
+        assert f.find_entry("/f2").extended == {"k": "v"}
+
+    def test_hardlinks_replicate_through_meta_feed(self):
+        src, dst = Filer(), Filer()
+        src.create_entry(file_entry("/f1", b"shared"))
+        src.create_hard_link("/f1", "/f2")
+        for event in src.subscribe_metadata(0):
+            apply_meta_event(dst, event)
+        # the replica must resolve both links to the shared content
+        assert dst.find_entry("/f1").content == b"shared"
+        assert dst.find_entry("/f2").content == b"shared"
+
+    def test_failed_link_rolls_back_refcount(self):
+        reclaimed = []
+        f = Filer()
+        f.on_delete_chunks = reclaimed.extend
+        chunks = [FileChunk(fid="7,cc", offset=0, size=5)]
+        e = file_entry("/f1", chunks=chunks)
+        e.attr.file_size = 5
+        f.create_entry(e)
+        f.create_entry(new_dir := file_entry("/adir", b""))
+        new_dir.attr.mode |= 0o40000
+        f.store.update_entry(new_dir)
+        with pytest.raises(ValueError):
+            f.create_hard_link("/f1", "/adir")
+        f.delete_entry("/f1")  # sole reference -> must reclaim
+        assert [c.fid for c in reclaimed] == ["7,cc"]
+
+    def test_chunks_reclaimed_only_at_last_unlink(self):
+        reclaimed = []
+        f = Filer()
+        f.on_delete_chunks = reclaimed.extend
+        chunks = [FileChunk(fid="7,aa", offset=0, size=5)]
+        e = file_entry("/f1", chunks=chunks)
+        e.attr.file_size = 5
+        f.create_entry(e)
+        f.create_hard_link("/f1", "/f2")
+        f.delete_entry("/f1")
+        assert reclaimed == []
+        assert f.find_entry("/f2").chunks[0].fid == "7,aa"
+        f.delete_entry("/f2")
+        assert [c.fid for c in reclaimed] == ["7,aa"]
+
+    def test_relink_same_record_keeps_refcount_balanced(self):
+        reclaimed = []
+        f = Filer()
+        f.on_delete_chunks = reclaimed.extend
+        chunks = [FileChunk(fid="7,dd", offset=0, size=5)]
+        e = file_entry("/f1", chunks=chunks)
+        e.attr.file_size = 5
+        f.create_entry(e)
+        f.create_hard_link("/f1", "/f2")
+        f.create_hard_link("/f1", "/f2")  # idempotent re-link
+        f.delete_entry("/f1")
+        f.delete_entry("/f2")  # last pointer -> chunks reclaimed exactly once
+        assert [c.fid for c in reclaimed] == ["7,dd"]
+
+
+class TestChunkManifest:
+    def _saver(self, store):
+        def save(blob):
+            fid = f"m,{len(store):04x}"
+            store[fid] = blob
+            return FileChunk(fid=fid, offset=0, size=len(blob))
+        return save
+
+    def test_small_list_untouched(self):
+        chunks = [FileChunk(fid=f"1,{i:02x}", offset=i * 10, size=10)
+                  for i in range(5)]
+        assert maybe_manifestize(self._saver({}), chunks, batch=100) == chunks
+
+    def test_round_trip(self):
+        store = {}
+        chunks = [FileChunk(fid=f"1,{i:02x}", offset=i * 10, size=10,
+                            modified_ts_ns=i)
+                  for i in range(25)]
+        folded = maybe_manifestize(self._saver(store), chunks, batch=10)
+        assert has_chunk_manifest(folded)
+        plain = [c for c in folded if not c.is_chunk_manifest]
+        assert len(plain) == 5  # 25 = 2 batches of 10 + 5 leftovers
+        resolved = resolve_chunk_manifest(lambda fid: store[fid], folded)
+        assert sorted(c.fid for c in resolved) == \
+            sorted(c.fid for c in chunks)
+        assert {c.offset for c in resolved} == {c.offset for c in chunks}
+
+    def test_keep_manifests_lists_every_fid_for_deletion(self):
+        store = {}
+        chunks = [FileChunk(fid=f"1,{i:02x}", offset=i * 10, size=10)
+                  for i in range(20)]
+        folded = maybe_manifestize(self._saver(store), chunks, batch=10)
+        everything = resolve_chunk_manifest(lambda fid: store[fid], folded,
+                                            keep_manifests=True)
+        fids = {c.fid for c in everything}
+        assert {c.fid for c in chunks} <= fids  # all data chunks
+        assert set(store) <= fids  # and every manifest blob
+
+    def test_manifest_covers_span(self):
+        store = {}
+        chunks = [FileChunk(fid=f"1,{i:02x}", offset=i * 10, size=10)
+                  for i in range(10)]
+        folded = maybe_manifestize(self._saver(store), chunks, batch=10)
+        assert len(folded) == 1 and folded[0].is_chunk_manifest
+        assert folded[0].offset == 0 and folded[0].size == 100
+
+
+class TestReaderCache:
+    def test_lru_eviction_by_bytes(self):
+        cache = ChunkCache(capacity_bytes=100)
+        cache.put("a", b"x" * 60)
+        cache.put("b", b"y" * 60)  # evicts a
+        assert cache.get("a") is None
+        assert cache.get("b") == b"y" * 60
+        assert cache.size_bytes == 60
+
+    def test_get_refreshes_recency(self):
+        cache = ChunkCache(capacity_bytes=100)
+        cache.put("a", b"x" * 40)
+        cache.put("b", b"y" * 40)
+        cache.get("a")
+        cache.put("c", b"z" * 40)  # evicts b, not a
+        assert cache.get("a") is not None
+        assert cache.get("b") is None
+
+    def test_oversized_never_cached(self):
+        cache = ChunkCache(capacity_bytes=10)
+        cache.put("big", b"x" * 11)
+        assert cache.get("big") is None
+
+
+class TestFilerConf:
+    def test_longest_prefix_wins(self):
+        conf = FilerConf()
+        conf.add(PathConf(location_prefix="/", replication="000"))
+        conf.add(PathConf(location_prefix="/buckets/", replication="001"))
+        conf.add(PathConf(location_prefix="/buckets/hot/",
+                          replication="010", collection="hot"))
+        assert conf.match_path("/buckets/hot/x").replication == "010"
+        assert conf.match_path("/buckets/cold/x").replication == "001"
+        assert conf.match_path("/other").replication == "000"
+
+    def test_save_load_round_trip(self):
+        f = Filer()
+        conf = FilerConf()
+        conf.add(PathConf(location_prefix="/ro/", read_only=True))
+        conf.save(f)
+        loaded = FilerConf.load(f)
+        assert loaded.match_path("/ro/x").read_only
+        assert not loaded.match_path("/rw/x").read_only
+        assert f.find_entry(FILER_CONF_PATH).content
+
+    def test_delete_rule(self):
+        conf = FilerConf()
+        conf.add(PathConf(location_prefix="/a/", collection="c"))
+        conf.delete("/a/")
+        assert conf.match_path("/a/x").collection == ""
+
+
+class TestMetaAggregation:
+    def test_apply_meta_event_create_update_delete(self):
+        src, dst = Filer(), Filer()
+        src.create_entry(file_entry("/d/a.txt", b"1"))
+        e = src.find_entry("/d/a.txt")
+        e.content = b"22"
+        src.update_entry(e)
+        for event in src.subscribe_metadata(0):
+            apply_meta_event(dst, event)
+        assert dst.find_entry("/d/a.txt").content == b"22"
+        src.delete_entry("/d/a.txt")
+        for event in src.subscribe_metadata(0):
+            apply_meta_event(dst, event)
+        with pytest.raises(NotFoundError):
+            dst.find_entry("/d/a.txt")
+
+    def test_rename_event_replay(self):
+        src, dst = Filer(), Filer()
+        src.create_entry(file_entry("/d/old.txt", b"x"))
+        src.rename("/d/old.txt", "/d/new.txt")
+        for event in src.subscribe_metadata(0):
+            apply_meta_event(dst, event)
+        assert dst.find_entry("/d/new.txt").content == b"x"
+        # the rename event must carry the old path so replicas delete it
+        with pytest.raises(NotFoundError):
+            dst.find_entry("/d/old.txt")
+
+
+class TestFilerServerIntegration:
+    """End-to-end through HTTP: aggregator follows a peer filer's feed."""
+
+    def test_aggregator_follows_peer(self):
+        from seaweedfs_tpu.filer.server import FilerServer
+
+        peer = FilerServer(master_address="127.0.0.1:1")
+        peer.server.start()
+        try:
+            peer.filer.create_entry(file_entry("/p/x.txt", b"x"))
+            agg = MetaAggregator([peer.address])
+            assert agg.poll_once(peer.address) >= 1
+            paths = [e["new_entry"]["full_path"] for e in agg.events()
+                     if e.get("new_entry")]
+            assert "/p/x.txt" in paths
+            # cursor advanced: re-poll brings nothing new
+            assert agg.poll_once(peer.address) == 0
+        finally:
+            peer.server.stop()
+
+    def test_bootstrap_from_peer(self):
+        from seaweedfs_tpu.filer.server import FilerServer
+
+        peer = FilerServer(master_address="127.0.0.1:1")
+        peer.server.start()
+        try:
+            peer.filer.create_entry(file_entry("/boot/a.txt", b"a"))
+            peer.filer.create_entry(file_entry("/boot/b.txt", b"b"))
+            fresh = Filer()
+            n = MetaAggregator.bootstrap_from_peer(peer.address, fresh)
+            assert n >= 2
+            assert fresh.find_entry("/boot/a.txt").content == b"a"
+            assert fresh.find_entry("/boot/b.txt").content == b"b"
+        finally:
+            peer.server.stop()

@@ -103,7 +103,9 @@ def cmd_filer(args):
     f = FilerServer(args.master, host=args.ip, port=args.port, store=store,
                     chunk_size=args.maxMB * 1024 * 1024,
                     replication=args.replication,
-                    collection=args.collection, guard=_load_guard())
+                    collection=args.collection, guard=_load_guard(),
+                    peers=args.peers.split(",") if args.peers else None,
+                    persist_meta_log=args.metaLog)
     f.start()
     print(f"filer listening on {f.address}")
     _wait_forever([f])
@@ -310,6 +312,10 @@ def main(argv=None):
     p.add_argument("-db", default="", help="sqlite path (default: memory)")
     p.add_argument("-replication", default="")
     p.add_argument("-collection", default="")
+    p.add_argument("-peers", default="",
+                   help="comma-separated peer filers to aggregate")
+    p.add_argument("-metaLog", action="store_true",
+                   help="persist the metadata change log")
     p.set_defaults(fn=cmd_filer)
 
     p = sub.add_parser("s3", help="start an s3 gateway (+embedded filer)")
