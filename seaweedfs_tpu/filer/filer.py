@@ -165,11 +165,14 @@ class Filer:
             if existing_dst is not None and existing_dst.is_directory:
                 raise ValueError(f"{dst_path} is a directory")
             if not src.hard_link_id:
+                src_before = Entry.from_dict(src.to_dict())
                 src.hard_link_id = uuid.uuid4().hex
                 self._write_hardlink(src.hard_link_id, src, refcount=1)
-                # the entry itself becomes a pointer
+                # the entry itself becomes a pointer; replicas following the
+                # change feed must see the conversion
                 src.chunks, src.content = [], b""
                 self.store.update_entry(src)
+                self._notify(src.parent, src_before, src)
             record = self._read_hardlink(src.hard_link_id)
             record["refcount"] += 1
             self._put_hardlink(src.hard_link_id, record)

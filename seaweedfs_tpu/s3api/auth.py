@@ -1,16 +1,19 @@
-"""AWS Signature V4 verification for the S3 gateway.
+"""AWS Signature V2/V4 verification for the S3 gateway.
 
 Parity with weed/s3api/auth_signature_v4.go (header-based signing and
-presigned query auth) and auth_credentials.go's identity model: identities
-with access/secret keys and allowed actions.  Anonymous access is allowed
-when no identities are configured, mirroring the reference's behaviour
-without a config.
+presigned query auth), auth_signature_v2.go (legacy HMAC-SHA1 scheme),
+policy/post-policy validation (s3api_object_handlers_postpolicy.go), and
+auth_credentials.go's identity model: identities with access/secret keys
+and allowed actions.  Anonymous access is allowed when no identities are
+configured, mirroring the reference's behaviour without a config.
 """
 
 from __future__ import annotations
 
+import base64
 import hashlib
 import hmac
+import json
 import time
 import urllib.parse
 from dataclasses import dataclass, field
@@ -18,6 +21,17 @@ from typing import Optional
 
 ALGORITHM = "AWS4-HMAC-SHA256"
 MAX_CLOCK_SKEW_SECONDS = 15 * 60  # AWS allows +/-15 minutes
+
+# sub-resources included in the V2 canonicalized resource
+# (auth_signature_v2.go resourceList)
+V2_SUBRESOURCES = {
+    "acl", "delete", "lifecycle", "location", "logging", "notification",
+    "partNumber", "policy", "requestPayment", "response-cache-control",
+    "response-content-disposition", "response-content-encoding",
+    "response-content-language", "response-content-type", "response-expires",
+    "tagging", "torrent", "uploadId", "uploads", "versionId", "versioning",
+    "versions", "website",
+}
 
 
 def _parse_amz_date(amz_date: str) -> float:
@@ -80,6 +94,11 @@ class IdentityAccessManagement:
                                        auth_header)
         if query.get("X-Amz-Algorithm") == ALGORITHM:
             return self._verify_presigned(method, path, query, headers)
+        if auth_header.startswith("AWS "):
+            return self._verify_v2_header(method, path, query, headers,
+                                          auth_header)
+        if "Signature" in query and "AWSAccessKeyId" in query:
+            return self._verify_v2_presigned(method, path, query, headers)
         raise AuthError("AccessDenied", "no valid authentication", 403)
 
     def _parse_auth_header(self, auth_header: str) -> dict:
@@ -166,6 +185,170 @@ class IdentityAccessManagement:
             raise AuthError("SignatureDoesNotMatch",
                             "signature mismatch", 403)
         return identity
+
+    # -- sigv2 (auth_signature_v2.go) ----------------------------------------
+    def _v2_string_to_sign(self, method, path, query, headers,
+                           date_value: str) -> str:
+        amz_headers = sorted(
+            (k.lower(), " ".join(str(v).split()))
+            for k, v in headers.items()
+            if k.lower().startswith("x-amz-"))
+        canonical_amz = "".join(f"{k}:{v}\n" for k, v in amz_headers)
+        resource = urllib.parse.quote(path, safe="/~")
+        subs = sorted(k for k in query if k in V2_SUBRESOURCES)
+        if subs:
+            pairs = []
+            for k in subs:
+                v = query[k]
+                pairs.append(f"{k}={v}" if v not in ("", None) else k)
+            resource += "?" + "&".join(pairs)
+        return "\n".join([
+            method,
+            headers.get("Content-Md5", "") or headers.get("Content-MD5", ""),
+            headers.get("Content-Type", "") or "",
+            date_value,
+            canonical_amz + resource])
+
+    @staticmethod
+    def _v2_signature(secret: str, string_to_sign: str) -> str:
+        return base64.b64encode(
+            hmac.new(secret.encode(), string_to_sign.encode(),
+                     hashlib.sha1).digest()).decode()
+
+    def _verify_v2_header(self, method, path, query, headers,
+                          auth_header) -> Identity:
+        try:
+            access_key, provided = auth_header[4:].strip().split(":", 1)
+        except ValueError:
+            raise AuthError("AuthorizationHeaderMalformed",
+                            "bad v2 authorization header", 400)
+        identity = self.identities.get(access_key)
+        if identity is None:
+            raise AuthError("InvalidAccessKeyId",
+                            f"unknown access key {access_key}", 403)
+        # x-amz-date supersedes Date in the string-to-sign (v2 spec)
+        date_value = "" if headers.get("X-Amz-Date") \
+            else (headers.get("Date", "") or "")
+        string_to_sign = self._v2_string_to_sign(method, path, query,
+                                                 headers, date_value)
+        expected = self._v2_signature(identity.secret_key, string_to_sign)
+        if not hmac.compare_digest(expected, provided):
+            raise AuthError("SignatureDoesNotMatch",
+                            "v2 signature mismatch", 403)
+        return identity
+
+    def _verify_v2_presigned(self, method, path, query, headers) -> Identity:
+        access_key = query.get("AWSAccessKeyId", "")
+        identity = self.identities.get(access_key)
+        if identity is None:
+            raise AuthError("InvalidAccessKeyId",
+                            f"unknown access key {access_key}", 403)
+        expires = query.get("Expires", "0")
+        try:
+            if time.time() > int(expires):
+                raise AuthError("AccessDenied", "request has expired", 403)
+        except ValueError:
+            raise AuthError("AccessDenied", "malformed Expires", 403)
+        string_to_sign = self._v2_string_to_sign(method, path, query,
+                                                 headers, expires)
+        expected = self._v2_signature(identity.secret_key, string_to_sign)
+        if not hmac.compare_digest(expected, query.get("Signature", "")):
+            raise AuthError("SignatureDoesNotMatch",
+                            "v2 signature mismatch", 403)
+        return identity
+
+    # -- POST policy (policy/post-policy, s3api postpolicy handlers) ---------
+    def verify_post_policy(self, form: dict[str, str]) -> Identity:
+        """Validate a browser-POST upload: signature over the base64 policy
+        document, policy expiration, and its conditions against the form
+        fields.  Returns the signing identity."""
+        policy_b64 = form.get("policy", "")
+        if not policy_b64:
+            if not self.enabled:
+                return None  # anonymous post without a policy
+            raise AuthError("AccessDenied", "missing policy", 403)
+        if "x-amz-signature" in form:  # v4-signed policy
+            cred_parts = form.get("x-amz-credential", "").split("/")
+            if len(cred_parts) != 5:
+                raise AuthError("AuthorizationQueryParametersError",
+                                "bad credential", 400)
+            access_key, datestamp, region, service, _ = cred_parts
+            identity = self.identities.get(access_key)
+            if identity is None:
+                raise AuthError("InvalidAccessKeyId",
+                                f"unknown access key {access_key}", 403)
+            expected = self._signature(identity.secret_key, datestamp,
+                                       region, service, policy_b64)
+            if not hmac.compare_digest(expected,
+                                       form.get("x-amz-signature", "")):
+                raise AuthError("SignatureDoesNotMatch",
+                                "policy signature mismatch", 403)
+        elif "signature" in form:  # v2-signed policy
+            access_key = form.get("awsaccesskeyid", "")  # form keys lowered
+            identity = self.identities.get(access_key)
+            if identity is None:
+                raise AuthError("InvalidAccessKeyId",
+                                f"unknown access key {access_key}", 403)
+            expected = self._v2_signature(identity.secret_key, policy_b64)
+            if not hmac.compare_digest(expected, form.get("signature", "")):
+                raise AuthError("SignatureDoesNotMatch",
+                                "policy signature mismatch", 403)
+        else:
+            raise AuthError("AccessDenied", "unsigned policy", 403)
+        self._check_policy_conditions(policy_b64, form)
+        return identity
+
+    @staticmethod
+    def _check_policy_conditions(policy_b64: str, form: dict[str, str]):
+        try:
+            policy = json.loads(base64.b64decode(policy_b64))
+        except (ValueError, TypeError):
+            raise AuthError("InvalidPolicyDocument", "unparsable policy",
+                            400)
+        expiration = policy.get("expiration", "")
+        try:
+            exp_ts = time.mktime(time.strptime(
+                expiration.split(".")[0].rstrip("Z"),
+                "%Y-%m-%dT%H:%M:%S")) - time.timezone
+        except ValueError:
+            raise AuthError("InvalidPolicyDocument", "bad expiration", 400)
+        if time.time() > exp_ts:
+            raise AuthError("AccessDenied", "policy expired", 403)
+        size = len(form.get("__file_bytes__", b""))
+        for cond in policy.get("conditions", []):
+            if isinstance(cond, dict):
+                for k, v in cond.items():
+                    if k.lower().startswith("x-ignore-"):
+                        continue
+                    have = form.get(k.lower(), form.get(k, ""))
+                    if str(have) != str(v):
+                        raise AuthError(
+                            "AccessDenied",
+                            f"policy condition failed: {k}", 403)
+            elif isinstance(cond, list) and len(cond) == 3:
+                op, name, value = cond[0], cond[1], cond[2]
+                if op == "content-length-range":
+                    try:
+                        lo, hi = int(name), int(value)
+                    except (TypeError, ValueError):
+                        raise AuthError("InvalidPolicyDocument",
+                                        "bad content-length-range", 400)
+                    if not (lo <= size <= hi):
+                        raise AuthError("EntityTooLarge" if size > hi
+                                        else "EntityTooSmall",
+                                        "content length out of range", 400)
+                    continue
+                name = str(name).lstrip("$").lower()
+                if op == "eq":
+                    if str(form.get(name, "")) != str(value):
+                        raise AuthError("AccessDenied",
+                                        f"eq condition failed: {name}", 403)
+                elif op == "starts-with":
+                    if not str(form.get(name, "")).startswith(str(value)):
+                        raise AuthError(
+                            "AccessDenied",
+                            f"starts-with condition failed: {name}", 403)
+                # unknown operators are ignored, like the reference
 
     @staticmethod
     def _canonical_request(method, path, query, headers, signed_headers,

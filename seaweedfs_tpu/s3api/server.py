@@ -24,9 +24,52 @@ from ..filer.server import FilerServer
 from ..rpc.http_rpc import Request, Response, RpcError, RpcServer
 from .auth import (ACTION_ADMIN, ACTION_LIST, ACTION_READ, ACTION_WRITE,
                    AuthError, Identity, IdentityAccessManagement)
+from .circuit_breaker import CircuitBreaker, SlowDown
 
 BUCKETS_ROOT = "/buckets"
 UPLOADS_DIR = ".uploads"
+
+
+def parse_multipart_form(content_type: str, body: bytes) -> dict:
+    """Minimal multipart/form-data parser for browser POST uploads.
+    Returns field name -> str value, plus '__file_bytes__' (bytes) and
+    '__file_name__' for the file part."""
+    if "boundary=" not in content_type:
+        raise RpcError("missing multipart boundary", 400)
+    boundary = content_type.split("boundary=", 1)[1].split(";")[0].strip()
+    boundary = boundary.strip('"')
+    form: dict = {}
+    delim = b"--" + boundary.encode()
+    for part in body.split(delim):
+        # each part is wrapped in exactly one CRLF on each side; strip only
+        # those delimiters — trailing \r\n bytes may belong to the payload
+        if part.startswith(b"\r\n"):
+            part = part[2:]
+        if part.endswith(b"\r\n"):
+            part = part[:-2]
+        if not part or part in (b"--", b"--\r\n"):
+            continue
+        head, _, payload = part.partition(b"\r\n\r\n")
+        disposition = ""
+        for line in head.decode("utf-8", "replace").splitlines():
+            if line.lower().startswith("content-disposition:"):
+                disposition = line
+        name = ""
+        filename = None
+        for item in disposition.split(";"):
+            item = item.strip()
+            if item.startswith("name="):
+                name = item[5:].strip('"')
+            elif item.startswith("filename="):
+                filename = item[9:].strip('"')
+        if not name:
+            continue
+        if name == "file" or filename is not None:
+            form["__file_bytes__"] = payload
+            form["__file_name__"] = filename or ""
+        else:
+            form[name.lower()] = payload.decode("utf-8", "replace")
+    return form
 
 
 def _xml(tag: str, children) -> bytes:
@@ -61,10 +104,13 @@ def _error_xml(code: str, message: str, status: int) -> Response:
 class S3ApiServer:
     def __init__(self, filer: FilerServer, host: str = "127.0.0.1",
                  port: int = 0,
-                 identities: Optional[list[Identity]] = None):
+                 identities: Optional[list[Identity]] = None,
+                 circuit_breaker: Optional[CircuitBreaker] = None):
         self.filer_server = filer
         self.filer = filer.filer
         self.iam = IdentityAccessManagement(identities)
+        self.circuit_breaker = circuit_breaker \
+            or CircuitBreaker.load_from_filer(self.filer)
         self.server = RpcServer(host, port)
         self.server.default_route = self._handle
 
@@ -84,6 +130,8 @@ class S3ApiServer:
             return self._route(method, req)
         except AuthError as e:
             return _error_xml(e.code, str(e), e.status)
+        except SlowDown as e:
+            return _error_xml("SlowDown", str(e), 503)
         except NotFoundError as e:
             return _error_xml("NoSuchKey", str(e), 404)
 
@@ -92,6 +140,18 @@ class S3ApiServer:
         parts = path.lstrip("/").split("/", 1)
         bucket = parts[0]
         key = parts[1] if len(parts) > 1 else ""
+
+        content_type = req.headers.get("Content-Type") or ""
+        if method == "POST" and bucket and not key \
+                and content_type.startswith("multipart/form-data"):
+            # browser-based POST policy upload: auth comes from the signed
+            # policy document, not the Authorization header
+            release = self.circuit_breaker.acquire(
+                bucket, "Write", len(req.body or b""))
+            try:
+                return self._post_policy_upload(bucket, req)
+            finally:
+                release()
 
         action = ACTION_READ if method in ("GET", "HEAD") else ACTION_WRITE
         if method == "GET" and not key:
@@ -102,13 +162,19 @@ class S3ApiServer:
             raise AuthError("AccessDenied",
                             f"{action} not allowed on {bucket}", 403)
 
-        if not bucket:
-            if method == "GET":
-                return self._list_buckets()
-            raise RpcError("bad request", 400)
-        if not key:
-            return self._bucket_op(method, bucket, req)
-        return self._object_op(method, bucket, key, req)
+        release = self.circuit_breaker.acquire(
+            bucket, "Read" if action in (ACTION_READ, ACTION_LIST)
+            else "Write", len(req.body or b""))
+        try:
+            if not bucket:
+                if method == "GET":
+                    return self._list_buckets()
+                raise RpcError("bad request", 400)
+            if not key:
+                return self._bucket_op(method, bucket, req)
+            return self._object_op(method, bucket, key, req)
+        finally:
+            release()
 
     # -- buckets -------------------------------------------------------------
     def _bucket_path(self, bucket: str) -> str:
@@ -150,10 +216,62 @@ class S3ApiServer:
             return Response(b"", 204)
         if method == "GET":
             self.filer.find_entry(path)  # 404 when missing
+            if "uploads" in req.query:
+                return self._list_multipart_uploads(bucket, req)
             return self._list_objects(bucket, req)
         if method == "POST" and "delete" in req.query:
             return self._multi_delete(bucket, req)
         raise RpcError(f"unsupported bucket op {method}", 405)
+
+    def _post_policy_upload(self, bucket: str, req: Request):
+        """Browser POST upload (s3api_object_handlers_postpolicy.go): the
+        form carries the key, a signed policy document, and the file."""
+        self.filer.find_entry(self._bucket_path(bucket))  # NoSuchBucket
+        form = parse_multipart_form(
+            req.headers.get("Content-Type") or "", req.body)
+        form.setdefault("bucket", bucket)
+        identity = self.iam.verify_post_policy(form)
+        if identity is not None and not identity.can(ACTION_WRITE, bucket):
+            raise AuthError("AccessDenied",
+                            f"Write not allowed on {bucket}", 403)
+        key = form.get("key", "")
+        if not key:
+            return _error_xml("InvalidArgument", "missing key field", 400)
+        key = key.replace("${filename}", form.get("__file_name__", ""))
+        body = form.get("__file_bytes__", b"")
+        entry = self.filer_server.save_bytes(
+            self._object_path(bucket, key), body,
+            mime=form.get("content-type", ""))
+        try:
+            status = int(form.get("success_action_status", "204"))
+        except ValueError:
+            status = 204
+        if status not in (200, 201, 204):
+            status = 204
+        if status == 201:
+            return Response(_xml("PostResponse", {
+                "Bucket": bucket, "Key": key,
+                "ETag": f'"{entry.attr.md5}"',
+            }), 201, "application/xml")
+        return Response(b"", status,
+                        headers={"ETag": f'"{entry.attr.md5}"'})
+
+    def _list_multipart_uploads(self, bucket: str, req: Request):
+        """GET /bucket?uploads (ListMultipartUploads)."""
+        uploads_root = f"{self._bucket_path(bucket)}/{UPLOADS_DIR}"
+        try:
+            pending = self.filer.list_directory(uploads_root, limit=10000)
+        except NotFoundError:
+            pending = []
+        return Response(_xml("ListMultipartUploadsResult", {
+            "Bucket": bucket,
+            "Upload": [
+                {"Key": u.extended.get("key", ""),
+                 "UploadId": u.name,
+                 "Initiated": _iso(u.attr.crtime)}
+                for u in pending if u.is_directory
+            ],
+        }), 200, "application/xml")
 
     # -- object listing ------------------------------------------------------
     def _walk(self, dir_path: str, rel_prefix: str = ""):

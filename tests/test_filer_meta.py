@@ -180,6 +180,15 @@ class TestHardlinks:
         # the replica must resolve both links to the shared content
         assert dst.find_entry("/f1").content == b"shared"
         assert dst.find_entry("/f2").content == b"shared"
+        # updates through one link propagate: the replica must have learned
+        # that /f1 became a pointer, not kept its stale full copy
+        e = src.find_entry("/f2")
+        e.content = b"v2"
+        src.update_entry(e)
+        cursor = 0
+        for event in src.subscribe_metadata(cursor):
+            apply_meta_event(dst, event)
+        assert dst.find_entry("/f1").content == b"v2"
 
     def test_failed_link_rolls_back_refcount(self):
         reclaimed = []

@@ -1,0 +1,454 @@
+"""S3 gateway extras: sigv2 auth, POST policy uploads, circuit breaker,
+ListMultipartUploads; IAM management API."""
+
+import base64
+import hashlib
+import hmac
+import json
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+import xml.etree.ElementTree as ET
+
+import pytest
+
+from seaweedfs_tpu.filer.server import FilerServer
+from seaweedfs_tpu.iamapi.server import IamApiServer, _policy_to_actions
+from seaweedfs_tpu.master.server import MasterServer
+from seaweedfs_tpu.s3api.auth import Identity, IdentityAccessManagement
+from seaweedfs_tpu.s3api.circuit_breaker import CircuitBreaker, SlowDown
+from seaweedfs_tpu.s3api.server import S3ApiServer, parse_multipart_form
+from seaweedfs_tpu.volume_server.server import VolumeServer
+
+NS = "{http://s3.amazonaws.com/doc/2006-03-01/}"
+IAM_NS = "{https://iam.amazonaws.com/doc/2010-05-08/}"
+
+
+def http(address, method, path, query="", body=b"", headers=None):
+    url = f"http://{address}{urllib.parse.quote(path)}"
+    if query:
+        url += f"?{query}"
+    req = urllib.request.Request(url, data=body or None, method=method,
+                                 headers=dict(headers or {}))
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.status, dict(resp.headers), resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read()
+
+
+@pytest.fixture
+def stack(tmp_path):
+    master = MasterServer(port=0, pulse_seconds=0.2)
+    master.start()
+    d = tmp_path / "v"
+    d.mkdir()
+    vs = VolumeServer([str(d)], master.address, port=0, pulse_seconds=0.2)
+    vs.start()
+    vs.heartbeat_once()
+    filer = FilerServer(master.address, port=0, chunk_size=1024)
+    filer.start()
+    yield master, vs, filer
+    filer.stop()
+    vs.stop()
+    master.stop()
+
+
+# --------------------------------------------------------------------------
+# Signature V2
+# --------------------------------------------------------------------------
+
+
+def v2_sign(secret, string_to_sign):
+    return base64.b64encode(
+        hmac.new(secret.encode(), string_to_sign.encode(),
+                 hashlib.sha1).digest()).decode()
+
+
+class TestSigV2:
+    def make_iam(self):
+        return IdentityAccessManagement([
+            Identity(name="u", access_key="AK2", secret_key="SK2")])
+
+    def test_header_auth_accepted(self):
+        iam = self.make_iam()
+        date = time.strftime("%a, %d %b %Y %H:%M:%S GMT", time.gmtime())
+        sts = "\n".join(["GET", "", "", date, "/b/k"])
+        headers = {"Date": date,
+                   "Authorization": f"AWS AK2:{v2_sign('SK2', sts)}"}
+        ident = iam.verify("GET", "/b/k", {}, headers, b"")
+        assert ident.name == "u"
+
+    def test_header_auth_with_subresource(self):
+        iam = self.make_iam()
+        date = time.strftime("%a, %d %b %Y %H:%M:%S GMT", time.gmtime())
+        sts = "\n".join(["GET", "", "", date, "/b/k?tagging"])
+        headers = {"Date": date,
+                   "Authorization": f"AWS AK2:{v2_sign('SK2', sts)}"}
+        ident = iam.verify("GET", "/b/k", {"tagging": ""}, headers, b"")
+        assert ident.name == "u"
+
+    def test_bad_signature_rejected(self):
+        from seaweedfs_tpu.s3api.auth import AuthError
+
+        iam = self.make_iam()
+        headers = {"Date": "x", "Authorization": "AWS AK2:nonsense"}
+        with pytest.raises(AuthError) as e:
+            iam.verify("GET", "/b/k", {}, headers, b"")
+        assert e.value.code == "SignatureDoesNotMatch"
+
+    def test_presigned_query_auth(self):
+        iam = self.make_iam()
+        expires = str(int(time.time()) + 60)
+        sts = "\n".join(["GET", "", "", expires, "/b/k"])
+        query = {"AWSAccessKeyId": "AK2", "Expires": expires,
+                 "Signature": v2_sign("SK2", sts)}
+        ident = iam.verify("GET", "/b/k", query, {}, b"")
+        assert ident.name == "u"
+
+    def test_presigned_expired(self):
+        from seaweedfs_tpu.s3api.auth import AuthError
+
+        iam = self.make_iam()
+        expires = str(int(time.time()) - 10)
+        sts = "\n".join(["GET", "", "", expires, "/b/k"])
+        query = {"AWSAccessKeyId": "AK2", "Expires": expires,
+                 "Signature": v2_sign("SK2", sts)}
+        with pytest.raises(AuthError) as e:
+            iam.verify("GET", "/b/k", query, {}, b"")
+        assert "expired" in str(e.value)
+
+
+# --------------------------------------------------------------------------
+# POST policy upload
+# --------------------------------------------------------------------------
+
+
+def make_form_body(fields, file_bytes, boundary="testboundary42"):
+    parts = []
+    for k, v in fields.items():
+        parts.append(
+            f'--{boundary}\r\nContent-Disposition: form-data; '
+            f'name="{k}"\r\n\r\n{v}'.encode())
+    parts.append(
+        b'--' + boundary.encode() +
+        b'\r\nContent-Disposition: form-data; name="file"; '
+        b'filename="upload.bin"\r\nContent-Type: '
+        b'application/octet-stream\r\n\r\n' + file_bytes)
+    body = b"\r\n".join(parts) + b"\r\n--" + boundary.encode() + b"--\r\n"
+    return body, f"multipart/form-data; boundary={boundary}"
+
+
+class TestPostPolicy:
+    def test_parse_multipart_form(self):
+        body, ctype = make_form_body({"key": "a/b.txt", "policy": "cG9s"},
+                                     b"DATA")
+        form = parse_multipart_form(ctype, body)
+        assert form["key"] == "a/b.txt"
+        assert form["policy"] == "cG9s"
+        assert form["__file_bytes__"] == b"DATA"
+        assert form["__file_name__"] == "upload.bin"
+
+    def test_parser_preserves_trailing_newlines(self):
+        # only the single delimiter CRLF is stripped — payload bytes
+        # ending in \n or \r\n must survive
+        payload = b"line1\nline2\r\n\r\n"
+        body, ctype = make_form_body({"key": "k"}, payload)
+        form = parse_multipart_form(ctype, body)
+        assert form["__file_bytes__"] == payload
+
+    def _policy_b64(self, conditions, minutes=5):
+        exp = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                            time.gmtime(time.time() + minutes * 60))
+        return base64.b64encode(json.dumps(
+            {"expiration": exp, "conditions": conditions}).encode()).decode()
+
+    def test_post_policy_upload_end_to_end(self, stack):
+        master, vs, filer = stack
+        s3 = S3ApiServer(filer, port=0, identities=[
+            Identity(name="u", access_key="AKP", secret_key="SKP")])
+        s3.start()
+        try:
+            # create the bucket (signed v2 header for brevity)
+            date = time.strftime("%a, %d %b %Y %H:%M:%S GMT", time.gmtime())
+            sts = "\n".join(["PUT", "", "", date, "/pb"])
+            http(s3.address, "PUT", "/pb", headers={
+                "Date": date,
+                "Authorization": f"AWS AKP:{v2_sign('SKP', sts)}"})
+            # v2-signed policy post
+            policy = self._policy_b64([
+                {"bucket": "pb"},
+                ["starts-with", "$key", "up/"],
+                ["content-length-range", 1, 1024],
+            ])
+            fields = {
+                "key": "up/${filename}",
+                "policy": policy,
+                "AWSAccessKeyId": "AKP",
+                "signature": v2_sign("SKP", policy),
+                "success_action_status": "201",
+            }
+            body, ctype = make_form_body(fields, b"posted-bytes")
+            status, _, resp = http(s3.address, "POST", "/pb", body=body,
+                                   headers={"Content-Type": ctype})
+            assert status == 201, resp
+            root = ET.fromstring(resp)
+            assert root.find(f"{NS}Key").text == "up/upload.bin"
+            # fetch it back
+            sts = "\n".join(["GET", "", "", date, "/pb/up/upload.bin"])
+            status, _, got = http(s3.address, "GET", "/pb/up/upload.bin",
+                                  headers={
+                                      "Date": date,
+                                      "Authorization":
+                                      f"AWS AKP:{v2_sign('SKP', sts)}"})
+            assert status == 200 and got == b"posted-bytes"
+        finally:
+            s3.stop()
+
+    def test_post_policy_condition_violation(self, stack):
+        master, vs, filer = stack
+        s3 = S3ApiServer(filer, port=0, identities=[
+            Identity(name="u", access_key="AKP", secret_key="SKP")])
+        s3.start()
+        try:
+            date = time.strftime("%a, %d %b %Y %H:%M:%S GMT", time.gmtime())
+            sts = "\n".join(["PUT", "", "", date, "/pc"])
+            http(s3.address, "PUT", "/pc", headers={
+                "Date": date,
+                "Authorization": f"AWS AKP:{v2_sign('SKP', sts)}"})
+            policy = self._policy_b64([["starts-with", "$key", "only/"]])
+            fields = {
+                "key": "elsewhere/x",
+                "policy": policy,
+                "AWSAccessKeyId": "AKP",
+                "signature": v2_sign("SKP", policy),
+            }
+            body, ctype = make_form_body(fields, b"x")
+            status, _, resp = http(s3.address, "POST", "/pc", body=body,
+                                   headers={"Content-Type": ctype})
+            assert status == 403 and b"starts-with" in resp
+        finally:
+            s3.stop()
+
+    def test_expired_policy_rejected(self, stack):
+        master, vs, filer = stack
+        s3 = S3ApiServer(filer, port=0, identities=[
+            Identity(name="u", access_key="AKP", secret_key="SKP")])
+        s3.start()
+        try:
+            date = time.strftime("%a, %d %b %Y %H:%M:%S GMT", time.gmtime())
+            sts = "\n".join(["PUT", "", "", date, "/pe"])
+            http(s3.address, "PUT", "/pe", headers={
+                "Date": date,
+                "Authorization": f"AWS AKP:{v2_sign('SKP', sts)}"})
+            policy = self._policy_b64([], minutes=-5)
+            fields = {"key": "k", "policy": policy,
+                      "AWSAccessKeyId": "AKP",
+                      "signature": v2_sign("SKP", policy)}
+            body, ctype = make_form_body(fields, b"x")
+            status, _, resp = http(s3.address, "POST", "/pe", body=body,
+                                   headers={"Content-Type": ctype})
+            assert status == 403 and b"expired" in resp
+        finally:
+            s3.stop()
+
+
+# --------------------------------------------------------------------------
+# circuit breaker
+# --------------------------------------------------------------------------
+
+
+class TestCircuitBreaker:
+    def test_count_limit(self):
+        cb = CircuitBreaker({"global": {
+            "enabled": True, "actions": {"Write:Count": 2}}})
+        r1 = cb.acquire("b", "Write")
+        r2 = cb.acquire("b", "Write")
+        with pytest.raises(SlowDown):
+            cb.acquire("b", "Write")
+        r1()
+        r3 = cb.acquire("b", "Write")  # freed slot admits again
+        r2()
+        r3()
+
+    def test_byte_limit(self):
+        cb = CircuitBreaker({"global": {
+            "enabled": True, "actions": {"Write:MB": 1}}})
+        r = cb.acquire("b", "Write", nbytes=900 * 1024)
+        with pytest.raises(SlowDown):
+            cb.acquire("b", "Write", nbytes=200 * 1024)
+        r()
+        cb.acquire("b", "Write", nbytes=200 * 1024)()
+
+    def test_per_bucket_limit(self):
+        cb = CircuitBreaker({"buckets": {"hot": {
+            "enabled": True, "actions": {"Read:Count": 1}}}})
+        r = cb.acquire("hot", "Read")
+        with pytest.raises(SlowDown):
+            cb.acquire("hot", "Read")
+        cb.acquire("cold", "Read")()  # other buckets unlimited
+        r()
+
+    def test_release_idempotent(self):
+        cb = CircuitBreaker({"global": {
+            "enabled": True, "actions": {"Write:Count": 1}}})
+        r = cb.acquire("b", "Write")
+        r()
+        r()  # double release must not underflow
+        r2 = cb.acquire("b", "Write")
+        with pytest.raises(SlowDown):
+            cb.acquire("b", "Write")
+        r2()
+
+    def test_gateway_returns_503(self, stack):
+        master, vs, filer = stack
+        cb = CircuitBreaker({"global": {
+            "enabled": True, "actions": {"Write:Count": 0}}})
+        s3 = S3ApiServer(filer, port=0, circuit_breaker=cb)
+        s3.start()
+        try:
+            status, _, body = http(s3.address, "PUT", "/cbk")
+            assert status == 503 and b"SlowDown" in body
+        finally:
+            s3.stop()
+
+
+# --------------------------------------------------------------------------
+# ListMultipartUploads
+# --------------------------------------------------------------------------
+
+
+class TestListMultipartUploads:
+    def test_pending_uploads_listed(self, stack):
+        master, vs, filer = stack
+        s3 = S3ApiServer(filer, port=0)
+        s3.start()
+        try:
+            http(s3.address, "PUT", "/mb")
+            status, _, body = http(s3.address, "POST", "/mb/big.bin",
+                                   query="uploads=")
+            assert status == 200
+            upload_id = ET.fromstring(body).find(f"{NS}UploadId").text
+            status, _, body = http(s3.address, "GET", "/mb",
+                                   query="uploads=")
+            assert status == 200
+            root = ET.fromstring(body)
+            uploads = root.findall(f"{NS}Upload")
+            assert [u.find(f"{NS}UploadId").text for u in uploads] == \
+                [upload_id]
+            assert uploads[0].find(f"{NS}Key").text == "big.bin"
+        finally:
+            s3.stop()
+
+
+# --------------------------------------------------------------------------
+# IAM API
+# --------------------------------------------------------------------------
+
+
+def iam_call(address, action, **params):
+    body = urllib.parse.urlencode({"Action": action, **params}).encode()
+    return http(address, "POST", "/", body=body,
+                headers={"Content-Type":
+                         "application/x-www-form-urlencoded"})
+
+
+class TestIamApi:
+    @pytest.fixture
+    def iam_stack(self, stack):
+        master, vs, filer = stack
+        s3 = S3ApiServer(filer, port=0, identities=[])
+        s3.start()
+        iam = IamApiServer(filer, port=0, s3_server=s3)
+        iam.start()
+        yield s3, iam
+        iam.stop()
+        s3.stop()
+
+    def test_user_lifecycle(self, iam_stack):
+        s3, iam = iam_stack
+        status, _, body = iam_call(iam.address, "CreateUser",
+                                   UserName="alice")
+        assert status == 200
+        assert ET.fromstring(body).find(
+            f".//{IAM_NS}UserName").text == "alice"
+        status, _, body = iam_call(iam.address, "ListUsers")
+        assert b"alice" in body
+        status, _, _ = iam_call(iam.address, "DeleteUser", UserName="alice")
+        assert status == 200
+        status, _, body = iam_call(iam.address, "GetUser", UserName="alice")
+        assert status == 404
+
+    def test_access_key_and_policy_flow(self, iam_stack):
+        s3, iam = iam_stack
+        iam_call(iam.address, "CreateUser", UserName="bob")
+        status, _, body = iam_call(iam.address, "CreateAccessKey",
+                                   UserName="bob")
+        assert status == 200
+        root = ET.fromstring(body)
+        access_key = root.find(f".//{IAM_NS}AccessKeyId").text
+        secret_key = root.find(f".//{IAM_NS}SecretAccessKey").text
+        policy = json.dumps({"Version": "2012-10-17", "Statement": [{
+            "Effect": "Allow", "Action": ["s3:*"],
+            "Resource": "arn:aws:s3:::*"}]})
+        status, _, _ = iam_call(iam.address, "PutUserPolicy",
+                                UserName="bob", PolicyDocument=policy)
+        assert status == 200
+        # the S3 gateway picked up the new credentials live
+        assert s3.iam.enabled
+        ident = s3.iam.identities.get(access_key)
+        assert ident is not None and ident.secret_key == secret_key
+        assert ident.can("Write", "anything")
+        status, _, body = iam_call(iam.address, "GetUserPolicy",
+                                   UserName="bob")
+        assert status == 200 and b"2012-10-17" in body
+        # revoke
+        iam_call(iam.address, "DeleteAccessKey", UserName="bob",
+                 AccessKeyId=access_key)
+        assert access_key not in s3.iam.identities
+
+    def test_persisted_identities_sync_on_startup(self, stack):
+        master, vs, filer = stack
+        s3 = S3ApiServer(filer, port=0, identities=[])
+        s3.start()
+        iam = IamApiServer(filer, port=0, s3_server=s3)
+        iam.start()
+        try:
+            iam_call(iam.address, "CreateUser", UserName="persist")
+            _, _, body = iam_call(iam.address, "CreateAccessKey",
+                                  UserName="persist")
+            access_key = ET.fromstring(body).find(
+                f".//{IAM_NS}AccessKeyId").text
+        finally:
+            iam.stop()
+        # simulate a restart: a fresh gateway + IAM server over the same
+        # filer store must pick up the persisted identities immediately
+        s3b = S3ApiServer(filer, port=0, identities=[])
+        s3b.start()
+        iam2 = IamApiServer(filer, port=0, s3_server=s3b)
+        try:
+            assert access_key in s3b.iam.identities
+        finally:
+            s3b.stop()
+            s3.stop()
+
+    def test_duplicate_user_conflict(self, iam_stack):
+        s3, iam = iam_stack
+        iam_call(iam.address, "CreateUser", UserName="dup")
+        status, _, body = iam_call(iam.address, "CreateUser", UserName="dup")
+        assert status == 409 and b"EntityAlreadyExists" in body
+
+    def test_policy_to_actions_mapping(self):
+        doc = {"Statement": [
+            {"Effect": "Allow", "Action": ["s3:GetObject"],
+             "Resource": "arn:aws:s3:::mybucket/*"},
+            {"Effect": "Allow", "Action": ["s3:ListBucket"],
+             "Resource": "arn:aws:s3:::mybucket"},
+            {"Effect": "Deny", "Action": ["s3:PutObject"],
+             "Resource": "arn:aws:s3:::mybucket/*"},
+        ]}
+        actions = _policy_to_actions(doc)
+        assert "Read:mybucket" in actions
+        assert "List:mybucket" in actions
+        assert not any(a.startswith("Write") for a in actions)

@@ -140,6 +140,25 @@ def cmd_s3(args):
     _wait_forever([s3, filer])
 
 
+def cmd_iam(args):
+    from seaweedfs_tpu.filer.filer_store import SqliteStore
+    from seaweedfs_tpu.filer.server import FilerServer
+    from seaweedfs_tpu.iamapi.server import IamApiServer
+    from seaweedfs_tpu.s3api.server import S3ApiServer
+
+    store = SqliteStore(args.db) if args.db else None
+    filer = FilerServer(args.master, port=0, store=store,
+                        guard=_load_guard())
+    filer.start()
+    s3 = S3ApiServer(filer, port=args.s3Port,
+                     identities=_load_identities(args.config))
+    s3.start()
+    iam = IamApiServer(filer, host=args.ip, port=args.port, s3_server=s3)
+    iam.start()
+    print(f"iam api on {iam.address} (s3 {s3.address})")
+    _wait_forever([iam, s3, filer])
+
+
 def cmd_server(args):
     """Combined master + volume + filer (+ s3) in one process
     (weed/command/server.go)."""
@@ -325,6 +344,15 @@ def main(argv=None):
     p.add_argument("-db", default="")
     p.add_argument("-config", default="", help="identities json")
     p.set_defaults(fn=cmd_s3)
+
+    p = sub.add_parser("iam", help="start an IAM management API (+s3+filer)")
+    p.add_argument("-master", default="127.0.0.1:9333")
+    p.add_argument("-ip", default="127.0.0.1")
+    p.add_argument("-port", type=int, default=8111)
+    p.add_argument("-s3Port", type=int, default=8333)
+    p.add_argument("-db", default="", help="sqlite path (default: memory)")
+    p.add_argument("-config", default="", help="s3 identities json")
+    p.set_defaults(fn=cmd_iam)
 
     p = sub.add_parser("server", help="combined master+volume(+filer)(+s3)")
     p.add_argument("-ip", default="127.0.0.1")
