@@ -56,6 +56,14 @@ class Filer:
                                      meta_log_flush_interval,
                                      max_entries=LOG_BUFFER_CAPACITY)
         self._last_event_ns = 0
+        # per-thread signature list stamped onto emitted events; a sync
+        # client sets its own cluster signature so active-active
+        # replication can skip events it produced itself
+        # (filer_pb EventNotification.signatures / IsFromOtherCluster)
+        self._sig_local = threading.local()
+
+    def set_event_signatures(self, signatures: Optional[list]):
+        self._sig_local.value = signatures or None
 
     # -- change log (filer_notify.go NotifyUpdateEvent) ----------------------
     def _notify(self, directory: str, old_entry: Optional[Entry],
@@ -71,7 +79,11 @@ class Filer:
             directory,
             old_entry.to_dict() if old_entry else None,
             new_entry.to_dict() if new_entry else None, ts_ns=ts)
-        self._log_buffer.add(ts, event.to_dict())
+        record = event.to_dict()
+        sigs = getattr(self._sig_local, "value", None)
+        if sigs:
+            record["signatures"] = list(sigs)
+        self._log_buffer.add(ts, record)
 
     def enable_meta_log(self, background: bool = True):
         """Turn on persistence of the change log into date-partitioned

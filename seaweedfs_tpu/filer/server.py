@@ -155,12 +155,24 @@ class FilerServer:
     # -- request routing -----------------------------------------------------
     def _handle(self, method: str, req: Request):
         path = req.path or "/"
-        if method in ("POST", "PUT"):
-            return self._h_write(path, req)
         if method in ("GET", "HEAD"):
             return self._h_read(path, req, method)
-        if method == "DELETE":
-            return self._h_delete(path, req)
+        # mutations: stamp the caller's replication signature (if any) onto
+        # the resulting metadata events so sync loops can break cycles
+        sig_header = req.headers.get("X-Sw-Signature", "")
+        try:
+            sigs = [int(s) for s in sig_header.split(",") if s.strip()] \
+                if sig_header else None
+        except ValueError:
+            raise RpcError("malformed X-Sw-Signature header", 400)
+        self.filer.set_event_signatures(sigs)
+        try:
+            if method in ("POST", "PUT"):
+                return self._h_write(path, req)
+            if method == "DELETE":
+                return self._h_delete(path, req)
+        finally:
+            self.filer.set_event_signatures(None)
         raise RpcError(f"unsupported method {method}", 405)
 
     def _check_writable(self, path: str):

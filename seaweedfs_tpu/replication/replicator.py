@@ -1,0 +1,107 @@
+"""The Replicator: apply one metadata event to a sink.
+
+Parity with weed/replication/replicator.go:40-100: path filtering against
+the source dir and exclude list, incremental-sink date prefixes, and the
+create/update/delete/rename dispatch — a rename arrives as one event with
+both old and new entries whose paths differ, which fans out to
+delete+create on the sink.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from ..util import glog
+from .sink import ReplicationSink
+from .source import FilerSource
+
+
+def _is_dir(entry: Optional[dict]) -> bool:
+    if not entry:
+        return False
+    return bool(entry.get("attr", {}).get("mode", 0) & 0o40000)
+
+
+class Replicator:
+    def __init__(self, source: FilerSource, sink: ReplicationSink,
+                 exclude_dirs: Optional[list[str]] = None,
+                 signature: int = 0):
+        self.source = source
+        self.sink = sink
+        self.sink.set_source(source)
+        self.exclude_dirs = exclude_dirs or []
+        # events carrying this signature were produced by the opposite
+        # direction of an active-active sync pair — skip them to break
+        # replication loops (replicator.go IsFromOtherCluster check)
+        self.signature = signature
+
+    def _translate(self, key: str, entry: Optional[dict]) -> str:
+        """Source path -> sink path, honoring the incremental date dir."""
+        date_key = ""
+        if self.sink.is_incremental:
+            mtime = (entry or {}).get("attr", {}).get("mtime", 0) \
+                or time.time()
+            date_key = "/" + time.strftime("%Y-%m-%d", time.gmtime(mtime))
+        return date_key + key[len(self.source.path) - 1:]
+
+    def replicate(self, event: dict) -> bool:
+        """Apply one metadata event; returns False if filtered out."""
+        if self.signature and self.signature in event.get("signatures", []):
+            return False
+        old_entry, new_entry = event.get("old_entry"), event.get("new_entry")
+        key = None
+        for entry in (new_entry, old_entry):
+            if entry:
+                key = entry["full_path"]
+                break
+        if key is None or not key.startswith(self.source.path) \
+                and key + "/" != self.source.path:
+            return False
+        for exclude in self.exclude_dirs:
+            if key == exclude or key.startswith(exclude.rstrip("/") + "/"):
+                return False
+
+        if old_entry and not new_entry:
+            self.sink.delete_entry(self._translate(key, old_entry),
+                                   _is_dir(old_entry))
+            return True
+        if new_entry and not old_entry:
+            self.sink.create_entry(self._translate(key, new_entry),
+                                   new_entry, _is_dir(new_entry))
+            return True
+        if new_entry and old_entry:
+            old_key = old_entry["full_path"]
+            if old_key != key:  # rename: delete old location, create new
+                if old_key.startswith(self.source.path):
+                    self.sink.delete_entry(
+                        self._translate(old_key, old_entry),
+                        _is_dir(old_entry))
+                self.sink.create_entry(self._translate(key, new_entry),
+                                       new_entry, _is_dir(new_entry))
+            else:
+                self.sink.update_entry(self._translate(key, new_entry),
+                                       old_entry, new_entry,
+                                       _is_dir(new_entry))
+            return True
+        return False
+
+    def run_once(self, since_ns: int = 0) -> tuple[int, int]:
+        """Poll the source feed once, apply everything; returns
+        (events applied, new cursor).  On a sink failure the cursor stops
+        *before* the failed event so the next poll retries it — a
+        persisted cursor must never skip unreplicated data (the reference
+        retries failed events instead of advancing)."""
+        applied, cursor = 0, since_ns
+        for event in self.source.subscribe(since_ns):
+            try:
+                if self.replicate(event):
+                    applied += 1
+            except Exception as e:
+                glog.errorf("replicate %s: %s (will retry)",
+                            (event.get("new_entry")
+                             or event.get("old_entry")
+                             or {}).get("full_path"), e)
+                return applied, cursor
+            cursor = max(cursor, event["ts_ns"])
+        return applied, cursor

@@ -1,0 +1,134 @@
+"""Minimal SigV4-signing S3 client (path-style).
+
+The reference links the AWS Go SDK for its s3 sink and remote-storage
+provider (replication/sink/s3sink, remote_storage/s3); this environment
+has no SDK and no egress, so replication/remote-storage speak to any
+S3-compatible endpoint — including this framework's own s3api gateway —
+through this hand-rolled client.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import time
+import urllib.parse
+from typing import Optional
+
+from ..rpc.http_rpc import RpcError, call
+
+ALGORITHM = "AWS4-HMAC-SHA256"
+
+
+class S3Client:
+    def __init__(self, endpoint: str, access_key: str = "",
+                 secret_key: str = "", region: str = "us-east-1"):
+        self.endpoint = endpoint  # host:port
+        self.access_key = access_key
+        self.secret_key = secret_key
+        self.region = region
+
+    # -- signing -------------------------------------------------------------
+    def _sign(self, method: str, path: str, query: dict,
+              body: bytes) -> dict:
+        if not self.access_key:
+            return {}
+        now = time.gmtime()
+        amz_date = time.strftime("%Y%m%dT%H%M%SZ", now)
+        datestamp = time.strftime("%Y%m%d", now)
+        payload_hash = hashlib.sha256(body).hexdigest()
+        headers = {
+            "Host": self.endpoint,
+            "X-Amz-Date": amz_date,
+            "X-Amz-Content-Sha256": payload_hash,
+        }
+        signed = ["host", "x-amz-content-sha256", "x-amz-date"]
+        canonical_uri = urllib.parse.quote(path, safe="/~")
+        q_pairs = sorted(
+            (urllib.parse.quote(k, safe="~"),
+             urllib.parse.quote(str(v), safe="~"))
+            for k, v in query.items())
+        canonical_query = "&".join(f"{k}={v}" for k, v in q_pairs)
+        lower = {k.lower(): v for k, v in headers.items()}
+        header_lines = [f"{name}:{' '.join(lower[name].split())}"
+                        for name in signed]
+        canonical = "\n".join([
+            method, canonical_uri, canonical_query,
+            "\n".join(header_lines) + "\n", ";".join(signed), payload_hash])
+        scope = f"{datestamp}/{self.region}/s3/aws4_request"
+        string_to_sign = "\n".join([
+            ALGORITHM, amz_date, scope,
+            hashlib.sha256(canonical.encode()).hexdigest()])
+
+        def h(key, msg):
+            return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+        k = h(("AWS4" + self.secret_key).encode(), datestamp)
+        for part in (self.region, "s3", "aws4_request"):
+            k = h(k, part)
+        signature = hmac.new(k, string_to_sign.encode(),
+                             hashlib.sha256).hexdigest()
+        headers["Authorization"] = (
+            f"{ALGORITHM} Credential={self.access_key}/{scope}, "
+            f"SignedHeaders={';'.join(signed)}, Signature={signature}")
+        return headers
+
+    def _request(self, method: str, path: str,
+                 query: Optional[dict] = None, body: bytes = b"",
+                 content_type: str = ""):
+        query = query or {}
+        headers = self._sign(method, path, query, body)
+        if content_type:
+            headers["Content-Type"] = content_type
+        qs = urllib.parse.urlencode(query)
+        # send the same quoted path the signature canonicalises
+        full = urllib.parse.quote(path, safe="/~") + ("?" + qs if qs else "")
+        return call(self.endpoint, full, raw=body if body else None,
+                    method=method, headers=headers, timeout=120)
+
+    # -- object ops ----------------------------------------------------------
+    def create_bucket(self, bucket: str):
+        try:
+            self._request("PUT", f"/{bucket}")
+        except RpcError as e:
+            if e.status != 409:  # BucketAlreadyExists is fine
+                raise
+
+    def delete_bucket(self, bucket: str):
+        self._request("DELETE", f"/{bucket}")
+
+    def put_object(self, bucket: str, key: str, data: bytes,
+                   content_type: str = "application/octet-stream"):
+        self._request("PUT", f"/{bucket}/{key.lstrip('/')}", body=data,
+                      content_type=content_type)
+
+    def get_object(self, bucket: str, key: str) -> bytes:
+        body = self._request("GET", f"/{bucket}/{key.lstrip('/')}")
+        return body if isinstance(body, bytes) else b""
+
+    def delete_object(self, bucket: str, key: str):
+        try:
+            self._request("DELETE", f"/{bucket}/{key.lstrip('/')}")
+        except RpcError as e:
+            if e.status != 404:
+                raise
+
+    def list_keys(self, bucket: str, prefix: str = "") -> list[str]:
+        import re
+
+        keys: list[str] = []
+        start_after = ""
+        while True:
+            query = {"list-type": "2", "prefix": prefix}
+            if start_after:
+                query["start-after"] = start_after
+            body = self._request("GET", f"/{bucket}", query=query)
+            if not isinstance(body, bytes):
+                break
+            text = body.decode()
+            page = re.findall(r"<Key>([^<]+)</Key>", text)
+            keys.extend(page)
+            if not page or "<IsTruncated>true</IsTruncated>" not in text:
+                break
+            start_after = page[-1]
+        return keys

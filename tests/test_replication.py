@@ -1,0 +1,206 @@
+"""Cross-cluster replication: replicator + filer/local/s3 sinks +
+metadata backup (weed/replication, command/filer_sync.go,
+command/filer_backup.go, command/filer_meta_backup.go)."""
+
+import os
+import time
+
+import pytest
+
+from seaweedfs_tpu.filer.server import FilerServer
+from seaweedfs_tpu.master.server import MasterServer
+from seaweedfs_tpu.replication import (FilerSink, FilerSource, LocalSink,
+                                       Replicator, S3Sink, make_sink)
+from seaweedfs_tpu.replication.meta_backup import (MetaBackup,
+                                                   restore_listing)
+from seaweedfs_tpu.rpc.http_rpc import RpcError, call
+from seaweedfs_tpu.volume_server.server import VolumeServer
+
+
+def mini_cluster(tmp_path, tag):
+    master = MasterServer(port=0, pulse_seconds=0.2)
+    master.start()
+    d = tmp_path / f"vol-{tag}"
+    d.mkdir()
+    vs = VolumeServer([str(d)], master.address, port=0, pulse_seconds=0.2)
+    vs.start()
+    vs.heartbeat_once()
+    filer = FilerServer(master.address, port=0, chunk_size=512)
+    filer.start()
+    return master, vs, filer
+
+
+@pytest.fixture
+def two_clusters(tmp_path):
+    a = mini_cluster(tmp_path, "a")
+    b = mini_cluster(tmp_path, "b")
+    yield a, b
+    for master, vs, filer in (a, b):
+        filer.stop()
+        vs.stop()
+        master.stop()
+
+
+def put(filer, path, body, mime="text/plain"):
+    call(filer.address, path, raw=body, method="POST",
+         headers={"Content-Type": mime})
+
+
+def get(filer, path):
+    return call(filer.address, path)
+
+
+class TestFilerSink:
+    def test_create_update_delete(self, two_clusters):
+        (ma, va, fa), (mb, vb, fb) = two_clusters
+        rep = Replicator(FilerSource(fa.address, "/"),
+                         FilerSink(fb.address, "/"))
+        big = os.urandom(2048)  # > chunk_size: exercises chunked source read
+        put(fa, "/docs/readme.txt", b"hello replication")
+        put(fa, "/docs/big.bin", big, mime="application/octet-stream")
+        applied, cursor = rep.run_once(0)
+        assert applied >= 2
+        assert get(fb, "/docs/readme.txt") == b"hello replication"
+        assert get(fb, "/docs/big.bin") == big
+
+        put(fa, "/docs/readme.txt", b"updated")
+        applied, cursor = rep.run_once(cursor)
+        assert applied >= 1
+        assert get(fb, "/docs/readme.txt") == b"updated"
+
+        call(fa.address, "/docs/big.bin", method="DELETE")
+        applied, cursor = rep.run_once(cursor)
+        with pytest.raises(RpcError):
+            get(fb, "/docs/big.bin")
+
+    def test_rename_becomes_delete_create(self, two_clusters):
+        (ma, va, fa), (mb, vb, fb) = two_clusters
+        rep = Replicator(FilerSource(fa.address, "/"),
+                         FilerSink(fb.address, "/"))
+        put(fa, "/a.txt", b"payload")
+        _, cursor = rep.run_once(0)
+        assert get(fb, "/a.txt") == b"payload"
+        call(fa.address, "/b.txt?mv.from=/a.txt", raw=b"", method="POST")
+        rep.run_once(cursor)
+        assert get(fb, "/b.txt") == b"payload"
+        with pytest.raises(RpcError):
+            get(fb, "/a.txt")
+
+    def test_path_scoping_and_exclude(self, two_clusters):
+        (ma, va, fa), (mb, vb, fb) = two_clusters
+        rep = Replicator(FilerSource(fa.address, "/data/"),
+                         FilerSink(fb.address, "/mirror"),
+                         exclude_dirs=["/data/tmp"])
+        put(fa, "/data/keep.txt", b"keep")
+        put(fa, "/data/tmp/skip.txt", b"skip")
+        put(fa, "/outside.txt", b"outside")
+        rep.run_once(0)
+        assert get(fb, "/mirror/keep.txt") == b"keep"
+        for missing in ("/mirror/tmp/skip.txt", "/mirror/outside.txt",
+                        "/outside.txt"):
+            with pytest.raises(RpcError):
+                get(fb, missing)
+
+    def test_signature_breaks_active_active_loop(self, two_clusters):
+        (ma, va, fa), (mb, vb, fb) = two_clusters
+        sig_ab, sig_ba = 111, 222
+        # each direction stamps its own sig and skips the opposite one
+        ab = Replicator(FilerSource(fa.address, "/"),
+                        FilerSink(fb.address, "/", signature=sig_ab),
+                        signature=sig_ba)
+        ba = Replicator(FilerSource(fb.address, "/"),
+                        FilerSink(fa.address, "/", signature=sig_ba),
+                        signature=sig_ab)
+        put(fa, "/x.txt", b"from-a")
+        applied, ab_cursor = ab.run_once(0)
+        assert applied >= 1
+        # b's feed now contains the replicated write stamped with sig_ab;
+        # the reverse direction must apply ZERO events (no bounce)
+        applied_back, ba_cursor = ba.run_once(0)
+        assert applied_back == 0
+        assert get(fa, "/x.txt") == b"from-a"
+        # write on b flows a-ward; the a->b direction skips its echo
+        put(fb, "/y.txt", b"from-b")
+        applied, ba_cursor = ba.run_once(ba_cursor)
+        assert applied == 1
+        assert get(fa, "/y.txt") == b"from-b"
+        applied_echo, _ = ab.run_once(ab_cursor)
+        assert applied_echo == 0
+
+
+class TestLocalSink:
+    def test_backup_tree(self, two_clusters, tmp_path):
+        (ma, va, fa), _ = two_clusters
+        backup_dir = tmp_path / "backup"
+        rep = Replicator(FilerSource(fa.address, "/"),
+                         LocalSink(str(backup_dir)))
+        put(fa, "/site/index.html", b"<html>hi</html>")
+        put(fa, "/site/assets/app.js", b"console.log(1)")
+        _, cursor = rep.run_once(0)
+        assert (backup_dir / "site/index.html").read_bytes() \
+            == b"<html>hi</html>"
+        assert (backup_dir / "site/assets/app.js").read_bytes() \
+            == b"console.log(1)"
+        call(fa.address, "/site/index.html", method="DELETE")
+        rep.run_once(cursor)
+        assert not (backup_dir / "site/index.html").exists()
+
+    def test_incremental_mode_dates_changes(self, two_clusters, tmp_path):
+        (ma, va, fa), _ = two_clusters
+        backup_dir = tmp_path / "incr"
+        rep = Replicator(FilerSource(fa.address, "/"),
+                         LocalSink(str(backup_dir), is_incremental=True))
+        put(fa, "/f.txt", b"v1")
+        rep.run_once(0)
+        date = time.strftime("%Y-%m-%d", time.gmtime())
+        assert (backup_dir / date / "f.txt").read_bytes() == b"v1"
+
+
+class TestS3Sink:
+    def test_replicate_into_own_gateway(self, two_clusters):
+        from seaweedfs_tpu.s3api.server import S3ApiServer
+
+        (ma, va, fa), (mb, vb, fb) = two_clusters
+        s3 = S3ApiServer(fb, port=0)
+        s3.start()
+        try:
+            sink = make_sink(f"s3://mirror/pre?endpoint={s3.address}")
+            sink.client.create_bucket("mirror")
+            rep = Replicator(FilerSource(fa.address, "/"), sink)
+            put(fa, "/obj.bin", b"s3-bound bytes")
+            _, cursor = rep.run_once(0)
+            assert sink.client.get_object("mirror", "pre/obj.bin") \
+                == b"s3-bound bytes"
+            call(fa.address, "/obj.bin", method="DELETE")
+            rep.run_once(cursor)
+            assert "pre/obj.bin" not in sink.client.list_keys("mirror")
+        finally:
+            s3.stop()
+
+
+class TestMetaBackup:
+    def test_backup_and_restore_listing(self, two_clusters, tmp_path):
+        (ma, va, fa), _ = two_clusters
+        store = str(tmp_path / "meta.db")
+        put(fa, "/m/one.txt", b"1")
+        put(fa, "/m/two.txt", b"22")
+        backup = MetaBackup(fa.address, "/", store)
+        assert backup.run_once() >= 2
+        # cursor persists: a fresh poll applies nothing new
+        assert backup.run_once() == 0
+        backup.close()
+        listed = restore_listing(store, "/m")
+        names = {e["full_path"] for e in listed}
+        assert {"/m/one.txt", "/m/two.txt"} <= names
+        sizes = {e["full_path"]: e["attr"]["file_size"] for e in listed}
+        assert sizes["/m/two.txt"] == 2
+
+
+class TestMakeSink:
+    def test_specs(self, tmp_path):
+        assert make_sink("filer://h:1/dir").name == "filer"
+        assert make_sink(f"local://{tmp_path}").name == "local"
+        s3 = make_sink("s3://b/d?endpoint=h:1")
+        assert s3.name == "s3" and s3.bucket == "b"
+        with pytest.raises(ValueError):
+            make_sink("ftp://nope")

@@ -275,6 +275,142 @@ def cmd_download(args):
     print(f"wrote {len(data)} bytes to {out}")
 
 
+def _sync_state_path(tag: str) -> str:
+    import hashlib
+
+    digest = hashlib.md5(tag.encode()).hexdigest()[:12]
+    return os.path.expanduser(f"~/.weed_sync_{digest}.json")
+
+
+def _load_offsets(path: str) -> dict:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+def _save_offsets(path: str, offsets: dict):
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(offsets, f)
+    os.replace(tmp, path)
+
+
+def cmd_filer_sync(args):
+    """Continuous one- or two-way sync between filers
+    (weed/command/filer_sync.go)."""
+    import time as _time
+
+    from seaweedfs_tpu.replication import FilerSink, FilerSource, Replicator
+
+    import hashlib as _hashlib
+
+    state = args.state or _sync_state_path(f"{args.a}{args.b}")
+    offsets = _load_offsets(state)
+
+    def _sig(tag: str) -> int:
+        # stable across restarts (unlike hash()), never 0
+        return (int.from_bytes(_hashlib.md5(tag.encode()).digest()[:4],
+                               "big") & 0x7FFFFFFF) or 1
+
+    sig_ab, sig_ba = _sig(f"{args.a}->{args.b}"), _sig(f"{args.b}->{args.a}")
+    # each direction stamps its own signature on sink writes and SKIPS
+    # events stamped by the opposite direction (they are its echoes)
+    pairs = [("a->b", FilerSource(args.a, args.a_path),
+              FilerSink(args.b, args.b_path, signature=sig_ab), sig_ba)]
+    if not args.isActivePassive:
+        pairs.append(("b->a", FilerSource(args.b, args.b_path),
+                      FilerSink(args.a, args.a_path, signature=sig_ba),
+                      sig_ab))
+    reps = [(name, Replicator(src, snk, signature=skip_sig))
+            for name, src, snk, skip_sig in pairs]
+    print(f"filer.sync {args.a}{args.a_path} <-> {args.b}{args.b_path} "
+          f"({'active-passive' if args.isActivePassive else 'two-way'})")
+    while True:
+        moved = 0
+        for name, rep in reps:
+            applied, cursor = rep.run_once(offsets.get(name, 0))
+            if cursor != offsets.get(name, 0):
+                offsets[name] = cursor
+                _save_offsets(state, offsets)
+            moved += applied
+        if args.once and moved == 0:
+            break
+        if not moved:
+            _time.sleep(args.interval)
+
+
+def cmd_filer_backup(args):
+    """Incremental content backup of a filer path to a local/s3 sink
+    (weed/command/filer_backup.go)."""
+    import time as _time
+
+    from seaweedfs_tpu.replication import FilerSource, Replicator, make_sink
+
+    sink = make_sink(args.sink, access_key=args.accessKey,
+                     secret_key=args.secretKey,
+                     is_incremental=args.incremental)
+    source = FilerSource(args.filer, args.filerPath)
+    rep = Replicator(source, sink,
+                     exclude_dirs=[d for d in args.exclude.split(",") if d])
+    state = args.state or _sync_state_path(f"backup{args.filer}{args.sink}")
+    offsets = _load_offsets(state)
+    while True:
+        applied, cursor = rep.run_once(offsets.get("backup", 0))
+        if cursor != offsets.get("backup", 0):
+            offsets["backup"] = cursor
+            _save_offsets(state, offsets)
+        if args.once and applied == 0:
+            break
+        if not applied:
+            _time.sleep(args.interval)
+
+
+def cmd_filer_meta_backup(args):
+    """Metadata-only backup into a local sqlite store
+    (weed/command/filer_meta_backup.go)."""
+    import time as _time
+
+    from seaweedfs_tpu.replication.meta_backup import (MetaBackup,
+                                                       restore_listing)
+
+    if args.restore:
+        for entry in restore_listing(args.store, args.filerPath):
+            print(json.dumps(entry))
+        return
+    backup = MetaBackup(args.filer, args.filerPath, args.store)
+    try:
+        while True:
+            applied = backup.run_once()
+            if args.once and applied == 0:
+                break
+            if not applied:
+                _time.sleep(args.interval)
+    finally:
+        backup.close()
+
+
+def cmd_filer_meta_tail(args):
+    """Print the filer metadata change feed
+    (weed/command/filer_meta_tail.go)."""
+    import time as _time
+
+    from seaweedfs_tpu.replication import FilerSource
+
+    source = FilerSource(args.filer, args.pathPrefix)
+    since = int((_time.time() - args.timeAgo) * 1e9) if args.timeAgo else 0
+    while True:
+        events = source.subscribe(since)
+        for event in events:
+            print(json.dumps(event))
+            since = max(since, event["ts_ns"])
+        if args.once:
+            break
+        if not events:
+            _time.sleep(args.interval)
+
+
 def cmd_scaffold(args):
     from seaweedfs_tpu.util.config import scaffold
 
@@ -395,6 +531,58 @@ def main(argv=None):
     p.add_argument("-output", default="")
     p.set_defaults(fn=cmd_download)
 
+    p = sub.add_parser("filer.sync", help="sync two filers continuously")
+    p.add_argument("-a", required=True, help="source filer host:port")
+    p.add_argument("-b", required=True, help="target filer host:port")
+    p.add_argument("-a.path", dest="a_path", default="/")
+    p.add_argument("-b.path", dest="b_path", default="/")
+    p.add_argument("-isActivePassive", action="store_true",
+                   help="one-way a->b only")
+    p.add_argument("-state", default="", help="offset state file")
+    p.add_argument("-interval", type=float, default=2.0)
+    p.add_argument("-once", action="store_true",
+                   help="exit when caught up (for scripting/tests)")
+    p.set_defaults(fn=cmd_filer_sync)
+
+    p = sub.add_parser("filer.backup",
+                       help="replicate filer data to local/s3 sink")
+    p.add_argument("-filer", default="127.0.0.1:8888")
+    p.add_argument("-filerPath", default="/")
+    p.add_argument("-sink", required=True,
+                   help="local:///dir | s3://bucket/dir?endpoint=host:port"
+                        " | filer://host:port/dir")
+    p.add_argument("-accessKey", default="")
+    p.add_argument("-secretKey", default="")
+    p.add_argument("-incremental", action="store_true",
+                   help="file changes under yyyy-mm-dd dirs")
+    p.add_argument("-exclude", default="",
+                   help="comma-separated directories to skip")
+    p.add_argument("-state", default="")
+    p.add_argument("-interval", type=float, default=2.0)
+    p.add_argument("-once", action="store_true")
+    p.set_defaults(fn=cmd_filer_backup)
+
+    p = sub.add_parser("filer.meta.backup",
+                       help="continuously back up filer metadata to sqlite")
+    p.add_argument("-filer", default="127.0.0.1:8888")
+    p.add_argument("-filerPath", default="/")
+    p.add_argument("-store", required=True, help="sqlite backup file")
+    p.add_argument("-restore", action="store_true",
+                   help="print entries from the backup store and exit")
+    p.add_argument("-interval", type=float, default=2.0)
+    p.add_argument("-once", action="store_true")
+    p.set_defaults(fn=cmd_filer_meta_backup)
+
+    p = sub.add_parser("filer.meta.tail",
+                       help="print filer metadata change events")
+    p.add_argument("-filer", default="127.0.0.1:8888")
+    p.add_argument("-pathPrefix", default="/")
+    p.add_argument("-timeAgo", type=float, default=0,
+                   help="start this many seconds in the past")
+    p.add_argument("-interval", type=float, default=1.0)
+    p.add_argument("-once", action="store_true")
+    p.set_defaults(fn=cmd_filer_meta_tail)
+
     p = sub.add_parser("scaffold", help="print a config template")
     p.add_argument("-config", default="security",
                    help="security|master|filer|replication|notification")
@@ -409,7 +597,11 @@ def main(argv=None):
         from seaweedfs_tpu.util import glog
 
         glog.set_verbosity(args.v)
-    args.fn(args)
+    try:
+        args.fn(args)
+    except BrokenPipeError:  # e.g. `weed filer.meta.tail | head`
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        sys.exit(0)
 
 
 if __name__ == "__main__":
