@@ -314,9 +314,12 @@ class Filer:
             self._notify(entry.parent, old, entry)
 
     def delete_entry(self, path: str, recursive: bool = False,
-                     ignore_recursive_error: bool = False):
+                     ignore_recursive_error: bool = False,
+                     delete_chunks: bool = True):
         """filer_delete_entry.go semantics: directories need recursive=True
-        unless empty; file deletion reclaims chunks."""
+        unless empty; file deletion reclaims chunks unless the caller opts
+        out (the HTTP skipChunkDelete param, used by metadata-only
+        restores)."""
         path = self._norm(path)
         with self.lock:
             entry = self.store.find_entry(path)
@@ -328,7 +331,8 @@ class Filer:
                 self.store.delete_entry(path)
             else:
                 self.store.delete_entry(path)
-                self._release_file(entry)
+                if delete_chunks:
+                    self._release_file(entry)
             self._notify(entry.parent, entry, None)
 
     def _release_file(self, entry: Entry):

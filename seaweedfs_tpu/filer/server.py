@@ -201,6 +201,15 @@ class FilerServer:
                 self.filer._norm(path)))
             return {"name": path}
 
+        if req.param("meta") == "true":
+            # metadata-only restore (fs.meta.load): recreate the entry
+            # record verbatim — chunk fids must still be resolvable
+            self._check_writable(path)
+            entry = Entry.from_dict(req.json())
+            entry.full_path = self.filer._norm(path)
+            self.filer.create_entry(entry)
+            return {"name": entry.name, "size": entry.size()}
+
         body = req.body
         mime = req.headers.get("Content-Type") or ""
         entry = self.save_bytes(path, body, mime)
@@ -347,9 +356,11 @@ class FilerServer:
         last = req.param("lastFileName", "") or ""
         entries = self.filer.list_directory(entry.full_path,
                                             start_file=last, limit=limit)
-        return {
-            "Path": entry.full_path,
-            "Entries": [
+        if req.param("metadata") == "true":
+            # full entry dicts incl. chunks (fs.meta.cat / fsck surface)
+            rendered = [e.to_dict() for e in entries]
+        else:
+            rendered = [
                 {
                     "FullPath": e.full_path,
                     "Mtime": e.attr.mtime,
@@ -358,7 +369,10 @@ class FilerServer:
                     "FileSize": e.size(),
                     "IsDirectory": e.is_directory,
                 } for e in entries
-            ],
+            ]
+        return {
+            "Path": entry.full_path,
+            "Entries": rendered,
             "Limit": limit,
             "LastFileName": entries[-1].name if entries else "",
             "ShouldDisplayLoadMore": len(entries) == limit,
@@ -369,7 +383,9 @@ class FilerServer:
         self._check_writable(path)
         recursive = req.param("recursive") == "true"
         try:
-            self.filer.delete_entry(path, recursive=recursive)
+            self.filer.delete_entry(
+                path, recursive=recursive,
+                delete_chunks=req.param("skipChunkDelete") != "true")
         except NotFoundError:
             raise RpcError(f"{path} not found", 404)
         except ValueError as e:

@@ -73,16 +73,27 @@ class RaftNode:
             self.term = int(d.get("term", 0))
             self.voted_for = d.get("voted_for")
             self.max_volume_id = int(d.get("max_volume_id", 0))
+            # peers are persisted only once membership was changed via
+            # cluster.raft.add/remove — a plain restart keeps the
+            # configured list (addresses are identity here, so saving the
+            # bootstrap list would resurrect stale self-addresses)
+            persisted = d.get("peers")
+            if persisted is not None:
+                self.peers = sorted(set(persisted) | {self.address})
+                self._peers_persisted = True
         except (OSError, ValueError):
             pass
 
     def _save_state(self):
         if not self.state_dir:
             return
+        state = {"term": self.term, "voted_for": self.voted_for,
+                 "max_volume_id": self.max_volume_id}
+        if getattr(self, "_peers_persisted", False):
+            state["peers"] = self.peers
         tmp = self._state_path() + ".tmp"
         with open(tmp, "w") as f:
-            json.dump({"term": self.term, "voted_for": self.voted_for,
-                       "max_volume_id": self.max_volume_id}, f)
+            json.dump(state, f)
         os.replace(tmp, self._state_path())
 
     # -- lifecycle -----------------------------------------------------------
@@ -106,6 +117,57 @@ class RaftNode:
 
     def quorum(self) -> int:
         return len(self.peers) // 2 + 1
+
+    # -- membership changes (shell cluster.raft.add/remove) ------------------
+    # The reference drives these through hashicorp/raft's joint-consensus
+    # log.  Here membership is an administrative broadcast: the serving
+    # master updates its list and pushes the new list to every old AND new
+    # peer, so no node is left believing in a divergent quorum.
+
+    def set_peers(self, peers: list[str]):
+        """Adopt a broadcast membership list (internal /raft/update_peers).
+        A node absent from the list has been expelled: it drops to a
+        standalone cluster instead of continuing to campaign against its
+        former peers."""
+        with self.lock:
+            if self.address in peers:
+                self.peers = sorted(set(peers))
+            else:
+                self.peers = [self.address]
+                self.state = FOLLOWER
+                self.leader = None
+            self._peers_persisted = True
+            self._save_state()
+
+    def _broadcast_membership(self, notify: set[str]):
+        for peer in notify - {self.address}:
+            try:
+                call(peer, "/raft/update_peers", {"peers": self.peers},
+                     timeout=5)
+            except RpcError:
+                pass  # unreachable peer adopts the list when it rejoins
+
+    def add_peer(self, address: str):
+        with self.lock:
+            if address in self.peers:
+                return
+            self.peers = sorted(set(self.peers) | {address})
+            self._peers_persisted = True
+            self._save_state()
+            notify = set(self.peers)
+        self._broadcast_membership(notify)
+
+    def remove_peer(self, address: str):
+        if address == self.address:
+            raise ValueError("cannot remove self from the raft cluster")
+        with self.lock:
+            if address not in self.peers:
+                return
+            notify = set(self.peers)  # incl. the removed node
+            self.peers = [p for p in self.peers if p != address]
+            self._peers_persisted = True
+            self._save_state()
+        self._broadcast_membership(notify)
 
     # -- main loop -----------------------------------------------------------
     def _run(self):

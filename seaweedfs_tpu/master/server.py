@@ -120,6 +120,14 @@ class MasterServer:
         s.add("POST", "/raft/append_entries",
               lambda r: self.raft.handle_append_entries(r.json()))
         s.add("GET", "/raft/status", self._handle_raft_status)
+        s.add("POST", "/raft/add_peer", g(self._handle_raft_add_peer))
+        s.add("POST", "/raft/remove_peer", g(self._handle_raft_remove_peer))
+        s.add("POST", "/raft/update_peers",
+              lambda req: (self.raft.set_peers(req.json()["peers"]),
+                           {"peers": self.raft.peers})[1])
+        s.add("POST", "/dir/leave", self._handle_leave)
+        s.add("GET", "/col/list", self._handle_collection_list)
+        s.add("POST", "/col/delete", g(self._handle_collection_delete))
         s.add("GET", "/dir/watch", self._handle_watch)
         s.add("POST", "/cluster/register", self._handle_cluster_register)
         s.add("GET", "/cluster/nodes", self._handle_cluster_nodes)
@@ -292,6 +300,65 @@ class MasterServer:
             "peers": self.raft.peers,
             "max_volume_id": self.raft.max_volume_id,
         }
+
+    def _handle_leave(self, req):
+        """A volume server announces departure (VolumeServerLeave);
+        unregister immediately instead of waiting for the reaper."""
+        p = req.json()
+        self.topo.unregister_node(f"{p['ip']}:{p['port']}")
+        return {}
+
+    def _handle_raft_add_peer(self, req):
+        """cluster.raft.add (shell/command_cluster_raft_add.go)."""
+        self.raft.add_peer(req.json()["address"])
+        return {"peers": self.raft.peers}
+
+    def _handle_raft_remove_peer(self, req):
+        """cluster.raft.remove (shell/command_cluster_raft_remove.go)."""
+        try:
+            self.raft.remove_peer(req.json()["address"])
+        except ValueError as e:
+            raise RpcError(str(e), 400)
+        return {"peers": self.raft.peers}
+
+    # -- collections (master_server_handlers_admin.go /col/*) ----------------
+    def _handle_collection_list(self, req):
+        names: set[str] = set()
+        with self.topo.lock:
+            for dc in self.topo.dcs.values():
+                for rack in dc.racks.values():
+                    for node in rack.nodes.values():
+                        for v in node.volumes.values():
+                            names.add(v.collection)
+                        for vid in node.ec_shards:
+                            names.add(
+                                self.topo.ec_collections.get(vid, ""))
+        return {"collections": sorted(n for n in names if n)}
+
+    def _handle_collection_delete(self, req):
+        """Delete every volume of a collection on every server
+        (topology.DeleteCollection + DeleteVolume RPC fan-out)."""
+        name = req.json().get("collection", "")
+        if not name:
+            raise RpcError("collection name required", 400)
+        deleted = []
+        with self.topo.lock:
+            targets = [
+                (node.url, v.id)
+                for dc in self.topo.dcs.values()
+                for rack in dc.racks.values()
+                for node in rack.nodes.values()
+                for v in node.volumes.values() if v.collection == name
+            ]
+        for url, vid in targets:
+            try:
+                call(url, "/admin/delete_volume",
+                     {"volume": vid, "collection": name}, timeout=60)
+                deleted.append({"url": url, "volume": vid})
+            except RpcError as e:
+                deleted.append({"url": url, "volume": vid,
+                                "error": str(e)})
+        return {"deleted": deleted}
 
     # -- cluster membership (cluster/cluster.go, KeepConnected registry) -----
     def _handle_cluster_register(self, req):

@@ -69,6 +69,16 @@ class DataNode:
             "volumes": len(self.volumes),
             "ecShards": sum(b.count() for b in self.ec_shards.values()),
             "max": self.max_volume_count, "free": self.available_slots(),
+            "dc": self.dc.id, "rack": self.rack.id,
+            "volume_list": [
+                {"id": v.id, "collection": v.collection, "size": v.size,
+                 "file_count": v.file_count,
+                 "delete_count": v.delete_count,
+                 "deleted_bytes": v.deleted_byte_count,
+                 "read_only": v.read_only,
+                 "replication": v.replica_placement, "ttl": v.ttl}
+                for v in self.volumes.values()
+            ],
         }
 
 

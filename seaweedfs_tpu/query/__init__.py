@@ -1,0 +1,7 @@
+"""Structured queries over needle content (weed/query)."""
+
+from .json_query import (Query, filter_record, get_path, query_csv,
+                         query_json_lines)
+
+__all__ = ["Query", "filter_record", "get_path", "query_csv",
+           "query_json_lines"]

@@ -25,10 +25,10 @@ class FilerSource:
         filer_source.go ReadPart fetching each chunk from volume
         servers)."""
         quoted = urllib.parse.quote(full_path)
-        body = call(self.address, quoted, timeout=120)
+        # parse=False: a stored .json object must come back as bytes
+        body = call(self.address, quoted, timeout=120, parse=False)
         if isinstance(body, bytes):
             return body
-        # JSON response means a directory listing was returned
         raise RpcError(f"{full_path} is not a file", 400)
 
     def subscribe(self, since_ns: int = 0,

@@ -195,8 +195,11 @@ class RpcServer:
 
 def call(addr: str, path: str, payload: Optional[dict] = None,
          method: Optional[str] = None, timeout: float = 30.0,
-         raw: Optional[bytes] = None, headers: Optional[dict] = None):
-    """JSON RPC call; returns parsed JSON (or raw bytes for non-JSON)."""
+         raw: Optional[bytes] = None, headers: Optional[dict] = None,
+         parse: bool = True):
+    """JSON RPC call; returns parsed JSON (or raw bytes for non-JSON).
+    parse=False always returns the raw body — required when fetching
+    stored object content whose mime may itself be application/json."""
     url = f"http://{addr}{path}"
     data = None
     req_headers = dict(headers or {})
@@ -222,6 +225,6 @@ def call(addr: str, path: str, payload: Optional[dict] = None,
         raise RpcError(message, e.code) from None
     except (urllib.error.URLError, socket.timeout, ConnectionError) as e:
         raise RpcError(f"cannot reach {addr}: {e}", 503) from None
-    if "application/json" in ctype:
+    if parse and "application/json" in ctype:
         return json.loads(body) if body else {}
     return body

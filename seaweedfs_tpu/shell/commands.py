@@ -21,6 +21,8 @@ from ..storage.erasure_coding import TOTAL_SHARDS_COUNT
 @dataclass
 class CommandEnv:
     master_address: str
+    filer_address: str = ""  # discovered lazily via the cluster registry
+    admin_token: int = 0  # LeaseAdminToken lease for lock/unlock
 
     def master(self, path: str, payload=None, **kw):
         return call(self.master_address, path, payload, **kw)
@@ -296,3 +298,29 @@ def volume_vacuum(env: CommandEnv,
     if garbage_threshold is not None:
         path += f"?garbageThreshold={garbage_threshold}"
     return env.master(path, {})
+
+
+def volume_query(env: CommandEnv, file_ids: list[str],
+                 selections: Optional[list[str]] = None, field: str = "",
+                 op: str = "", value: str = "",
+                 csv: bool = False) -> list[dict]:
+    """SELECT over stored objects: route each fid to a server holding its
+    volume and run the /query RPC there (volume_grpc_query.go)."""
+    by_url: dict[str, list[str]] = {}
+    for fid in file_ids:
+        vid = fid.split(",")[0]
+        found = env.master(f"/dir/lookup?volumeId={vid}")
+        locations = found.get("locations", [])
+        if not locations:
+            raise RpcError(f"volume {vid} not found", 404)
+        by_url.setdefault(locations[0]["url"], []).append(fid)
+    records: list[dict] = []
+    for url, fids in by_url.items():
+        resp = call(url, "/query", {
+            "from_file_ids": fids,
+            "selections": selections or [],
+            "filter": {"field": field, "operand": op, "value": value},
+            "input_serialization": {"csv": {}} if csv else {"json": {}},
+        })
+        records.extend(resp.get("records", []))
+    return records

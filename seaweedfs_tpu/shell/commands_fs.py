@@ -1,0 +1,309 @@
+"""Admin shell: fs.* and s3.* commands against a filer.
+
+Parity with weed/shell/command_fs_*.go and command_s3_*.go: namespace
+inspection (ls/du/tree/cat/meta), mutation (mkdir/rm/mv), metadata
+save/load round-trips, bucket management under /buckets, stale multipart
+upload cleanup, and identity configuration shared with the IAM API.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.parse
+from typing import Optional
+
+from ..rpc.http_rpc import RpcError, call
+from .commands import CommandEnv
+
+BUCKETS_ROOT = "/buckets"
+IDENTITY_CONFIG_PATH = "/etc/iam/identity.json"
+
+
+def find_filer(env: CommandEnv) -> str:
+    """Resolve a filer address: explicit on the env, else the master's
+    cluster registry (shell.go filer discovery)."""
+    addr = getattr(env, "filer_address", "")
+    if addr:
+        return addr
+    members = env.master("/cluster/nodes?type=filer") \
+        .get("cluster_nodes", [])
+    if not members:
+        raise RpcError("no filer registered with the master", 404)
+    addr = members[0]["address"]
+    env.filer_address = addr
+    return addr
+
+
+def _list(filer: str, path: str, metadata: bool = False) -> list[dict]:
+    dir_path = path if path.endswith("/") else path + "/"
+    out: list[dict] = []
+    last = ""
+    while True:
+        q = f"?limit=1000&lastFileName={urllib.parse.quote(last)}"
+        if metadata:
+            q += "&metadata=true"
+        resp = call(filer, urllib.parse.quote(dir_path) + q)
+        entries = resp.get("Entries", []) or []
+        out.extend(entries)
+        if not resp.get("ShouldDisplayLoadMore"):
+            return out
+        last = resp.get("LastFileName", "")
+        if not last:
+            return out
+
+
+def _name(entry: dict) -> str:
+    return (entry.get("FullPath") or entry.get("full_path", "")) \
+        .rsplit("/", 1)[-1]
+
+
+def _is_dir(entry: dict) -> bool:
+    if "IsDirectory" in entry:
+        return entry["IsDirectory"]
+    return bool(entry.get("attr", {}).get("mode", 0) & 0o40000)
+
+
+def _size(entry: dict) -> int:
+    if "FileSize" in entry:
+        return entry["FileSize"]
+    return entry.get("attr", {}).get("file_size", 0)
+
+
+# -- fs.* --------------------------------------------------------------------
+
+def fs_ls(env: CommandEnv, path: str = "/",
+          long_format: bool = False) -> list[dict]:
+    filer = find_filer(env)
+    entries = _list(filer, path)
+    if long_format:
+        return [{"name": _name(e), "is_dir": _is_dir(e),
+                 "size": _size(e), "mode": e.get("Mode", 0),
+                 "mtime": e.get("Mtime", 0)} for e in entries]
+    return [{"name": _name(e), "is_dir": _is_dir(e)} for e in entries]
+
+
+def fs_cat(env: CommandEnv, path: str) -> bytes:
+    body = call(find_filer(env), urllib.parse.quote(path), parse=False)
+    if isinstance(body, bytes):
+        return body
+    raise RpcError(f"{path} is a directory", 400)
+
+
+def fs_mkdir(env: CommandEnv, path: str) -> dict:
+    return call(find_filer(env), urllib.parse.quote(path.rstrip("/")) + "/",
+                raw=b"", method="POST")
+
+
+def fs_rm(env: CommandEnv, path: str, recursive: bool = False) -> None:
+    q = "?recursive=true" if recursive else ""
+    call(find_filer(env), urllib.parse.quote(path) + q, method="DELETE")
+
+
+def fs_mv(env: CommandEnv, src: str, dst: str) -> dict:
+    return call(find_filer(env),
+                f"{urllib.parse.quote(dst)}?mv.from="
+                f"{urllib.parse.quote(src, safe='')}",
+                raw=b"", method="POST")
+
+
+def fs_du(env: CommandEnv, path: str = "/") -> dict:
+    """command_fs_du.go: recursive file/dir/byte accounting."""
+    filer = find_filer(env)
+    files = dirs = size = 0
+
+    def walk(p: str):
+        nonlocal files, dirs, size
+        for e in _list(filer, p):
+            if _is_dir(e):
+                dirs += 1
+                walk(p.rstrip("/") + "/" + _name(e))
+            else:
+                files += 1
+                size += _size(e)
+
+    walk(path)
+    return {"path": path, "files": files, "dirs": dirs, "bytes": size}
+
+
+def fs_tree(env: CommandEnv, path: str = "/") -> list[str]:
+    filer = find_filer(env)
+    lines: list[str] = []
+
+    def walk(p: str, depth: int):
+        for e in _list(filer, p):
+            name = _name(e)
+            lines.append("  " * depth
+                         + (name + "/" if _is_dir(e) else name))
+            if _is_dir(e):
+                walk(p.rstrip("/") + "/" + name, depth + 1)
+
+    walk(path, 0)
+    return lines
+
+
+def fs_meta_cat(env: CommandEnv, path: str) -> dict:
+    """command_fs_meta_cat.go: the raw entry record."""
+    filer = find_filer(env)
+    parent, _, name = path.rstrip("/").rpartition("/")
+    for e in _list(filer, parent or "/", metadata=True):
+        if e.get("full_path", "").rsplit("/", 1)[-1] == name:
+            return e
+    raise RpcError(f"{path} not found", 404)
+
+
+def fs_meta_save(env: CommandEnv, path: str = "/",
+                 output: str = "") -> list[dict]:
+    """command_fs_meta_save.go: dump the subtree's full metadata as
+    JSON-lines (returned, and written to `output` when given)."""
+    filer = find_filer(env)
+    records: list[dict] = []
+
+    def walk(p: str):
+        for e in _list(filer, p, metadata=True):
+            records.append(e)
+            if _is_dir(e):
+                walk(e["full_path"] + "/")
+
+    walk(path)
+    if output:
+        with open(output, "w") as f:
+            for r in records:
+                f.write(json.dumps(r) + "\n")
+    return records
+
+
+def fs_meta_load(env: CommandEnv, input_path: str) -> int:
+    """command_fs_meta_load.go: restore entries saved by fs.meta.save.
+    Directories are recreated; file entries are restored with their
+    chunk lists verbatim (the chunks must still exist on the volume
+    servers)."""
+    from ..filer.entry import Entry
+
+    filer = find_filer(env)
+    count = 0
+    with open(input_path) as f:
+        for line in f:
+            if not line.strip():
+                continue
+            record = json.loads(line)
+            entry = Entry.from_dict(record)
+            if entry.is_directory:
+                call(filer, urllib.parse.quote(entry.full_path) + "/",
+                     raw=b"", method="POST")
+            else:
+                # restore metadata-only: re-post inlined content, or
+                # re-attach chunks through the meta endpoint
+                call(filer,
+                     urllib.parse.quote(entry.full_path) + "?meta=true",
+                     payload=record, method="POST")
+            count += 1
+    return count
+
+
+# -- s3.* --------------------------------------------------------------------
+
+def s3_bucket_list(env: CommandEnv) -> list[dict]:
+    filer = find_filer(env)
+    try:
+        entries = _list(filer, BUCKETS_ROOT)
+    except RpcError as e:
+        if e.status == 404:
+            return []
+        raise
+    return [{"name": _name(e)} for e in entries if _is_dir(e)]
+
+
+def s3_bucket_create(env: CommandEnv, name: str) -> dict:
+    return call(find_filer(env), f"{BUCKETS_ROOT}/{name}/",
+                raw=b"", method="POST")
+
+
+def s3_bucket_delete(env: CommandEnv, name: str) -> None:
+    call(find_filer(env), f"{BUCKETS_ROOT}/{name}?recursive=true",
+         method="DELETE")
+
+
+def s3_clean_uploads(env: CommandEnv,
+                     timeout_seconds: float = 24 * 3600) -> list[str]:
+    """command_s3_clean_uploads.go: abort multipart uploads older than
+    the timeout (their staging dirs live under <bucket>/.uploads/)."""
+    filer = find_filer(env)
+    removed = []
+    now = time.time()
+    for bucket in s3_bucket_list(env):
+        uploads_dir = f"{BUCKETS_ROOT}/{bucket['name']}/.uploads"
+        try:
+            uploads = _list(filer, uploads_dir)
+        except RpcError:
+            continue
+        for u in uploads:
+            if now - u.get("Mtime", 0) > timeout_seconds:
+                path = f"{uploads_dir}/{_name(u)}"
+                call(filer, path + "?recursive=true", method="DELETE")
+                removed.append(path)
+    return removed
+
+
+def s3_configure(env: CommandEnv, user: str, access_key: str,
+                 secret_key: str,
+                 actions: Optional[list[str]] = None) -> dict:
+    """command_s3_configure.go: upsert an identity in the shared
+    identity config (the same file the IAM API manages)."""
+    filer = find_filer(env)
+    try:
+        raw = call(filer, IDENTITY_CONFIG_PATH)
+        config = raw if isinstance(raw, dict) else json.loads(
+            raw if isinstance(raw, str) else raw.decode())
+    except (RpcError, ValueError):
+        config = {"identities": []}
+    identities = [i for i in config.get("identities", [])
+                  if i.get("name") != user]
+    identities.append({
+        "name": user,
+        "credentials": [{"accessKey": access_key,
+                         "secretKey": secret_key}],
+        "actions": actions or ["Admin"],
+    })
+    config["identities"] = identities
+    body = json.dumps(config, indent=2).encode()
+    call(filer, IDENTITY_CONFIG_PATH, raw=body, method="POST",
+         headers={"Content-Type": "application/json"})
+    return config
+
+
+def fs_configure(env: CommandEnv, location_prefix: str,
+                 collection: str = "", replication: str = "",
+                 ttl: str = "", read_only: Optional[bool] = None,
+                 max_file_name_length: int = 0,
+                 delete: bool = False) -> dict:
+    """command_fs_configure.go: edit the per-path rules stored at
+    /etc/seaweedfs/filer.conf in the filer itself."""
+    from ..filer.filer_conf import FILER_CONF_PATH
+
+    filer = find_filer(env)
+    try:
+        raw = call(filer, FILER_CONF_PATH)
+        conf = raw if isinstance(raw, dict) else json.loads(
+            raw if isinstance(raw, str) else raw.decode())
+    except (RpcError, ValueError):
+        conf = {"locations": []}
+    locations = [loc for loc in conf.get("locations", [])
+                 if loc.get("location_prefix") != location_prefix]
+    if not delete:
+        rule: dict = {"location_prefix": location_prefix}
+        if collection:
+            rule["collection"] = collection
+        if replication:
+            rule["replication"] = replication
+        if ttl:
+            rule["ttl"] = ttl
+        if read_only is not None:
+            rule["read_only"] = read_only
+        if max_file_name_length:
+            rule["max_file_name_length"] = max_file_name_length
+        locations.append(rule)
+    conf["locations"] = locations
+    call(filer, FILER_CONF_PATH, raw=json.dumps(conf, indent=2).encode(),
+         method="POST", headers={"Content-Type": "application/json"})
+    return conf

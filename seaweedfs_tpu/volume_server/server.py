@@ -152,8 +152,74 @@ class VolumeServer:
         s.add("POST", "/admin/ec/to_volume", g(self._h_ec_to_volume))
         s.add("GET", "/admin/ec/shard_file", self._h_ec_shard_file)
         s.add("GET", "/admin/ec/shard_read", self._h_ec_shard_read)
+        s.add("POST", "/admin/volume/configure_replication",
+              g(self._h_configure_replication))
+        s.add("POST", "/admin/leave", g(self._h_leave))
+        s.add("POST", "/query", self._h_query)
         s.add("GET", "/metrics", stats.metrics_handler)
         s.default_route = self._handle_object
+
+    def _h_configure_replication(self, req: Request):
+        """VolumeConfigure (volume server side of
+        command_volume_configure_replication.go): rewrite the
+        replica-placement byte in the superblock on disk."""
+        from ..storage.super_block import ReplicaPlacement
+
+        p = req.json()
+        v = self._volume_or_404(int(p["volume"]))
+        rp = ReplicaPlacement.parse(p.get("replication", "000"))
+        with v.lock:
+            v.super_block.replica_placement = rp
+            v.data.write_at(v.super_block.to_bytes(), 0)
+            v.data.sync()
+        self._try_heartbeat()
+        return {"volume": v.id, "replication": str(rp)}
+
+    def _h_leave(self, req: Request):
+        """VolumeServerLeave (volume_grpc_admin.go): stop heartbeating and
+        unregister from the master so assigns stop landing here; the
+        process keeps serving reads until stopped."""
+        self._stop.set()  # ends the heartbeat loop only; server threads
+        # are owned by RpcServer and keep running
+        try:
+            call(self.master_address, "/dir/leave",
+                 {"ip": self.store.ip, "port": self.store.port}, timeout=5)
+        except RpcError:
+            pass  # master reaps on missed pulses anyway
+        return {}
+
+    # -- structured query (volume_grpc_query.go Query) -----------------------
+    def _h_query(self, req: Request):
+        """SELECT over JSON-lines/CSV needle content: body carries
+        from_file_ids, filter {field, operand, value}, selections, and
+        input_serialization (volume_server.proto QueryRequest)."""
+        from ..query import Query, query_csv, query_json_lines
+
+        spec = req.json()
+        filt = spec.get("filter") or {}
+        query = Query(field=filt.get("field", ""),
+                      op=filt.get("operand", ""),
+                      value=str(filt.get("value", "")))
+        selections = spec.get("selections") or []
+        input_ser = spec.get("input_serialization") or {"json": {}}
+        records = []
+        for fid in spec.get("from_file_ids", []):
+            try:
+                vid, nid, cookie = t.parse_file_id(fid)
+            except ValueError as e:
+                raise RpcError(f"bad fid {fid}: {e}", 400)
+            try:
+                n = self.store.read_needle(vid, nid, cookie=cookie)
+            except (NotFoundError, EcNotFoundError, DeletedError,
+                    EcDeletedError, CookieMismatchError):
+                raise RpcError(f"{fid} not found", 404)
+            if "csv" in input_ser:
+                records.extend(query_csv(
+                    n.data, selections, query,
+                    input_ser["csv"].get("file_header_info", "USE")))
+            else:
+                records.extend(query_json_lines(n.data, selections, query))
+        return {"records": records}
 
     # -- public object API ---------------------------------------------------
     def _handle_object(self, method: str, req: Request):

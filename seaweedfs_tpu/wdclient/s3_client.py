@@ -103,7 +103,14 @@ class S3Client:
                       content_type=content_type)
 
     def get_object(self, bucket: str, key: str) -> bytes:
-        body = self._request("GET", f"/{bucket}/{key.lstrip('/')}")
+        query: dict = {}
+        headers = self._sign("GET", f"/{bucket}/{key.lstrip('/')}",
+                             query, b"")
+        body = call(self.endpoint,
+                    urllib.parse.quote(f"/{bucket}/{key.lstrip('/')}",
+                                       safe="/~"),
+                    method="GET", headers=headers, timeout=120,
+                    parse=False)
         return body if isinstance(body, bytes) else b""
 
     def delete_object(self, bucket: str, key: str):

@@ -1,0 +1,138 @@
+"""Structured query over needle content (weed/query/json/query_json.go,
+volume_grpc_query.go) — unit semantics + live volume-server /query."""
+
+import json
+
+import pytest
+
+from seaweedfs_tpu.query import (Query, filter_record, get_path, query_csv,
+                                 query_json_lines)
+from seaweedfs_tpu.query.json_query import _glob_match
+
+
+class TestPathLookup:
+    def test_nested_and_index(self):
+        obj = {"a": {"b": [10, {"c": "x"}]}}
+        assert get_path(obj, "a.b.0") == 10
+        assert get_path(obj, "a.b.1.c") == "x"
+        assert get_path(obj, "a.missing") is None
+        assert get_path(obj, "a.b.9") is None
+
+
+class TestGlob:
+    def test_match(self):
+        assert _glob_match("hello", "h*o")
+        assert _glob_match("hello", "h?llo")
+        assert not _glob_match("hello", "h?lo")
+        assert _glob_match("a/b/c", "a/*/c")
+        assert _glob_match("", "*")
+        assert not _glob_match("x", "")
+
+
+class TestFilterSemantics:
+    """Mirrors query_json.go filterJson()'s type-directed table."""
+
+    def test_string_ops(self):
+        rec = {"name": "bob"}
+        assert filter_record(rec, Query("name", "=", "bob"))
+        assert filter_record(rec, Query("name", "!=", "alice"))
+        assert filter_record(rec, Query("name", ">", "alice"))
+        assert filter_record(rec, Query("name", "%", "b*"))
+        assert filter_record(rec, Query("name", "!%", "a*"))
+        assert not filter_record(rec, Query("name", "%", "a*"))
+
+    def test_number_ops(self):
+        rec = {"age": 30}
+        assert filter_record(rec, Query("age", "=", "30"))
+        assert filter_record(rec, Query("age", ">=", "30"))
+        assert filter_record(rec, Query("age", "<", "31.5"))
+        assert not filter_record(rec, Query("age", ">", "30"))
+        # glob ops are undefined for numbers -> no match
+        assert not filter_record(rec, Query("age", "%", "3*"))
+
+    def test_bool_ops(self):
+        assert filter_record({"ok": True}, Query("ok", "=", "true"))
+        assert filter_record({"ok": True}, Query("ok", ">", "false"))
+        assert filter_record({"ok": False}, Query("ok", "<=", "anything"))
+        assert not filter_record({"ok": False}, Query("ok", "=", "true"))
+
+    def test_existence_and_missing(self):
+        assert filter_record({"x": 0}, Query("x", "", ""))
+        assert not filter_record({}, Query("x", "", ""))
+        assert not filter_record({"y": 1}, Query("x", "=", "1"))
+
+
+class TestJsonLines:
+    DATA = b"\n".join([
+        json.dumps({"user": {"name": "ann"}, "score": 10}).encode(),
+        json.dumps({"user": {"name": "bob"}, "score": 55}).encode(),
+        b"this is not json",
+        json.dumps({"user": {"name": "cat"}, "score": 99}).encode(),
+    ])
+
+    def test_filter_and_project(self):
+        out = query_json_lines(self.DATA, ["user.name"],
+                               Query("score", ">", "20"))
+        assert out == [{"user.name": "bob"}, {"user.name": "cat"}]
+
+    def test_no_selection_returns_whole_record(self):
+        out = query_json_lines(self.DATA, [], Query("score", "=", "10"))
+        assert out == [{"user": {"name": "ann"}, "score": 10}]
+
+
+class TestCsv:
+    DATA = b"name,age,active\nann,31,true\nbob,55,false\n"
+
+    def test_header_use(self):
+        out = query_csv(self.DATA, ["name"], Query("age", ">", "40"))
+        assert out == [{"name": "bob"}]
+
+    def test_header_none_positional(self):
+        out = query_csv(b"x,1\ny,2\n", ["_1"], Query("_2", "=", "2"),
+                        file_header_info="NONE")
+        assert out == [{"_1": "y"}]
+
+    def test_bool_cells(self):
+        out = query_csv(self.DATA, ["name"], Query("active", "=", "true"))
+        assert out == [{"name": "ann"}]
+
+
+class TestLiveQuery:
+    @pytest.fixture
+    def cluster(self, tmp_path):
+        from seaweedfs_tpu.master.server import MasterServer
+        from seaweedfs_tpu.volume_server.server import VolumeServer
+
+        master = MasterServer(port=0, pulse_seconds=0.2)
+        master.start()
+        d = tmp_path / "v"
+        d.mkdir()
+        vs = VolumeServer([str(d)], master.address, port=0,
+                          pulse_seconds=0.2)
+        vs.start()
+        vs.heartbeat_once()
+        yield master, vs
+        vs.stop()
+        master.stop()
+
+    def test_query_endpoint_and_shell(self, cluster):
+        from seaweedfs_tpu.rpc.http_rpc import call
+        from seaweedfs_tpu.shell.commands import CommandEnv, volume_query
+
+        master, vs = cluster
+        rows = b"\n".join(json.dumps({"city": c, "pop": p}).encode()
+                          for c, p in [("oslo", 1), ("rio", 13), ("nyc", 8)])
+        a = call(master.address, "/dir/assign")
+        call(a["url"], f"/{a['fid']}", raw=rows, method="POST")
+
+        resp = call(vs.address, "/query", {
+            "from_file_ids": [a["fid"]],
+            "selections": ["city"],
+            "filter": {"field": "pop", "operand": ">=", "value": "8"},
+        })
+        assert resp["records"] == [{"city": "rio"}, {"city": "nyc"}]
+
+        env = CommandEnv(master.address)
+        out = volume_query(env, [a["fid"]], ["city"],
+                           field="city", op="%", value="*o")
+        assert out == [{"city": "oslo"}, {"city": "rio"}]

@@ -219,3 +219,33 @@ class TestWatchAndClient:
             mc.stop()
             vs.stop()
             m.stop()
+
+
+class TestRaftMembershipChange:
+    def test_remove_propagates_and_expels(self, trio):
+        masters = trio
+        assert wait_for(lambda: len(leaders(masters)) == 1)
+        leader = leaders(masters)[0]
+        victim = next(m for m in masters if m is not leader)
+        call(leader.address, "/raft/remove_peer",
+             {"address": victim.address})
+        survivors = [m for m in masters if m is not victim]
+        # every survivor adopts the shrunk list; the expelled node drops
+        # to a standalone cluster instead of campaigning against it
+        assert wait_for(lambda: all(
+            victim.address not in m.raft.peers for m in survivors))
+        assert victim.raft.peers == [victim.address]
+        assert wait_for(lambda: len(leaders(survivors)) == 1)
+
+    def test_add_propagates(self, trio):
+        masters = trio
+        assert wait_for(lambda: len(leaders(masters)) == 1)
+        leader = leaders(masters)[0]
+        call(leader.address, "/raft/add_peer",
+             {"address": "127.0.0.1:19999"})
+        assert wait_for(lambda: all(
+            "127.0.0.1:19999" in m.raft.peers for m in masters))
+        call(leader.address, "/raft/remove_peer",
+             {"address": "127.0.0.1:19999"})
+        assert wait_for(lambda: all(
+            "127.0.0.1:19999" not in m.raft.peers for m in masters))

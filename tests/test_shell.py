@@ -1,0 +1,321 @@
+"""Admin shell commands: volume.*, collection.*, cluster.*, fs.*, s3.*
+(weed/shell/command_volume_*.go, command_fs_*.go, command_s3_*.go,
+command_cluster_*.go).  Planning logic is tested plan-only like the
+reference's shell tests; mutation paths run against a live in-process
+cluster."""
+
+import json
+import time
+
+import pytest
+
+from seaweedfs_tpu.filer.server import FilerServer
+from seaweedfs_tpu.master.server import MasterServer
+from seaweedfs_tpu.rpc.http_rpc import RpcError, call
+from seaweedfs_tpu.shell import commands as sh
+from seaweedfs_tpu.shell import commands_fs as fs
+from seaweedfs_tpu.shell import commands_volume as vol
+from seaweedfs_tpu.volume_server.server import VolumeServer
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    master = MasterServer(port=0, pulse_seconds=0.2)
+    master.start()
+    servers = []
+    for i in range(3):
+        d = tmp_path / f"vs{i}"
+        d.mkdir()
+        vs = VolumeServer([str(d)], master.address, port=0,
+                          rack=f"rack{i % 2}", pulse_seconds=0.2)
+        vs.start()
+        vs.heartbeat_once()
+        servers.append(vs)
+    env = sh.CommandEnv(master.address)
+    yield master, servers, env
+    for vs in servers:
+        vs.stop()
+    master.stop()
+
+
+def write_files(master, n=3, collection="", size=100):
+    fids = []
+    for i in range(n):
+        q = f"?collection={collection}" if collection else ""
+        a = call(master.address, f"/dir/assign{q}")
+        call(a["url"], f"/{a['fid']}", raw=b"x" * size, method="POST")
+        fids.append((a["fid"], a["url"]))
+    return fids
+
+
+def heartbeat_all(servers):
+    for vs in servers:
+        vs.heartbeat_once()
+
+
+class TestVolumeOps:
+    def test_move(self, cluster):
+        master, servers, env = cluster
+        (fid, url), = write_files(master, 1)
+        heartbeat_all(servers)
+        vid = int(fid.split(",")[0])
+        nodes = vol.collect_volume_servers(env)
+        src = next(n for n in nodes if vid in n.volume_ids())
+        dst = next(n for n in nodes if vid not in n.volume_ids())
+
+        plan = vol.volume_move(env, vid, src.url, dst.url, plan_only=True)
+        assert plan["steps"]
+        vol.volume_move(env, vid, src.url, dst.url)
+        heartbeat_all(servers)
+        # data still readable from the new home
+        assert call(dst.url, f"/{fid}") == b"x" * 100
+        with pytest.raises(RpcError):
+            call(src.url, f"/{fid}")
+
+    def test_balance_plan_and_apply(self, cluster):
+        master, servers, env = cluster
+        # two volumes land on assign-chosen servers; balance should spread
+        write_files(master, 6)
+        heartbeat_all(servers)
+        moves = vol.volume_balance(env, plan_only=True)
+        counts = {}
+        for n in vol.collect_volume_servers(env):
+            counts[n.url] = len(n.volumes)
+        # plan must move from fullest to emptiest only
+        for m in moves:
+            assert counts[m["from"]] > counts[m["to"]]
+        vol.volume_balance(env)
+        heartbeat_all(servers)
+        after = [len(n.volumes) for n in vol.collect_volume_servers(env)]
+        assert max(after) - min(after) <= 1
+
+    def test_fix_replication_restores_copy(self, cluster):
+        master, servers, env = cluster
+        a = call(master.address, "/dir/assign?replication=010")
+        call(a["url"], f"/{a['fid']}", raw=b"replicated", method="POST")
+        heartbeat_all(servers)
+        vid = int(a["fid"].split(",")[0])
+        replicas = [n for n in vol.collect_volume_servers(env)
+                    if vid in n.volume_ids()]
+        assert len(replicas) == 2
+        # kill one replica
+        vol.volume_delete(env, vid, replicas[0].url)
+        heartbeat_all(servers)
+        actions = vol.volume_fix_replication(env, plan_only=True)
+        assert any(x["action"] == "copy" and x["volume"] == vid
+                   for x in actions)
+        vol.volume_fix_replication(env)
+        heartbeat_all(servers)
+        again = [n for n in vol.collect_volume_servers(env)
+                 if vid in n.volume_ids()]
+        assert len(again) == 2
+        assert not vol.volume_fix_replication(env, plan_only=True)
+
+    def test_evacuate(self, cluster):
+        master, servers, env = cluster
+        write_files(master, 4)
+        heartbeat_all(servers)
+        nodes = vol.collect_volume_servers(env)
+        source = max(nodes, key=lambda n: len(n.volumes))
+        if not source.volumes:
+            pytest.skip("no volumes landed on one server")
+        moves = vol.volume_server_evacuate(env, source.url)
+        assert all(m.get("to") for m in moves)
+        heartbeat_all(servers)
+        after = next(n for n in vol.collect_volume_servers(env)
+                     if n.url == source.url)
+        assert not after.volumes
+
+    def test_check_disk_syncs_lagging_replica(self, cluster):
+        master, servers, env = cluster
+        a = call(master.address, "/dir/assign?replication=010")
+        call(a["url"], f"/{a['fid']}", raw=b"first", method="POST")
+        heartbeat_all(servers)
+        vid = int(a["fid"].split(",")[0])
+        # append a needle to only ONE replica (bypass fan-out with
+        # type=replicate)
+        b = call(master.address, f"/dir/assign")
+        holders = [n.url for n in vol.collect_volume_servers(env)
+                   if vid in n.volume_ids()]
+        nid_fid = f"{vid},{a['fid'].split(',')[1][:-8]}{'deadbeef'}"
+        call(holders[0], f"/{vid},00000000000000ff00000000?type=replicate",
+             raw=b"only-here", method="POST")
+        fixes = vol.volume_check_disk(env, plan_only=True)
+        assert fixes and fixes[0]["volume"] == vid
+        vol.volume_check_disk(env)
+        assert not vol.volume_check_disk(env, plan_only=True)
+
+    def test_configure_replication(self, cluster):
+        master, servers, env = cluster
+        (fid, url), = write_files(master, 1)
+        heartbeat_all(servers)
+        vid = int(fid.split(",")[0])
+        out = vol.volume_configure_replication(env, vid, "010")
+        assert out[0]["replication"] == "010"
+        heartbeat_all(servers)
+        nodes = vol.collect_volume_servers(env)
+        v = next(v for n in nodes for v in n.volumes if v["id"] == vid)
+        assert v["replication"] == 10
+
+    def test_delete_empty(self, cluster):
+        master, servers, env = cluster
+        (fid, url), = write_files(master, 1)
+        heartbeat_all(servers)
+        call(url, f"/{fid}", method="DELETE")
+        heartbeat_all(servers)
+        vid = int(fid.split(",")[0])
+        # default quiet window protects the freshly touched volume
+        assert not any(p["volume"] == vid
+                       for p in vol.volume_delete_empty(env,
+                                                        plan_only=True))
+        plan = vol.volume_delete_empty(env, quiet_for=0.0, plan_only=True)
+        assert any(p["volume"] == vid for p in plan)
+
+
+class TestCollectionAndCluster:
+    def test_collection_list_and_delete(self, cluster):
+        master, servers, env = cluster
+        write_files(master, 1, collection="logs")
+        heartbeat_all(servers)
+        assert "logs" in vol.collection_list(env)
+        deleted = vol.collection_delete(env, "logs")
+        assert deleted
+        heartbeat_all(servers)
+        assert "logs" not in vol.collection_list(env)
+
+    def test_cluster_ps_and_check(self, cluster):
+        master, servers, env = cluster
+        ps = vol.cluster_ps(env)
+        assert len(ps["volume_servers"]) == 3
+        assert any(m["role"] == "leader" for m in ps["masters"])
+        assert vol.cluster_check(env) == []
+
+    def test_raft_membership(self, cluster):
+        master, servers, env = cluster
+        before = vol.cluster_raft_ps(env)
+        vol.cluster_raft_add(env, "127.0.0.1:1")
+        assert "127.0.0.1:1" in vol.cluster_raft_ps(env)["peers"]
+        vol.cluster_raft_remove(env, "127.0.0.1:1")
+        assert "127.0.0.1:1" not in vol.cluster_raft_ps(env)["peers"]
+        assert set(vol.cluster_raft_ps(env)["peers"]) \
+            == set(before["peers"])
+
+    def test_lock_blocks_second_client(self, cluster):
+        master, servers, env = cluster
+        vol.shell_lock(env, client="one")
+        other = sh.CommandEnv(master.address)
+        with pytest.raises(RpcError) as e:
+            vol.shell_lock(other, client="two")
+        assert e.value.status == 423
+        vol.shell_unlock(env)
+        vol.shell_lock(other, client="two")
+
+    def test_server_leave(self, cluster):
+        master, servers, env = cluster
+        urls = [n.url for n in vol.collect_volume_servers(env)]
+        vol.volume_server_leave(env, urls[0])
+        left = [n.url for n in vol.collect_volume_servers(env)]
+        assert urls[0] not in left
+
+
+class TestFsCommands:
+    @pytest.fixture
+    def with_filer(self, cluster):
+        master, servers, env = cluster
+        filer = FilerServer(master.address, port=0, chunk_size=512)
+        filer.start()
+        env.filer_address = filer.address
+        yield master, servers, env, filer
+        filer.stop()
+
+    def seed(self, filer):
+        for path, body in [("/docs/a.txt", b"aaa"),
+                           ("/docs/sub/b.txt", b"bbbb"),
+                           ("/top.bin", b"t" * 3000)]:
+            call(filer.address, path, raw=body, method="POST")
+
+    def test_ls_du_tree_cat(self, with_filer):
+        master, servers, env, filer = with_filer
+        self.seed(filer)
+        names = {e["name"] for e in fs.fs_ls(env, "/")}
+        assert {"docs", "top.bin"} <= names
+        du = fs.fs_du(env, "/")
+        assert du["files"] == 3 and du["bytes"] == 3 + 4 + 3000
+        tree = fs.fs_tree(env, "/")
+        assert "docs/" in tree and "  sub/" in tree
+        assert fs.fs_cat(env, "/docs/a.txt") == b"aaa"
+
+    def test_mkdir_mv_rm(self, with_filer):
+        master, servers, env, filer = with_filer
+        self.seed(filer)
+        fs.fs_mkdir(env, "/newdir")
+        assert any(e["name"] == "newdir" and e["is_dir"]
+                   for e in fs.fs_ls(env, "/"))
+        fs.fs_mv(env, "/docs/a.txt", "/newdir/a.txt")
+        assert fs.fs_cat(env, "/newdir/a.txt") == b"aaa"
+        fs.fs_rm(env, "/newdir", recursive=True)
+        assert not any(e["name"] == "newdir" for e in fs.fs_ls(env, "/"))
+
+    def test_meta_save_load_roundtrip(self, with_filer, tmp_path):
+        master, servers, env, filer = with_filer
+        self.seed(filer)
+        dump = str(tmp_path / "meta.jsonl")
+        saved = fs.fs_meta_save(env, "/", output=dump)
+        assert any(e["full_path"] == "/top.bin" for e in saved)
+        # wipe the chunked file's metadata, then restore it
+        meta = fs.fs_meta_cat(env, "/top.bin")
+        assert meta["chunks"]
+        call(filer.address, "/top.bin?skipChunkDelete=true",
+             method="DELETE")
+        with pytest.raises(RpcError):
+            fs.fs_cat(env, "/top.bin")
+        loaded = fs.fs_meta_load(env, dump)
+        assert loaded == len(saved)
+        assert fs.fs_cat(env, "/top.bin") == b"t" * 3000
+
+    def test_fs_configure_rules(self, with_filer):
+        master, servers, env, filer = with_filer
+        conf = fs.fs_configure(env, "/protected/", read_only=True)
+        assert conf["locations"][0]["read_only"] is True
+        time.sleep(1.1)  # filer conf cache refresh window
+        with pytest.raises(RpcError) as e:
+            call(filer.address, "/protected/x", raw=b"no", method="POST")
+        assert e.value.status == 403
+        fs.fs_configure(env, "/protected/", delete=True)
+
+
+class TestS3Commands:
+    @pytest.fixture
+    def with_filer(self, cluster):
+        master, servers, env = cluster
+        filer = FilerServer(master.address, port=0)
+        filer.start()
+        env.filer_address = filer.address
+        yield env, filer
+        filer.stop()
+
+    def test_bucket_lifecycle(self, with_filer):
+        env, filer = with_filer
+        assert fs.s3_bucket_list(env) == []
+        fs.s3_bucket_create(env, "media")
+        assert [b["name"] for b in fs.s3_bucket_list(env)] == ["media"]
+        fs.s3_bucket_delete(env, "media")
+        assert fs.s3_bucket_list(env) == []
+
+    def test_clean_uploads(self, with_filer):
+        env, filer = with_filer
+        fs.s3_bucket_create(env, "b1")
+        call(filer.address, "/buckets/b1/.uploads/u1/", raw=b"",
+             method="POST")
+        assert fs.s3_clean_uploads(env, timeout_seconds=0.0) \
+            == ["/buckets/b1/.uploads/u1"]
+
+    def test_s3_configure_identity(self, with_filer):
+        env, filer = with_filer
+        conf = fs.s3_configure(env, "alice", "AKID", "SECRET",
+                               actions=["Read", "Write"])
+        assert conf["identities"][0]["name"] == "alice"
+        raw = call(filer.address, "/etc/iam/identity.json")
+        stored = raw if isinstance(raw, dict) else json.loads(raw)
+        assert stored["identities"][0]["credentials"][0]["accessKey"] \
+            == "AKID"

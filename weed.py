@@ -202,46 +202,163 @@ def cmd_server(args):
     _wait_forever(stoppables)
 
 
+def _shell_handlers(env):
+    """The full admin command registry (weed/shell/commands.go)."""
+    from seaweedfs_tpu.shell import commands as sh
+    from seaweedfs_tpu.shell import commands_fs as fs
+    from seaweedfs_tpu.shell import commands_volume as vol
+
+    def show(value):
+        print(json.dumps(value, indent=2, default=str))
+
+    def flag(a, name, default=None):
+        for item in a:
+            if item.startswith(f"-{name}="):
+                return item.split("=", 1)[1]
+        return default
+
+    plan = lambda a: "-plan" in a or "-n" in a
+    return {
+        # volume family
+        "volume.list": lambda a: show(sh.volume_list(env)),
+        "volume.vacuum": lambda a: show(sh.volume_vacuum(
+            env, float(a[0]) if a else None)),
+        "volume.balance": lambda a: show(vol.volume_balance(
+            env, collection=flag(a, "collection", "ALL"),
+            plan_only=plan(a))),
+        "volume.move": lambda a: show(vol.volume_move(
+            env, int(a[0]), a[1], a[2], plan_only=plan(a))),
+        "volume.copy": lambda a: show(vol.volume_copy(
+            env, int(a[0]), a[1], a[2])),
+        "volume.delete": lambda a: show(vol.volume_delete(
+            env, int(a[0]), a[1])),
+        "volume.delete_empty": lambda a: show(vol.volume_delete_empty(
+            env, plan_only=plan(a))),
+        "volume.mount": lambda a: show(vol.volume_mount(
+            env, int(a[0]), a[1])),
+        "volume.unmount": lambda a: show(vol.volume_unmount(
+            env, int(a[0]), a[1])),
+        "volume.mark": lambda a: show(vol.volume_mark(
+            env, int(a[0]), a[1], writable="-writable" in a)),
+        "volume.fix.replication": lambda a: show(
+            vol.volume_fix_replication(env, plan_only=plan(a))),
+        "volume.check.disk": lambda a: show(vol.volume_check_disk(
+            env, plan_only=plan(a))),
+        "volume.fsck": lambda a: show(vol.volume_fsck(
+            env, filer_address=flag(a, "filer", ""),
+            verbose="-v" in a)),
+        "volume.configure.replication": lambda a: show(
+            vol.volume_configure_replication(
+                env, int(a[0]), flag(a, "replication", "000"))),
+        "volume.server.evacuate": lambda a: show(
+            vol.volume_server_evacuate(env, a[0], plan_only=plan(a))),
+        "volume.server.leave": lambda a: show(
+            vol.volume_server_leave(env, a[0])),
+        "volume.query": lambda a: show(sh.volume_query(
+            env, [a[0]],
+            selections=(flag(a, "select", "") or "").split(",")
+            if flag(a, "select") else None,
+            field=flag(a, "field", ""), op=flag(a, "op", ""),
+            value=flag(a, "value", ""), csv="-csv" in a)),
+        # ec family
+        "ec.encode": lambda a: show(sh.ec_encode(
+            env, int(a[0]), plan_only=plan(a))),
+        "ec.decode": lambda a: show(sh.ec_decode(
+            env, int(a[0]), plan_only=plan(a))),
+        "ec.rebuild": lambda a: show(sh.ec_rebuild(
+            env, int(a[0]), plan_only=plan(a))),
+        "ec.balance": lambda a: show(sh.ec_balance(
+            env, plan_only=plan(a))),
+        # collection / cluster
+        "collection.list": lambda a: show(vol.collection_list(env)),
+        "collection.delete": lambda a: show(vol.collection_delete(
+            env, a[0], plan_only=plan(a))),
+        "cluster.ps": lambda a: show(vol.cluster_ps(env)),
+        "cluster.check": lambda a: show(vol.cluster_check(env)),
+        "cluster.raft.ps": lambda a: show(vol.cluster_raft_ps(env)),
+        "cluster.raft.add": lambda a: show(vol.cluster_raft_add(
+            env, a[0])),
+        "cluster.raft.remove": lambda a: show(vol.cluster_raft_remove(
+            env, a[0])),
+        "lock": lambda a: show(vol.shell_lock(env)),
+        "unlock": lambda a: show(vol.shell_unlock(env)),
+        # fs family
+        "fs.ls": lambda a: show(fs.fs_ls(
+            env, a[-1] if a and not a[-1].startswith("-") else "/",
+            long_format="-l" in a)),
+        "fs.cat": lambda a: sys.stdout.buffer.write(
+            fs.fs_cat(env, a[0])),
+        "fs.mkdir": lambda a: show(fs.fs_mkdir(env, a[0])),
+        "fs.rm": lambda a: fs.fs_rm(
+            env, a[-1], recursive="-r" in a),
+        "fs.mv": lambda a: show(fs.fs_mv(env, a[0], a[1])),
+        "fs.du": lambda a: show(fs.fs_du(env, a[0] if a else "/")),
+        "fs.tree": lambda a: print("\n".join(fs.fs_tree(
+            env, a[0] if a else "/"))),
+        "fs.meta.cat": lambda a: show(fs.fs_meta_cat(env, a[0])),
+        "fs.meta.save": lambda a: show({"saved": len(fs.fs_meta_save(
+            env, a[-1] if a and not a[-1].startswith("-") else "/",
+            output=flag(a, "o", "")))}),
+        "fs.meta.load": lambda a: show(
+            {"loaded": fs.fs_meta_load(env, a[0])}),
+        "fs.configure": lambda a: show(fs.fs_configure(
+            env, flag(a, "locationPrefix", a[0] if a else "/"),
+            collection=flag(a, "collection", ""),
+            replication=flag(a, "replication", ""),
+            ttl=flag(a, "ttl", ""),
+            read_only=True if "-readOnly" in a else None,
+            delete="-delete" in a)),
+        # s3 family
+        "s3.bucket.list": lambda a: show(fs.s3_bucket_list(env)),
+        "s3.bucket.create": lambda a: show(fs.s3_bucket_create(
+            env, flag(a, "name", a[0] if a else ""))),
+        "s3.bucket.delete": lambda a: fs.s3_bucket_delete(
+            env, flag(a, "name", a[0] if a else "")),
+        "s3.clean.uploads": lambda a: show(fs.s3_clean_uploads(
+            env, float(flag(a, "timeAgo", 24 * 3600)))),
+        "s3.configure": lambda a: show(fs.s3_configure(
+            env, flag(a, "user", "admin"),
+            flag(a, "access_key", ""), flag(a, "secret_key", ""),
+            actions=(flag(a, "actions", "Admin") or "").split(","))),
+    }
+
+
 def cmd_shell(args):
     from seaweedfs_tpu.shell import commands as sh
 
-    env = sh.CommandEnv(args.master)
+    env = sh.CommandEnv(args.master, filer_address=args.filer)
+    handlers = _shell_handlers(env)
+
+    def run_line(line: str) -> bool:
+        if line in (".exit", "exit", "quit"):
+            return False
+        if line in (".help", "help"):
+            print("commands:", ", ".join(sorted(handlers)))
+            return True
+        name, *rest = line.split()
+        fn = handlers.get(name)
+        if fn is None:
+            print(f"unknown command {name!r}; .help lists commands")
+            return True
+        try:
+            fn(rest)
+        except (RpcError, ValueError, IndexError) as e:
+            print(f"error: {e}")
+        return True
+
+    if args.c:
+        for line in args.c.split(";"):
+            if line.strip() and not run_line(line.strip()):
+                return
+        return
     print(f"connected to master {args.master}; .help for commands")
-    handlers = {
-        "volume.list": lambda a: print(json.dumps(sh.volume_list(env),
-                                                  indent=2)),
-        "volume.vacuum": lambda a: print(sh.volume_vacuum(
-            env, float(a[0]) if a else None)),
-        "ec.encode": lambda a: print(sh.ec_encode(
-            env, int(a[0]), plan_only="-plan" in a)),
-        "ec.decode": lambda a: print(sh.ec_decode(
-            env, int(a[0]), plan_only="-plan" in a)),
-        "ec.rebuild": lambda a: print(sh.ec_rebuild(
-            env, int(a[0]), plan_only="-plan" in a)),
-        "ec.balance": lambda a: print(sh.ec_balance(
-            env, plan_only="-plan" in a)),
-    }
     while True:
         try:
             line = input("> ").strip()
         except EOFError:
             return
-        if not line:
-            continue
-        if line in (".exit", "exit", "quit"):
+        if line and not run_line(line):
             return
-        if line == ".help":
-            print("commands:", ", ".join(sorted(handlers)))
-            continue
-        name, *rest = line.split()
-        fn = handlers.get(name)
-        if fn is None:
-            print(f"unknown command {name!r}; .help lists commands")
-            continue
-        try:
-            fn(rest)
-        except (RpcError, ValueError) as e:
-            print(f"error: {e}")
 
 
 def cmd_benchmark(args):
@@ -306,7 +423,10 @@ def cmd_filer_sync(args):
 
     import hashlib as _hashlib
 
-    state = args.state or _sync_state_path(f"{args.a}{args.b}")
+    # key includes the paths: different path pairs between the same
+    # endpoints must not share cursors
+    state = args.state or _sync_state_path(
+        f"{args.a}{args.a_path}|{args.b}{args.b_path}")
     offsets = _load_offsets(state)
 
     def _sig(tag: str) -> int:
@@ -354,7 +474,8 @@ def cmd_filer_backup(args):
     source = FilerSource(args.filer, args.filerPath)
     rep = Replicator(source, sink,
                      exclude_dirs=[d for d in args.exclude.split(",") if d])
-    state = args.state or _sync_state_path(f"backup{args.filer}{args.sink}")
+    state = args.state or _sync_state_path(
+        f"backup{args.filer}{args.filerPath}|{args.sink}")
     offsets = _load_offsets(state)
     while True:
         applied, cursor = rep.run_once(offsets.get("backup", 0))
@@ -508,6 +629,10 @@ def main(argv=None):
 
     p = sub.add_parser("shell", help="interactive admin shell")
     p.add_argument("-master", default="127.0.0.1:9333")
+    p.add_argument("-filer", default="",
+                   help="filer for fs.*/s3.* (default: discover via master)")
+    p.add_argument("-c", default="",
+                   help="run ;-separated commands and exit")
     p.set_defaults(fn=cmd_shell)
 
     p = sub.add_parser("benchmark", help="write/read load benchmark")
