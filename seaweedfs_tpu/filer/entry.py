@@ -67,6 +67,10 @@ class Entry:
     content: bytes = b""  # inlined small-file content
     hard_link_id: str = ""
     symlink_target: str = ""
+    # remote-storage mount bookkeeping (filer.proto RemoteEntry): set on
+    # entries under a mounted directory; a file with a remote_entry and
+    # no chunks reads through to the remote object
+    remote_entry: dict = field(default_factory=dict)
 
     @property
     def name(self) -> str:
@@ -102,6 +106,8 @@ class Entry:
             "content": self.content.hex() if self.content else "",
             "hard_link_id": self.hard_link_id,
             "symlink_target": self.symlink_target,
+            **({"remote_entry": self.remote_entry}
+               if self.remote_entry else {}),
         }
 
     @classmethod
@@ -119,6 +125,7 @@ class Entry:
             content=bytes.fromhex(d["content"]) if d.get("content") else b"",
             hard_link_id=d.get("hard_link_id", ""),
             symlink_target=d.get("symlink_target", ""),
+            remote_entry=d.get("remote_entry", {}),
         )
 
 

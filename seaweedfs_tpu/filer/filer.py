@@ -327,7 +327,7 @@ class Filer:
                 children = self.store.list_directory(path, limit=1)
                 if children and not recursive:
                     raise ValueError(f"{path} is not empty")
-                self._delete_recursive(path)
+                self._delete_recursive(path, delete_chunks)
                 self.store.delete_entry(path)
             else:
                 self.store.delete_entry(path)
@@ -357,18 +357,19 @@ class Filer:
         elif self.on_delete_chunks and entry.chunks:
             self.on_delete_chunks(entry.chunks)
 
-    def _delete_recursive(self, dir_path: str):
+    def _delete_recursive(self, dir_path: str, delete_chunks: bool = True):
         while True:
             children = self.store.list_directory(dir_path, limit=1024)
             if not children:
                 break
             for child in children:
                 if child.is_directory:
-                    self._delete_recursive(child.full_path)
+                    self._delete_recursive(child.full_path, delete_chunks)
                     self.store.delete_entry(child.full_path)
                 else:
                     self.store.delete_entry(child.full_path)
-                    self._release_file(child)
+                    if delete_chunks:
+                        self._release_file(child)
 
     def list_directory(self, path: str, start_file: str = "",
                        limit: int = 1024, prefix: str = "",

@@ -66,6 +66,13 @@ class FilerServer:
         self.server = RpcServer(host, port)
         self.server.add("GET", "/metadata/subscribe", self._h_subscribe)
         self.server.add("GET", "/metadata/aggregate", self._h_aggregate)
+        self.server.add("POST", "/remote/configure", self._h_remote_configure)
+        self.server.add("GET", "/remote/list", self._h_remote_list)
+        self.server.add("POST", "/remote/mount", self._h_remote_mount)
+        self.server.add("POST", "/remote/unmount", self._h_remote_unmount)
+        self.server.add("POST", "/remote/meta_sync", self._h_remote_meta_sync)
+        self.server.add("POST", "/remote/cache", self._h_remote_cache)
+        self.server.add("POST", "/remote/uncache", self._h_remote_uncache)
         self.server.default_route = self._handle
         self._stop_event = threading.Event()
         self._register_thread: Optional[threading.Thread] = None
@@ -296,6 +303,12 @@ class FilerServer:
             length = size - start
         if entry.content:
             return entry.content[start:start + length]
+        if entry.remote_entry and not entry.chunks:
+            # metadata-only remote mount entry: read through to the
+            # remote object (read_remote.go; remote.cache materialises)
+            from .remote_storage import read_through
+
+            return read_through(self.filer, entry)[start:start + length]
         chunks = entry.chunks
         if has_chunk_manifest(chunks):
             chunks = resolve_chunk_manifest(self._fetch_chunk, chunks)
@@ -391,6 +404,128 @@ class FilerServer:
         except ValueError as e:
             raise RpcError(str(e), 400)
         return Response(b"", 204)
+
+    # -- remote storage mounts (weed/filer/remote_storage.go; shell
+    # remote.* commands drive these endpoints) -------------------------------
+    def _h_remote_configure(self, req: Request):
+        from ..remote_storage import RemoteConf
+        from . import remote_storage as rs
+
+        p = req.json()
+        if p.get("delete"):
+            rs.delete_remote_conf(self.filer, p["name"])
+            return {}
+        conf = RemoteConf.from_dict(p)
+        if conf.type not in ("s3", "local"):
+            raise RpcError(f"unknown remote type {conf.type!r}", 400)
+        rs.save_remote_conf(self.filer, conf)
+        return conf.to_dict()
+
+    def _h_remote_list(self, req: Request):
+        from . import remote_storage as rs
+
+        return {
+            "storages": [c.to_dict()
+                         for c in rs.list_remote_confs(self.filer)],
+            "mappings": rs.read_mount_mappings(self.filer),
+        }
+
+    def _h_remote_mount(self, req: Request):
+        from ..remote_storage import RemoteLocation
+        from . import remote_storage as rs
+
+        p = req.json()
+        directory, remote = p["dir"], p["remote"]
+        try:  # validate the storage name before touching any state
+            rs.load_remote_conf(self.filer,
+                                RemoteLocation.parse(remote).name)
+        except NotFoundError as e:
+            raise RpcError(str(e), 404)
+        self.filer._ensure_parents(directory.rstrip("/") or "/")
+        from .entry import new_directory_entry
+
+        try:
+            self.filer.find_entry(directory.rstrip("/"))
+        except NotFoundError:
+            self.filer.create_entry(
+                new_directory_entry(directory.rstrip("/")))
+        rs.insert_mount_mapping(self.filer, directory, remote)
+        synced = rs.sync_metadata(self.filer, directory)
+        return {"dir": directory, "remote": remote, "synced": synced}
+
+    def _h_remote_unmount(self, req: Request):
+        from . import remote_storage as rs
+
+        directory = req.json()["dir"].rstrip("/") or "/"
+        if directory not in rs.read_mount_mappings(self.filer):
+            raise RpcError(f"{directory} is not mounted", 404)
+        rs.delete_mount_mapping(self.filer, directory)
+        try:
+            self.filer.delete_entry(directory, recursive=True)
+        except NotFoundError:
+            pass
+        return {}
+
+    def _h_remote_meta_sync(self, req: Request):
+        from . import remote_storage as rs
+
+        directory = req.json()["dir"]
+        try:
+            return {"synced": rs.sync_metadata(self.filer, directory)}
+        except NotFoundError as e:
+            raise RpcError(str(e), 404)
+
+    def _walk_remote_entries(self, directory: str):
+        stack = [directory.rstrip("/") or "/"]
+        while stack:
+            d = stack.pop()
+            for e in self.filer.list_directory(d, limit=100000):
+                if e.is_directory:
+                    stack.append(e.full_path)
+                elif e.remote_entry:
+                    yield e
+
+    def _h_remote_cache(self, req: Request):
+        """Materialise remote objects locally (command_remote_cache.go)."""
+        from . import remote_storage as rs
+
+        directory = req.json()["dir"]
+        cached = 0
+        for entry in self._walk_remote_entries(directory):
+            if entry.chunks or entry.content:
+                continue  # already cached
+            data = rs.read_through(self.filer, entry)
+            entry.attr.file_size = len(data)
+            entry.attr.md5 = hashlib.md5(data).hexdigest()
+            if len(data) <= INLINE_LIMIT:
+                entry.content = data
+            else:
+                offset = 0
+                while offset < len(data):
+                    piece = data[offset:offset + self.chunk_size]
+                    chunk = self._upload_blob(piece)
+                    chunk.offset = offset
+                    entry.chunks.append(chunk)
+                    offset += len(piece)
+            self.filer.create_entry(entry)
+            cached += 1
+        return {"cached": cached}
+
+    def _h_remote_uncache(self, req: Request):
+        """Drop local copies, keep remote metadata
+        (command_remote_uncache.go)."""
+        directory = req.json()["dir"]
+        uncached = 0
+        for entry in self._walk_remote_entries(directory):
+            if not entry.chunks and not entry.content:
+                continue
+            if entry.chunks:
+                self._delete_chunks(entry.chunks)
+            entry.chunks = []
+            entry.content = b""
+            self.filer.create_entry(entry)
+            uncached += 1
+        return {"uncached": uncached}
 
     # -- metadata subscription ----------------------------------------------
     def _h_subscribe(self, req: Request):

@@ -350,6 +350,16 @@ class MasterServer:
                 for node in rack.nodes.values()
                 for v in node.volumes.values() if v.collection == name
             ]
+            # EC shards of the collection go too (topology
+            # DeleteCollection covers both normal and EC volumes)
+            ec_targets = [
+                (node.url, vid, sorted(node.ec_shards[vid].shard_ids()))
+                for dc in self.topo.dcs.values()
+                for rack in dc.racks.values()
+                for node in rack.nodes.values()
+                for vid in node.ec_shards
+                if self.topo.ec_collections.get(vid, "") == name
+            ]
         for url, vid in targets:
             try:
                 call(url, "/admin/delete_volume",
@@ -358,6 +368,16 @@ class MasterServer:
             except RpcError as e:
                 deleted.append({"url": url, "volume": vid,
                                 "error": str(e)})
+        for url, vid, shard_ids in ec_targets:
+            try:
+                call(url, "/admin/ec/delete_shards",
+                     {"volume": vid, "collection": name,
+                      "shard_ids": shard_ids}, timeout=60)
+                deleted.append({"url": url, "volume": vid,
+                                "ec_shards": shard_ids})
+            except RpcError as e:
+                deleted.append({"url": url, "volume": vid,
+                                "ec_shards": shard_ids, "error": str(e)})
         return {"deleted": deleted}
 
     # -- cluster membership (cluster/cluster.go, KeepConnected registry) -----

@@ -75,7 +75,7 @@ class S3Client:
 
     def _request(self, method: str, path: str,
                  query: Optional[dict] = None, body: bytes = b"",
-                 content_type: str = ""):
+                 content_type: str = "", parse: bool = True):
         query = query or {}
         headers = self._sign(method, path, query, body)
         if content_type:
@@ -84,7 +84,8 @@ class S3Client:
         # send the same quoted path the signature canonicalises
         full = urllib.parse.quote(path, safe="/~") + ("?" + qs if qs else "")
         return call(self.endpoint, full, raw=body if body else None,
-                    method=method, headers=headers, timeout=120)
+                    method=method, headers=headers, timeout=120,
+                    parse=parse)
 
     # -- object ops ----------------------------------------------------------
     def create_bucket(self, bucket: str):
@@ -103,14 +104,8 @@ class S3Client:
                       content_type=content_type)
 
     def get_object(self, bucket: str, key: str) -> bytes:
-        query: dict = {}
-        headers = self._sign("GET", f"/{bucket}/{key.lstrip('/')}",
-                             query, b"")
-        body = call(self.endpoint,
-                    urllib.parse.quote(f"/{bucket}/{key.lstrip('/')}",
-                                       safe="/~"),
-                    method="GET", headers=headers, timeout=120,
-                    parse=False)
+        body = self._request("GET", f"/{bucket}/{key.lstrip('/')}",
+                             parse=False)
         return body if isinstance(body, bytes) else b""
 
     def delete_object(self, bucket: str, key: str):
@@ -120,10 +115,17 @@ class S3Client:
             if e.status != 404:
                 raise
 
-    def list_keys(self, bucket: str, prefix: str = "") -> list[str]:
-        import re
+    def list_objects(self, bucket: str,
+                     prefix: str = "") -> list[dict]:
+        """ListObjectsV2 with pagination; returns
+        [{key, size, etag, last_modified}]."""
+        import xml.etree.ElementTree as ET
 
-        keys: list[str] = []
+        def text(node, tag):
+            child = node.find(f"{{*}}{tag}")
+            return child.text or "" if child is not None else ""
+
+        objects: list[dict] = []
         start_after = ""
         while True:
             query = {"list-type": "2", "prefix": prefix}
@@ -132,10 +134,19 @@ class S3Client:
             body = self._request("GET", f"/{bucket}", query=query)
             if not isinstance(body, bytes):
                 break
-            text = body.decode()
-            page = re.findall(r"<Key>([^<]+)</Key>", text)
-            keys.extend(page)
-            if not page or "<IsTruncated>true</IsTruncated>" not in text:
+            root = ET.fromstring(body)
+            page = root.findall("{*}Contents")
+            for node in page:
+                objects.append({
+                    "key": text(node, "Key"),
+                    "size": int(text(node, "Size") or 0),
+                    "etag": text(node, "ETag").strip('"'),
+                    "last_modified": text(node, "LastModified"),
+                })
+            if not page or text(root, "IsTruncated") != "true":
                 break
-            start_after = page[-1]
-        return keys
+            start_after = objects[-1]["key"]
+        return objects
+
+    def list_keys(self, bucket: str, prefix: str = "") -> list[str]:
+        return [o["key"] for o in self.list_objects(bucket, prefix)]

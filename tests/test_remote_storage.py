@@ -1,0 +1,259 @@
+"""Remote storage mounts (weed/remote_storage, weed/filer/remote_*.go,
+shell command_remote_*.go, command/filer_remote_sync.go)."""
+
+import json
+
+import pytest
+
+from seaweedfs_tpu.filer.server import FilerServer
+from seaweedfs_tpu.master.server import MasterServer
+from seaweedfs_tpu.remote_storage import (RemoteConf, RemoteLocation,
+                                          make_remote_client)
+from seaweedfs_tpu.rpc.http_rpc import RpcError, call
+from seaweedfs_tpu.shell import commands as sh
+from seaweedfs_tpu.shell import commands_remote as rem
+from seaweedfs_tpu.volume_server.server import VolumeServer
+
+
+class TestRemoteLocation:
+    def test_parse(self):
+        loc = RemoteLocation.parse("prod/bucket1/a/b")
+        assert (loc.name, loc.bucket, loc.path) \
+            == ("prod", "bucket1", "/a/b")
+        loc2 = RemoteLocation.parse("prod/bucket1")
+        assert loc2.path == "/"
+        assert str(loc) == "prod/bucket1/a/b"
+
+
+class TestLocalProvider:
+    def test_roundtrip_and_traverse(self, tmp_path):
+        conf = RemoteConf(name="n", type="local",
+                          directory=str(tmp_path / "remote"))
+        client = make_remote_client(conf)
+        loc = RemoteLocation.parse("n/bkt/data/x.bin")
+        client.write_file(loc, b"hello remote")
+        assert client.read_file(loc) == b"hello remote"
+        objs = list(client.traverse(RemoteLocation.parse("n/bkt")))
+        assert [o.key for o in objs] == ["data/x.bin"]
+        assert objs[0].size == len(b"hello remote")
+        client.delete_file(loc)
+        assert list(client.traverse(RemoteLocation.parse("n/bkt"))) == []
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    master = MasterServer(port=0, pulse_seconds=0.2)
+    master.start()
+    d = tmp_path / "v"
+    d.mkdir()
+    vs = VolumeServer([str(d)], master.address, port=0, pulse_seconds=0.2)
+    vs.start()
+    vs.heartbeat_once()
+    filer = FilerServer(master.address, port=0, chunk_size=512)
+    filer.start()
+    env = sh.CommandEnv(master.address, filer_address=filer.address)
+    yield master, vs, filer, env
+    filer.stop()
+    vs.stop()
+    master.stop()
+
+
+@pytest.fixture
+def remote_tree(tmp_path):
+    """A populated 'remote': local-dir provider with a few objects."""
+    root = tmp_path / "remote-root"
+    (root / "bkt" / "photos").mkdir(parents=True)
+    (root / "bkt" / "photos" / "cat.jpg").write_bytes(b"meow" * 700)
+    (root / "bkt" / "readme.md").write_bytes(b"# docs")
+    return str(root)
+
+
+class TestMountLifecycle:
+    def configure(self, env, remote_tree):
+        return rem.remote_configure(env, name="prod", type="local",
+                                    directory=remote_tree)
+
+    def test_configure_list_delete(self, cluster, remote_tree):
+        master, vs, filer, env = cluster
+        self.configure(env, remote_tree)
+        listed = rem.remote_configure(env)
+        assert [s["name"] for s in listed["storages"]] == ["prod"]
+        rem.remote_configure(env, name="prod", delete=True)
+        assert rem.remote_configure(env)["storages"] == []
+
+    def test_mount_reads_through_and_caches(self, cluster, remote_tree):
+        master, vs, filer, env = cluster
+        self.configure(env, remote_tree)
+        out = rem.remote_mount(env, "/mnt/prod", "prod/bkt")
+        assert out["synced"] == 2
+        assert rem.remote_mount(env) == {"/mnt/prod": "prod/bkt/"} \
+            or "/mnt/prod" in rem.remote_mount(env)
+
+        # metadata landed without content
+        meta = call(filer.address, "/mnt/prod/photos/?metadata=true")
+        entry = meta["Entries"][0]
+        assert entry["remote_entry"]["storage_name"] == "prod"
+        assert not entry["chunks"]
+
+        # read-through proxies the remote object
+        assert call(filer.address, "/mnt/prod/photos/cat.jpg",
+                    parse=False) == b"meow" * 700
+        assert call(filer.address, "/mnt/prod/readme.md",
+                    parse=False) == b"# docs"
+
+        # cache materialises chunks; uncache drops them
+        assert rem.remote_cache(env, "/mnt/prod")["cached"] == 2
+        meta = call(filer.address, "/mnt/prod/photos/?metadata=true")
+        assert meta["Entries"][0]["chunks"]  # 2800 bytes > inline limit
+        assert call(filer.address, "/mnt/prod/photos/cat.jpg",
+                    parse=False) == b"meow" * 700
+        assert rem.remote_uncache(env, "/mnt/prod")["uncached"] == 2
+        meta = call(filer.address, "/mnt/prod/photos/?metadata=true")
+        assert not meta["Entries"][0]["chunks"]
+        assert call(filer.address, "/mnt/prod/photos/cat.jpg",
+                    parse=False) == b"meow" * 700
+
+    def test_meta_sync_picks_up_remote_changes(self, cluster,
+                                               remote_tree, tmp_path):
+        master, vs, filer, env = cluster
+        self.configure(env, remote_tree)
+        rem.remote_mount(env, "/mnt/prod", "prod/bkt")
+        import os
+
+        with open(os.path.join(remote_tree, "bkt", "new.txt"), "wb") as f:
+            f.write(b"fresh")
+        assert rem.remote_meta_sync(env, "/mnt/prod")["synced"] >= 1
+        assert call(filer.address, "/mnt/prod/new.txt",
+                    parse=False) == b"fresh"
+
+    def test_unmount_removes_tree_and_mapping(self, cluster, remote_tree):
+        master, vs, filer, env = cluster
+        self.configure(env, remote_tree)
+        rem.remote_mount(env, "/mnt/prod", "prod/bkt")
+        rem.remote_unmount(env, "/mnt/prod")
+        assert rem.remote_mount(env) == {}
+        with pytest.raises(RpcError):
+            call(filer.address, "/mnt/prod/readme.md", parse=False)
+
+
+class TestRemoteSyncCli:
+    def test_push_local_changes(self, cluster, remote_tree, tmp_path):
+        import os
+        import weed
+
+        master, vs, filer, env = cluster
+        rem.remote_configure(env, name="prod", type="local",
+                             directory=remote_tree)
+        rem.remote_mount(env, "/mnt/prod", "prod/bkt")
+        # a local write under the mount...
+        call(filer.address, "/mnt/prod/local.bin", raw=b"local bytes",
+             method="POST")
+        state = str(tmp_path / "rsync.state")
+        weed.main(["filer.remote.sync", "-filer", filer.address,
+                   "-dir", "/mnt/prod", "-state", state, "-once"])
+        # ...lands on the remote
+        assert open(os.path.join(remote_tree, "bkt", "local.bin"),
+                    "rb").read() == b"local bytes"
+        # a local delete propagates too
+        call(filer.address, "/mnt/prod/local.bin", method="DELETE")
+        weed.main(["filer.remote.sync", "-filer", filer.address,
+                   "-dir", "/mnt/prod", "-state", state, "-once"])
+        assert not os.path.exists(
+            os.path.join(remote_tree, "bkt", "local.bin"))
+
+
+class TestS3Provider:
+    def test_mount_own_gateway(self, cluster, tmp_path):
+        """The S3 provider against this framework's own gateway: a second
+        cluster's bucket is mounted into the first cluster's namespace."""
+        from seaweedfs_tpu.s3api.server import S3ApiServer
+
+        master, vs, filer, env = cluster
+        # second cluster acting as the 'remote'
+        m2 = MasterServer(port=0, pulse_seconds=0.2)
+        m2.start()
+        d2 = tmp_path / "v2"
+        d2.mkdir()
+        vs2 = VolumeServer([str(d2)], m2.address, port=0,
+                           pulse_seconds=0.2)
+        vs2.start()
+        vs2.heartbeat_once()
+        f2 = FilerServer(m2.address, port=0)
+        f2.start()
+        s3 = S3ApiServer(f2, port=0)
+        s3.start()
+        try:
+            from seaweedfs_tpu.wdclient.s3_client import S3Client
+
+            client = S3Client(s3.address)
+            client.create_bucket("shared")
+            client.put_object("shared", "a/hello.txt", b"from far away")
+            rem.remote_configure(env, name="far", type="s3",
+                                 endpoint=s3.address)
+            out = rem.remote_mount(env, "/mnt/far", "far/shared")
+            assert out["synced"] == 1
+            assert call(filer.address, "/mnt/far/a/hello.txt",
+                        parse=False) == b"from far away"
+        finally:
+            s3.stop()
+            f2.stop()
+            vs2.stop()
+            m2.stop()
+
+
+class TestReviewFixes:
+    def test_meta_sync_removes_stale_entries(self, cluster, remote_tree):
+        import os
+
+        master, vs, filer, env = cluster
+        rem.remote_configure(env, name="prod", type="local",
+                             directory=remote_tree)
+        rem.remote_mount(env, "/mnt/prod", "prod/bkt")
+        os.remove(os.path.join(remote_tree, "bkt", "readme.md"))
+        rem.remote_meta_sync(env, "/mnt/prod")
+        with pytest.raises(RpcError):
+            call(filer.address, "/mnt/prod/readme.md", parse=False)
+        # cached (locally materialised) entries survive remote deletion
+        rem.remote_cache(env, "/mnt/prod")
+        os.remove(os.path.join(remote_tree, "bkt", "photos", "cat.jpg"))
+        rem.remote_meta_sync(env, "/mnt/prod")
+        assert call(filer.address, "/mnt/prod/photos/cat.jpg",
+                    parse=False) == b"meow" * 700
+
+    def test_mount_unconfigured_remote_is_404(self, cluster):
+        master, vs, filer, env = cluster
+        with pytest.raises(RpcError) as e:
+            rem.remote_mount(env, "/mnt/x", "nosuch/bkt")
+        assert e.value.status == 404
+
+    def test_remote_sync_rename_and_rmdir(self, cluster, remote_tree,
+                                          tmp_path):
+        import os
+        import weed
+
+        master, vs, filer, env = cluster
+        rem.remote_configure(env, name="prod", type="local",
+                             directory=remote_tree)
+        rem.remote_mount(env, "/mnt/prod", "prod/bkt")
+        state = str(tmp_path / "rs.state")
+        args = ["filer.remote.sync", "-filer", filer.address,
+                "-dir", "/mnt/prod", "-state", state, "-once"]
+        call(filer.address, "/mnt/prod/sub/one.bin", raw=b"payload",
+             method="POST")
+        weed.main(args)
+        assert os.path.exists(
+            os.path.join(remote_tree, "bkt", "sub", "one.bin"))
+        # rename: old remote object must disappear
+        call(filer.address, "/mnt/prod/sub/two.bin?mv.from="
+             "/mnt/prod/sub/one.bin", raw=b"", method="POST")
+        weed.main(args)
+        assert not os.path.exists(
+            os.path.join(remote_tree, "bkt", "sub", "one.bin"))
+        assert open(os.path.join(remote_tree, "bkt", "sub", "two.bin"),
+                    "rb").read() == b"payload"
+        # recursive dir delete: the whole remote prefix goes
+        call(filer.address, "/mnt/prod/sub?recursive=true",
+             method="DELETE")
+        weed.main(args)
+        assert not os.path.exists(
+            os.path.join(remote_tree, "bkt", "sub", "two.bin"))

@@ -319,3 +319,29 @@ class TestS3Commands:
         stored = raw if isinstance(raw, dict) else json.loads(raw)
         assert stored["identities"][0]["credentials"][0]["accessKey"] \
             == "AKID"
+
+
+class TestReviewFixes:
+    def test_recursive_skip_chunk_delete_preserves_needles(self, cluster):
+        master, servers, env = cluster
+        filer = FilerServer(master.address, port=0, chunk_size=512)
+        filer.start()
+        env.filer_address = filer.address
+        try:
+            call(filer.address, "/d/big.bin", raw=b"z" * 3000,
+                 method="POST")
+            saved = fs.fs_meta_save(env, "/")
+            call(filer.address, "/d?recursive=true&skipChunkDelete=true",
+                 method="DELETE")
+            import tempfile, os
+
+            fd, dump = tempfile.mkstemp()
+            os.close(fd)
+            with open(dump, "w") as f:
+                for r in saved:
+                    f.write(json.dumps(r) + "\n")
+            fs.fs_meta_load(env, dump)
+            os.unlink(dump)
+            assert fs.fs_cat(env, "/d/big.bin") == b"z" * 3000
+        finally:
+            filer.stop()

@@ -206,6 +206,7 @@ def _shell_handlers(env):
     """The full admin command registry (weed/shell/commands.go)."""
     from seaweedfs_tpu.shell import commands as sh
     from seaweedfs_tpu.shell import commands_fs as fs
+    from seaweedfs_tpu.shell import commands_remote as rem
     from seaweedfs_tpu.shell import commands_volume as vol
 
     def show(value):
@@ -308,6 +309,26 @@ def _shell_handlers(env):
             ttl=flag(a, "ttl", ""),
             read_only=True if "-readOnly" in a else None,
             delete="-delete" in a)),
+        # remote storage family
+        "remote.configure": lambda a: show(rem.remote_configure(
+            env, name=flag(a, "name", ""), type=flag(a, "type", "s3"),
+            endpoint=flag(a, "endpoint", ""),
+            access_key=flag(a, "access_key", ""),
+            secret_key=flag(a, "secret_key", ""),
+            directory=flag(a, "dir", ""), delete="-delete" in a)),
+        "remote.mount": lambda a: show(rem.remote_mount(
+            env, directory=flag(a, "dir", ""),
+            remote=flag(a, "remote", ""))),
+        "remote.unmount": lambda a: show(rem.remote_unmount(
+            env, flag(a, "dir", ""))),
+        "remote.meta.sync": lambda a: show(rem.remote_meta_sync(
+            env, flag(a, "dir", ""))),
+        "remote.cache": lambda a: show(rem.remote_cache(
+            env, flag(a, "dir", ""))),
+        "remote.uncache": lambda a: show(rem.remote_uncache(
+            env, flag(a, "dir", ""))),
+        "remote.mount.buckets": lambda a: show(rem.remote_mount_buckets(
+            env, flag(a, "remote", ""))),
         # s3 family
         "s3.bucket.list": lambda a: show(fs.s3_bucket_list(env)),
         "s3.bucket.create": lambda a: show(fs.s3_bucket_create(
@@ -532,6 +553,85 @@ def cmd_filer_meta_tail(args):
             _time.sleep(args.interval)
 
 
+def cmd_filer_remote_sync(args):
+    """Push local changes under a remote mount back to the remote
+    storage (weed/command/filer_remote_sync.go; filer.remote.gateway is
+    the same loop pointed at /buckets)."""
+    import time as _time
+
+    from seaweedfs_tpu.remote_storage import (RemoteConf, RemoteLocation,
+                                              make_remote_client)
+    from seaweedfs_tpu.replication import FilerSource
+
+    directory = args.dir.rstrip("/") or "/"
+    listing = call(args.filer, "/remote/list")
+    mappings = listing.get("mappings", {})
+    if directory not in mappings:
+        print(f"error: {directory} is not a remote mount "
+              f"(mounted: {sorted(mappings) or 'none'})")
+        sys.exit(1)
+    root = RemoteLocation.parse(mappings[directory])
+    conf = next((c for c in listing.get("storages", [])
+                 if c["name"] == root.name), None)
+    if conf is None:
+        print(f"error: remote storage {root.name!r} not configured")
+        sys.exit(1)
+    client = make_remote_client(RemoteConf.from_dict(conf))
+    source = FilerSource(args.filer, directory + "/")
+    state = args.state or _sync_state_path(
+        f"remote{args.filer}{directory}")
+    offsets = _load_offsets(state)
+    print(f"filer.remote.sync {args.filer}{directory} -> {root}")
+
+    def loc_of(full_path: str) -> "RemoteLocation":
+        rel = full_path[len(directory):].lstrip("/")
+        return RemoteLocation(root.name, root.bucket,
+                              root.path.rstrip("/") + "/" + rel)
+
+    while True:
+        cursor = offsets.get("sync", 0)
+        moved = 0
+        for event in source.subscribe(cursor):
+            old, new = event.get("old_entry"), event.get("new_entry")
+
+            def in_mount(e):
+                return e and e["full_path"].startswith(directory + "/")
+
+            def entry_is_dir(e):
+                return bool(e.get("attr", {}).get("mode", 0) & 0o40000)
+
+            try:
+                # drop the old remote object on delete AND on rename
+                if in_mount(old) and (
+                        new is None
+                        or old["full_path"] != new["full_path"]):
+                    if entry_is_dir(old):
+                        client.delete_prefix(loc_of(old["full_path"]))
+                    else:
+                        client.delete_file(loc_of(old["full_path"]))
+                    moved += 1
+                if in_mount(new) and not entry_is_dir(new) \
+                        and not new.get("remote_entry"):
+                    # a genuinely local change (mount syncs carry
+                    # remote_entry and must not echo back)
+                    path = new["full_path"]
+                    data = source.read_entry_bytes(path)
+                    client.write_file(loc_of(path), data)
+                    moved += 1
+            except RpcError as e:
+                print(f"push {(new or old)['full_path']}: {e} "
+                      "(will retry)")
+                break
+            cursor = max(cursor, event["ts_ns"])
+        if cursor != offsets.get("sync", 0):
+            offsets["sync"] = cursor
+            _save_offsets(state, offsets)
+        if args.once and moved == 0:
+            break
+        if not moved:
+            _time.sleep(args.interval)
+
+
 def cmd_scaffold(args):
     from seaweedfs_tpu.util.config import scaffold
 
@@ -697,6 +797,24 @@ def main(argv=None):
     p.add_argument("-interval", type=float, default=2.0)
     p.add_argument("-once", action="store_true")
     p.set_defaults(fn=cmd_filer_meta_backup)
+
+    p = sub.add_parser("filer.remote.sync",
+                       help="push local changes under a mount to remote")
+    p.add_argument("-filer", default="127.0.0.1:8888")
+    p.add_argument("-dir", required=True, help="mounted directory")
+    p.add_argument("-state", default="")
+    p.add_argument("-interval", type=float, default=2.0)
+    p.add_argument("-once", action="store_true")
+    p.set_defaults(fn=cmd_filer_remote_sync)
+
+    p = sub.add_parser("filer.remote.gateway",
+                       help="push bucket changes under /buckets to remote")
+    p.add_argument("-filer", default="127.0.0.1:8888")
+    p.add_argument("-dir", default="/buckets")
+    p.add_argument("-state", default="")
+    p.add_argument("-interval", type=float, default=2.0)
+    p.add_argument("-once", action="store_true")
+    p.set_defaults(fn=cmd_filer_remote_sync)
 
     p = sub.add_parser("filer.meta.tail",
                        help="print filer metadata change events")
