@@ -56,6 +56,9 @@ class Filer:
                                      meta_log_flush_interval,
                                      max_entries=LOG_BUFFER_CAPACITY)
         self._last_event_ns = 0
+        # optional external sink for every change event
+        # (weed/notification; wired from notification.toml)
+        self.notification_queue = None
         # per-thread signature list stamped onto emitted events; a sync
         # client sets its own cluster signature so active-active
         # replication can skip events it produced itself
@@ -84,6 +87,15 @@ class Filer:
         if sigs:
             record["signatures"] = list(sigs)
         self._log_buffer.add(ts, record)
+        if self.notification_queue is not None:
+            key = ((new_entry or old_entry).full_path
+                   if (new_entry or old_entry) else directory)
+            try:
+                self.notification_queue.send(key, record)
+            except Exception as e:  # a broken sink must not fail writes
+                from ..util import glog
+
+                glog.errorf("notification send %s: %s", key, e)
 
     def enable_meta_log(self, background: bool = True):
         """Turn on persistence of the change log into date-partitioned

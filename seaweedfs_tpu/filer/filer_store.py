@@ -187,3 +187,177 @@ class SqliteStore(FilerStore):
         if conn is not None:
             conn.close()
             self._local.conn = None
+
+
+class ShardedSqliteStore(FilerStore):
+    """Directory-hashed shards, one sqlite file each.
+
+    The analogue of the reference's leveldb2 store (filer/leveldb2: 256
+    hashed sub-DBs) — spreading directories over independent databases
+    keeps per-file lock contention and compaction local to a shard."""
+
+    def __init__(self, directory: str, shard_count: int = 8):
+        import os
+
+        os.makedirs(directory, exist_ok=True)
+        self.directory = directory
+        self.shard_count = shard_count
+        self._shards = [
+            SqliteStore(os.path.join(directory, f"meta_{i:02x}.db"))
+            for i in range(shard_count)]
+
+    def _shard(self, dir_path: str) -> SqliteStore:
+        import hashlib as _hashlib
+
+        digest = _hashlib.md5(dir_path.encode()).digest()
+        return self._shards[digest[0] % self.shard_count]
+
+    def insert_entry(self, entry: Entry):
+        self._shard(entry.parent).insert_entry(entry)
+
+    update_entry = insert_entry
+
+    def find_entry(self, path: str) -> Entry:
+        if path == "/":
+            from .entry import new_directory_entry
+
+            return new_directory_entry("/")
+        parent = path.rsplit("/", 1)[0] or "/"
+        return self._shard(parent).find_entry(path)
+
+    def delete_entry(self, path: str):
+        parent = path.rsplit("/", 1)[0] or "/"
+        self._shard(parent).delete_entry(path)
+
+    def delete_folder_children(self, path: str):
+        # children may hash to any shard (each child dir hashes by its
+        # own parent path): fan the prefix delete out to all shards
+        for shard in self._shards:
+            shard.delete_folder_children(path)
+
+    def list_directory(self, dir_path: str, start_file: str = "",
+                       include_start: bool = False, limit: int = 1024,
+                       prefix: str = "") -> list[Entry]:
+        return self._shard(dir_path).list_directory(
+            dir_path, start_file=start_file,
+            include_start=include_start, limit=limit, prefix=prefix)
+
+    def close(self):
+        for shard in self._shards:
+            shard.close()
+
+
+class PerBucketStoreRouter(FilerStore):
+    """Route /buckets/<name>/ subtrees to dedicated stores.
+
+    The analogue of the reference's leveldb3 (per-bucket DBs,
+    filer/leveldb3): dropping a bucket is dropping its store, and one
+    bucket's scan load cannot slow another's."""
+
+    def __init__(self, directory: str, buckets_root: str = "/buckets"):
+        import os
+
+        os.makedirs(directory, exist_ok=True)
+        self.directory = directory
+        self.buckets_root = buckets_root.rstrip("/")
+        self.default = SqliteStore(os.path.join(directory, "default.db"))
+        self._buckets: dict[str, SqliteStore] = {}
+        self._lock = threading.Lock()
+        for name in sorted(os.listdir(directory)):
+            if name.startswith("bucket_") and name.endswith(".db"):
+                bucket = name[len("bucket_"):-3]
+                self._buckets[bucket] = SqliteStore(
+                    os.path.join(directory, name))
+
+    def _bucket_of(self, path: str) -> Optional[str]:
+        if not path.startswith(self.buckets_root + "/"):
+            return None
+        rest = path[len(self.buckets_root) + 1:]
+        return rest.split("/", 1)[0] if rest else None
+
+    def _store_for(self, path: str) -> SqliteStore:
+        import os
+
+        bucket = self._bucket_of(path)
+        if not bucket:
+            return self.default
+        with self._lock:
+            store = self._buckets.get(bucket)
+            if store is None:
+                store = SqliteStore(os.path.join(
+                    self.directory, f"bucket_{bucket}.db"))
+                self._buckets[bucket] = store
+            return store
+
+    def insert_entry(self, entry: Entry):
+        self._store_for(entry.full_path).insert_entry(entry)
+
+    update_entry = insert_entry
+
+    def find_entry(self, path: str) -> Entry:
+        return self._store_for(path).find_entry(path)
+
+    def delete_entry(self, path: str):
+        self._store_for(path).delete_entry(path)
+        # deleting a bucket root drops its whole store file
+        bucket = self._bucket_of(path)
+        if bucket and path == f"{self.buckets_root}/{bucket}":
+            self._drop_bucket(bucket)
+
+    def _drop_bucket(self, bucket: str):
+        import os
+
+        with self._lock:
+            store = self._buckets.pop(bucket, None)
+        if store is not None:
+            store.close()
+            try:
+                os.remove(os.path.join(self.directory,
+                                       f"bucket_{bucket}.db"))
+            except FileNotFoundError:
+                pass
+
+    def delete_folder_children(self, path: str):
+        bucket = self._bucket_of(path)
+        if bucket and path.rstrip("/") == f"{self.buckets_root}/{bucket}":
+            # whole-bucket delete: clear the dedicated store
+            self._store_for(path + "/x").delete_folder_children(path)
+            return
+        self._store_for(path).delete_folder_children(path)
+        if path.rstrip("/") in ("", "/", self.buckets_root):
+            for b in list(self._buckets):
+                self._drop_bucket(b)
+
+    def list_directory(self, dir_path: str, start_file: str = "",
+                       include_start: bool = False, limit: int = 1024,
+                       prefix: str = "") -> list[Entry]:
+        if dir_path.rstrip("/") == self.buckets_root:
+            # bucket roots live in their own stores; merge their REAL
+            # stored entries with default-store entries (a fabricated
+            # listing would lose attributes and misreport plain files)
+            out = [e for e in self.default.list_directory(
+                dir_path, start_file=start_file,
+                include_start=include_start, limit=limit, prefix=prefix)]
+            have = {e.name for e in out}
+            for b in sorted(self._buckets):
+                if b in have or (prefix and not b.startswith(prefix)):
+                    continue
+                if start_file and (b < start_file or
+                                   (b == start_file
+                                    and not include_start)):
+                    continue
+                try:
+                    out.append(self._buckets[b].find_entry(
+                        f"{self.buckets_root}/{b}"))
+                except NotFoundError:
+                    continue  # store file exists but root entry gone
+            out.sort(key=lambda e: e.name)
+            return out[:limit]
+        return self._store_for(dir_path + "/x").list_directory(
+            dir_path, start_file=start_file,
+            include_start=include_start, limit=limit, prefix=prefix)
+
+    def close(self):
+        self.default.close()
+        for store in self._buckets.values():
+            store.close()

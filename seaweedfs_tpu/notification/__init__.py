@@ -1,0 +1,94 @@
+"""External metadata-event notification (weed/notification).
+
+The reference publishes every filer EventNotification to an optional
+message queue configured in notification.toml (kafka or log
+sinks; notification/configuration.go:9-40).  Same surface here: a
+NotificationQueue receives (key, event-dict) pairs from the filer's
+change log; implementations are a glog sink, a JSON-lines file sink, and
+a kafka sink gated on the client library being installed (it is not
+baked into this image).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Optional
+
+from ..util import glog
+
+
+class NotificationQueue:
+    name = "none"
+
+    def send(self, key: str, event: dict):
+        raise NotImplementedError
+
+    def close(self):
+        pass
+
+
+class LogQueue(NotificationQueue):
+    """notification.log sink: events to the process log."""
+
+    name = "log"
+
+    def send(self, key: str, event: dict):
+        glog.v(1).infof("notify %s: %s", key, json.dumps(event))
+
+
+class FileQueue(NotificationQueue):
+    """JSON-lines events appended to a file (useful stand-in for an
+    external queue in air-gapped deployments)."""
+
+    name = "file"
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+
+    def send(self, key: str, event: dict):
+        line = json.dumps({"key": key, **event})
+        with self._lock, open(self.path, "a") as f:
+            f.write(line + "\n")
+
+
+class KafkaQueue(NotificationQueue):
+    """notification.kafka sink; requires a kafka client library."""
+
+    name = "kafka"
+
+    def __init__(self, hosts: list[str], topic: str):
+        try:
+            from kafka import KafkaProducer  # type: ignore
+        except ImportError as e:
+            raise RuntimeError(
+                "kafka notification sink needs the kafka-python package, "
+                "which is not installed in this environment") from e
+        self.topic = topic
+        self.producer = KafkaProducer(bootstrap_servers=hosts)
+
+    def send(self, key: str, event: dict):
+        self.producer.send(self.topic, key=key.encode(),
+                           value=json.dumps(event).encode())
+
+    def close(self):
+        self.producer.close()
+
+
+def load_notification_queue(conf) -> Optional[NotificationQueue]:
+    """Build the configured sink from notification.toml
+    (configuration.go LoadConfiguration)."""
+    if conf is None:
+        return None
+    if conf.get_bool("notification.log.enabled"):
+        return LogQueue()
+    if conf.get_bool("notification.file.enabled"):
+        return FileQueue(str(conf.get("notification.file.path",
+                                      "filer_events.jsonl")))
+    if conf.get_bool("notification.kafka.enabled"):
+        hosts = str(conf.get("notification.kafka.hosts",
+                             "localhost:9092")).split(",")
+        topic = str(conf.get("notification.kafka.topic", "seaweedfs"))
+        return KafkaQueue(hosts, topic)
+    return None

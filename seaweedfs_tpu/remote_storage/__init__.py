@@ -101,6 +101,27 @@ class RemoteStorageClient:
         for obj in list(self.traverse(loc)):
             self.delete_file(loc.child(obj.key))
 
+    def read_range(self, loc: RemoteLocation, offset: int,
+                   size: int) -> bytes:
+        """Ranged read; default slices a whole-object fetch."""
+        return self.read_file(loc)[offset:offset + size]
+
+    def write_file_from(self, loc: RemoteLocation, read_chunk,
+                        total_size: int) -> "RemoteObject":
+        """Streaming write from a chunk reader.  The default accumulates
+        (single-PUT stores); file-backed providers override to stream."""
+        parts = []
+        while True:
+            chunk = read_chunk()
+            if not chunk:
+                break
+            parts.append(chunk)
+        return self.write_file(loc, b"".join(parts))
+
+    def stat(self, loc: RemoteLocation) -> Optional[RemoteObject]:
+        """Metadata of one object, or None when absent."""
+        raise NotImplementedError
+
 
 class LocalRemoteStorage(RemoteStorageClient):
     """A directory tree as a 'remote' (tests, NFS mounts, air-gap)."""
@@ -143,6 +164,38 @@ class LocalRemoteStorage(RemoteStorageClient):
         except FileNotFoundError:
             pass
 
+    def read_range(self, loc: RemoteLocation, offset: int,
+                   size: int) -> bytes:
+        with open(self._abs(loc), "rb") as f:
+            f.seek(offset)
+            return f.read(size)
+
+    def write_file_from(self, loc: RemoteLocation, read_chunk,
+                        total_size: int) -> RemoteObject:
+        path = self._abs(loc)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".part"
+        with open(tmp, "wb") as f:
+            while True:
+                chunk = read_chunk()
+                if not chunk:
+                    break
+                f.write(chunk)
+        os.replace(tmp, path)
+        st = os.stat(path)
+        return RemoteObject(key=loc.path.lstrip("/"), size=st.st_size,
+                            mtime=st.st_mtime,
+                            etag=f"{st.st_mtime_ns:x}-{st.st_size:x}")
+
+    def stat(self, loc: RemoteLocation) -> Optional[RemoteObject]:
+        try:
+            st = os.stat(self._abs(loc))
+        except FileNotFoundError:
+            return None
+        return RemoteObject(key=loc.path.lstrip("/"), size=st.st_size,
+                            mtime=st.st_mtime,
+                            etag=f"{st.st_mtime_ns:x}-{st.st_size:x}")
+
 
 class S3RemoteStorage(RemoteStorageClient):
     """Any S3-compatible endpoint via the SigV4 client
@@ -181,6 +234,32 @@ class S3RemoteStorage(RemoteStorageClient):
 
     def delete_file(self, loc: RemoteLocation):
         self.client.delete_object(loc.bucket, loc.path.lstrip("/"))
+
+    def read_range(self, loc: RemoteLocation, offset: int,
+                   size: int) -> bytes:
+        return self.client.get_object_range(
+            loc.bucket, loc.path.lstrip("/"), offset, size)
+
+    def stat(self, loc: RemoteLocation) -> Optional[RemoteObject]:
+        import calendar
+
+        key = loc.path.lstrip("/")
+        # exact-key prefix listing: the full key is the prefix, so the
+        # page holds the object itself plus at most same-prefix siblings
+        for obj in self.client.list_objects(loc.bucket, key):
+            if obj["key"] == key:
+                mtime = 0.0
+                if obj.get("last_modified"):
+                    try:
+                        mtime = calendar.timegm(time.strptime(
+                            obj["last_modified"],
+                            "%Y-%m-%dT%H:%M:%S.000Z"))
+                    except ValueError:
+                        pass
+                return RemoteObject(key=key, size=obj["size"],
+                                    mtime=mtime,
+                                    etag=obj.get("etag", ""))
+        return None
 
 
 def make_remote_client(conf: RemoteConf) -> RemoteStorageClient:

@@ -431,6 +431,39 @@ def volume_configure_replication(env: CommandEnv, vid: int,
     return out
 
 
+# -- volume.tier.* (command_volume_tier_{upload,download,move}.go) -----------
+
+def volume_tier_upload(env: CommandEnv, vid: int, server: str,
+                       backend: str, bucket: str = "volumes",
+                       keep_local: bool = False) -> dict:
+    return call(server, "/admin/volume/tier_upload",
+                {"volume": vid, "backend": backend, "bucket": bucket,
+                 "keep_local": keep_local}, timeout=3600)
+
+
+def volume_tier_download(env: CommandEnv, vid: int, server: str) -> dict:
+    return call(server, "/admin/volume/tier_download", {"volume": vid},
+                timeout=3600)
+
+
+def volume_tier_move(env: CommandEnv, vid: int, backend: str,
+                     bucket: str = "volumes",
+                     plan_only: bool = False) -> list[dict]:
+    """Tier every replica of the volume (the reference's tier.move picks
+    volumes by age/size; explicit vid here, selection in the caller)."""
+    nodes = collect_volume_servers(env)
+    holders = _find_volume(nodes, vid)
+    if not holders:
+        raise RpcError(f"volume {vid} not found", 404)
+    plan = [{"volume": vid, "server": n.url, "backend": backend}
+            for n, _ in holders]
+    if not plan_only:
+        for p in plan:
+            p.update(volume_tier_upload(env, vid, p["server"], backend,
+                                        bucket=bucket))
+    return plan
+
+
 # -- collection.* (command_collection_{list,delete}.go) ----------------------
 
 def collection_list(env: CommandEnv) -> list[str]:

@@ -56,3 +56,137 @@ class DiskFile:
 
     def __exit__(self, *exc):
         self.close()
+
+
+class MmapFile:
+    """mmap-backed reads + positional writes (backend/memory_map/):
+    reads hit the page cache mapping directly; the map is regrown lazily
+    when appends extend the file."""
+
+    def __init__(self, path: str, create: bool = False):
+        import mmap as _mmap
+
+        self._mmap_mod = _mmap
+        self.path = path
+        flags = os.O_RDWR
+        if create:
+            flags |= os.O_CREAT
+        self._fd = os.open(path, flags, 0o644)
+        self._map = None
+        self._remap()
+
+    def _remap(self):
+        size = os.fstat(self._fd).st_size
+        if self._map is not None:
+            self._map.close()
+            self._map = None
+        if size > 0:
+            self._map = self._mmap_mod.mmap(self._fd, size,
+                                            access=self._mmap_mod.ACCESS_READ)
+
+    def read_at(self, size: int, offset: int) -> bytes:
+        end = offset + size
+        if self._map is None or end > len(self._map):
+            self._remap()
+        if self._map is None:
+            return b""
+        return bytes(self._map[offset:min(end, len(self._map))])
+
+    def write_at(self, data: bytes, offset: int) -> int:
+        n = os.pwrite(self._fd, data, offset)
+        if self._map is not None and offset + n <= len(self._map):
+            self._remap()  # overwrite within the mapped range: refresh
+        return n
+
+    def append(self, data: bytes) -> int:
+        end = os.fstat(self._fd).st_size
+        os.pwrite(self._fd, data, end)
+        return end
+
+    def truncate(self, size: int):
+        os.ftruncate(self._fd, size)
+        self._remap()
+
+    def size(self) -> int:
+        return os.fstat(self._fd).st_size
+
+    def sync(self):
+        os.fsync(self._fd)
+
+    def close(self):
+        if self._map is not None:
+            self._map.close()
+            self._map = None
+        if self._fd is not None:
+            os.close(self._fd)
+            self._fd = None
+
+    @property
+    def name(self) -> str:
+        return self.path
+
+
+class TieredFile:
+    """Read-only BackendStorageFile over a remote tier
+    (backend/s3_backend/s3_backend.go S3BackendStorageFile): ranged
+    reads against the remote object, LRU block cache in front."""
+
+    BLOCK = 1 << 20
+
+    def __init__(self, fetch_range, total_size: int, name: str = "",
+                 cache_blocks: int = 32):
+        from collections import OrderedDict
+
+        self._fetch = fetch_range  # (offset, size) -> bytes
+        self._size = total_size
+        self._name = name
+        self._cache: "OrderedDict[int, bytes]" = OrderedDict()
+        self._cache_blocks = cache_blocks
+
+    def _block(self, index: int) -> bytes:
+        if index in self._cache:
+            self._cache.move_to_end(index)
+            return self._cache[index]
+        offset = index * self.BLOCK
+        data = self._fetch(offset, min(self.BLOCK, self._size - offset))
+        self._cache[index] = data
+        if len(self._cache) > self._cache_blocks:
+            self._cache.popitem(last=False)
+        return data
+
+    def read_at(self, size: int, offset: int) -> bytes:
+        if offset >= self._size:
+            return b""
+        size = min(size, self._size - offset)
+        parts = []
+        while size > 0:
+            index, inner = divmod(offset, self.BLOCK)
+            chunk = self._block(index)[inner:inner + size]
+            if not chunk:
+                break
+            parts.append(chunk)
+            offset += len(chunk)
+            size -= len(chunk)
+        return b"".join(parts)
+
+    def write_at(self, data: bytes, offset: int) -> int:
+        raise OSError("tiered volume file is read-only")
+
+    def append(self, data: bytes) -> int:
+        raise OSError("tiered volume file is read-only")
+
+    def truncate(self, size: int):
+        raise OSError("tiered volume file is read-only")
+
+    def size(self) -> int:
+        return self._size
+
+    def sync(self):
+        pass
+
+    def close(self):
+        self._cache.clear()
+
+    @property
+    def name(self) -> str:
+        return self._name

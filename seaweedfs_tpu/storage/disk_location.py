@@ -19,6 +19,7 @@ from .erasure_coding.ec_volume import EcVolume, EcVolumeShard
 from .volume import Volume
 
 _DAT_RE = re.compile(r"^(?:(?P<collection>.+)_)?(?P<vid>\d+)\.dat$")
+_VIF_RE = re.compile(r"^(?:(?P<collection>.+)_)?(?P<vid>\d+)\.vif$")
 _SHARD_RE = re.compile(r"^(?:(?P<collection>.+)_)?(?P<vid>\d+)\.ec(?P<shard>\d{2})$")
 
 
@@ -49,10 +50,24 @@ class DiskLocation:
     def load_existing_volumes(self):
         with self.lock:
             for name in sorted(os.listdir(self.directory)):
-                m = _DAT_RE.match(name)
+                m = _DAT_RE.match(name) or _VIF_RE.match(name)
                 if m:
                     vid = int(m.group("vid"))
                     collection = m.group("collection") or ""
+                    if name.endswith(".vif") and not os.path.exists(
+                            os.path.join(self.directory, name[:-4]
+                                         + ".dat")):
+                        # .vif without .dat: only a tiered volume (one
+                        # recording remote files) is loadable; EC
+                        # sidecars and stale .vifs are not
+                        from .volume_info import load_volume_info
+
+                        base = self._base_name(collection, vid)
+                        if os.path.exists(base + ".ecx"):
+                            continue
+                        vif = load_volume_info(base + ".vif")
+                        if vif is None or not vif.files:
+                            continue
                     if vid not in self.volumes:
                         try:
                             self.volumes[vid] = Volume(

@@ -86,7 +86,28 @@ class Volume:
               ttl: TTL = EMPTY_TTL):
         dat = self.file_name(".dat")
         exists = os.path.exists(dat)
-        if not exists:
+        tiered = None
+        # a .vif recording remote tier files means the volume was tiered
+        # (volume.tier.upload).  The remote is authoritative and the
+        # volume is readonly — a kept local .dat (keep_local=True) is
+        # only a read cache, never a write target, so the two can't
+        # diverge across restarts.
+        from .volume_info import load_volume_info
+
+        vif = load_volume_info(self.file_name(".vif"))
+        if vif is not None and vif.files:
+            self.read_only = True
+            if not exists:
+                from .tier import open_tiered_dat
+
+                tiered = open_tiered_dat(vif)
+        if tiered is not None:
+            self.data = tiered
+            import io
+
+            self.super_block = SuperBlock.from_file(
+                io.BytesIO(self.data.read_at(1024, 0)))
+        elif not exists:
             if not create_if_missing:
                 raise VolumeError(f"volume data file {dat} does not exist")
             self.data = DiskFile(dat, create=True)
@@ -101,7 +122,7 @@ class Volume:
             with open(dat, "rb") as f:
                 self.super_block = SuperBlock.from_file(f)
         idx_path = self.file_name(".idx")
-        if exists:
+        if exists or tiered is not None:
             self.last_append_at_ns = self._check_integrity(idx_path)
         self.nm = NeedleMap(idx_path)
 

@@ -146,6 +146,16 @@ directory = "/data"
 [notification.log]
 # this is only for debugging purpose and does not work with "weed filer.replicate"
 enabled = false
+
+[notification.file]
+# append every filer change event as a JSON line to a local file
+enabled = false
+path = "filer_events.jsonl"
+
+[notification.kafka]
+enabled = false
+hosts = "kafka1:9092"
+topic = "seaweedfs_filer"
 ''',
 }
 

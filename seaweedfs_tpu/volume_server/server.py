@@ -47,7 +47,14 @@ class VolumeServer:
                  public_url: str = "", data_center: str = "",
                  rack: str = "", max_volume_counts: Optional[list[int]] = None,
                  pulse_seconds: float = 5.0, ec_encoder_backend=None,
-                 guard: Optional[Guard] = None):
+                 guard: Optional[Guard] = None, tier_backends=None):
+        # tier backends must be registered before Store discovery so
+        # .vif-only (tiered) volumes load (storage/tier.py registry)
+        if tier_backends:
+            from ..storage import tier
+
+            for conf in tier_backends:
+                tier.register_tier_backend(conf)
         self.server = RpcServer(host, port)
         # the configured seed list survives leader redirects so a dead
         # leader never strands the heartbeat loop
@@ -154,6 +161,9 @@ class VolumeServer:
         s.add("GET", "/admin/ec/shard_read", self._h_ec_shard_read)
         s.add("POST", "/admin/volume/configure_replication",
               g(self._h_configure_replication))
+        s.add("POST", "/admin/volume/tier_upload", g(self._h_tier_upload))
+        s.add("POST", "/admin/volume/tier_download",
+              g(self._h_tier_download))
         s.add("POST", "/admin/leave", g(self._h_leave))
         s.add("POST", "/query", self._h_query)
         s.add("GET", "/metrics", stats.metrics_handler)
@@ -174,6 +184,35 @@ class VolumeServer:
             v.data.sync()
         self._try_heartbeat()
         return {"volume": v.id, "replication": str(rp)}
+
+    def _h_tier_upload(self, req: Request):
+        """VolumeTierMoveDatToRemote (volume_grpc_tier_upload.go): ship
+        the .dat to a configured tier backend; volume turns readonly."""
+        from ..storage import tier
+
+        p = req.json()
+        v = self._volume_or_404(int(p["volume"]))
+        try:
+            remote = tier.tier_upload(
+                v, p["backend"], p.get("bucket", "volumes"),
+                keep_local=bool(p.get("keep_local")))
+        except ValueError as e:
+            raise RpcError(str(e), 400)
+        self._try_heartbeat()
+        return {"volume": v.id, "key": remote.key,
+                "size": remote.file_size}
+
+    def _h_tier_download(self, req: Request):
+        """VolumeTierMoveDatFromRemote (volume_grpc_tier_download.go)."""
+        from ..storage import tier
+
+        v = self._volume_or_404(int(req.json()["volume"]))
+        try:
+            size = tier.tier_download(v)
+        except ValueError as e:
+            raise RpcError(str(e), 400)
+        self._try_heartbeat()
+        return {"volume": v.id, "size": size}
 
     def _h_leave(self, req: Request):
         """VolumeServerLeave (volume_grpc_admin.go): stop heartbeating and

@@ -108,6 +108,17 @@ class S3Client:
                              parse=False)
         return body if isinstance(body, bytes) else b""
 
+    def get_object_range(self, bucket: str, key: str, offset: int,
+                         size: int) -> bytes:
+        """Ranged GET (unsigned Range header rides alongside SigV4)."""
+        path = f"/{bucket}/{key.lstrip('/')}"
+        headers = self._sign("GET", path, {}, b"")
+        headers["Range"] = f"bytes={offset}-{offset + size - 1}"
+        body = call(self.endpoint,
+                    urllib.parse.quote(path, safe="/~"), method="GET",
+                    headers=headers, timeout=120, parse=False)
+        return body if isinstance(body, bytes) else b""
+
     def delete_object(self, bucket: str, key: str):
         try:
             self._request("DELETE", f"/{bucket}/{key.lstrip('/')}")

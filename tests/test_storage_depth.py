@@ -1,0 +1,331 @@
+"""Storage depth: mmap backend, volume tiering, notification sinks,
+sharded/per-bucket filer stores (weed/storage/backend/memory_map,
+backend/s3_backend, volume_grpc_tier_*.go, weed/notification,
+filer/leveldb2, filer/leveldb3)."""
+
+import json
+import os
+
+import pytest
+
+from seaweedfs_tpu.filer.entry import Attr, Entry
+from seaweedfs_tpu.filer.filer import Filer
+from seaweedfs_tpu.filer.filer_store import (NotFoundError,
+                                             PerBucketStoreRouter,
+                                             ShardedSqliteStore)
+from seaweedfs_tpu.master.server import MasterServer
+from seaweedfs_tpu.notification import FileQueue, LogQueue
+from seaweedfs_tpu.remote_storage import RemoteConf
+from seaweedfs_tpu.rpc.http_rpc import RpcError, call
+from seaweedfs_tpu.storage import tier
+from seaweedfs_tpu.storage.backend import DiskFile, MmapFile, TieredFile
+from seaweedfs_tpu.volume_server.server import VolumeServer
+
+
+class TestMmapFile:
+    def test_read_write_grow(self, tmp_path):
+        path = str(tmp_path / "m.dat")
+        f = MmapFile(path, create=True)
+        assert f.read_at(10, 0) == b""
+        off = f.append(b"hello")
+        assert off == 0
+        assert f.read_at(5, 0) == b"hello"
+        f.append(b" world")
+        assert f.read_at(11, 0) == b"hello world"
+        f.write_at(b"J", 0)
+        assert f.read_at(5, 0) == b"Jello"
+        f.truncate(5)
+        assert f.size() == 5
+        assert f.read_at(100, 0) == b"Jello"
+        f.close()
+        # DiskFile sees the same bytes
+        d = DiskFile(path)
+        assert d.read_at(5, 0) == b"Jello"
+        d.close()
+
+
+class TestTieredFile:
+    def test_block_cache_and_ranges(self):
+        data = bytes(range(256)) * 1024  # 256 KiB
+        calls = []
+
+        def fetch(off, size):
+            calls.append((off, size))
+            return data[off:off + size]
+
+        tf = TieredFile(fetch, len(data), cache_blocks=2)
+        assert tf.read_at(10, 0) == data[:10]
+        assert tf.read_at(10, 5) == data[5:15]
+        assert len(calls) == 1  # block cached
+        assert tf.read_at(len(data), 0) == data
+        assert tf.read_at(100, len(data) - 50) == data[-50:]
+        with pytest.raises(OSError):
+            tf.write_at(b"x", 0)
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    master = MasterServer(port=0, pulse_seconds=0.2)
+    master.start()
+    d = tmp_path / "v"
+    d.mkdir()
+    tier_root = tmp_path / "tier-root"
+    tier_root.mkdir()
+    conf = RemoteConf(name=f"tb-{os.path.basename(tmp_path)}",
+                      type="local", directory=str(tier_root))
+    vs = VolumeServer([str(d)], master.address, port=0, pulse_seconds=0.2,
+                      tier_backends=[conf])
+    vs.start()
+    vs.heartbeat_once()
+    yield master, vs, conf, str(d), str(tier_root)
+    vs.stop()
+    master.stop()
+
+
+class TestVolumeTiering:
+    def write_some(self, master, n=5):
+        fids = []
+        for i in range(n):
+            a = call(master.address, "/dir/assign")
+            body = os.urandom(500 + i)
+            call(a["url"], f"/{a['fid']}", raw=body, method="POST")
+            fids.append((a["fid"], a["url"], body))
+        return fids
+
+    def test_upload_read_download_cycle(self, cluster):
+        master, vs, conf, vol_dir, tier_root = cluster
+        fids = self.write_some(master)
+        vid = int(fids[0][0].split(",")[0])
+        out = call(vs.address, "/admin/volume/tier_upload",
+                   {"volume": vid, "backend": conf.name,
+                    "bucket": "vols"})
+        assert out["size"] > 0
+        # local .dat gone, remote object exists
+        v = vs.store.find_volume(vid)
+        assert not os.path.exists(v.file_name(".dat"))
+        assert os.path.exists(
+            os.path.join(tier_root, "vols",
+                         os.path.basename(v.file_name(".dat"))))
+        assert v.read_only
+        # every needle reads back through ranged remote fetches
+        for fid, url, body in fids:
+            if int(fid.split(",")[0]) == vid:
+                assert call(url, f"/{fid}") == body
+        # writes rejected
+        a = {"fid": f"{vid},ffffffffffffffffdeadbeef"}
+        with pytest.raises(RpcError):
+            call(vs.address, f"/{a['fid']}", raw=b"nope", method="POST")
+        # download restores local serving
+        call(vs.address, "/admin/volume/tier_download", {"volume": vid})
+        v = vs.store.find_volume(vid)
+        assert os.path.exists(v.file_name(".dat"))
+        assert not v.read_only
+        for fid, url, body in fids:
+            if int(fid.split(",")[0]) == vid:
+                assert call(url, f"/{fid}") == body
+
+    def test_tiered_volume_survives_restart(self, cluster, tmp_path):
+        master, vs, conf, vol_dir, tier_root = cluster
+        fids = self.write_some(master, 3)
+        vid = int(fids[0][0].split(",")[0])
+        call(vs.address, "/admin/volume/tier_upload",
+             {"volume": vid, "backend": conf.name, "bucket": "vols"})
+        vs.stop()
+        # a fresh server over the same dir discovers the tiered volume
+        vs2 = VolumeServer([vol_dir], master.address, port=0,
+                           pulse_seconds=0.2, tier_backends=[conf])
+        vs2.start()
+        vs2.heartbeat_once()
+        try:
+            v = vs2.store.find_volume(vid)
+            assert v is not None and v.read_only
+            for fid, url, body in fids:
+                if int(fid.split(",")[0]) == vid:
+                    assert call(vs2.address, f"/{fid}") == body
+        finally:
+            vs2.stop()
+
+    def test_shell_tier_move(self, cluster):
+        from seaweedfs_tpu.shell import commands as sh
+        from seaweedfs_tpu.shell import commands_volume as vol
+
+        master, vs, conf, vol_dir, tier_root = cluster
+        fids = self.write_some(master, 2)
+        vs.heartbeat_once()
+        vid = int(fids[0][0].split(",")[0])
+        env = sh.CommandEnv(master.address)
+        plan = vol.volume_tier_move(env, vid, conf.name, bucket="vols",
+                                    plan_only=True)
+        assert plan[0]["server"] == vs.store.url
+        done = vol.volume_tier_move(env, vid, conf.name, bucket="vols")
+        assert done[0]["size"] > 0
+        vol.volume_tier_download(env, vid, vs.store.url)
+        assert not vs.store.find_volume(vid).read_only
+
+
+class TestNotificationSinks:
+    def test_file_queue_receives_events(self, tmp_path):
+        filer = Filer()
+        sink_path = str(tmp_path / "events.jsonl")
+        filer.notification_queue = FileQueue(sink_path)
+        entry = Entry(full_path="/x.txt", attr=Attr(mtime=1, crtime=1),
+                      content=b"hi")
+        filer.create_entry(entry)
+        filer.delete_entry("/x.txt")
+        lines = [json.loads(l) for l in open(sink_path)]
+        assert lines[0]["key"] == "/x.txt"
+        assert lines[0]["new_entry"]["full_path"] == "/x.txt"
+        assert lines[-1]["old_entry"] is not None
+        assert lines[-1]["new_entry"] is None
+
+    def test_broken_sink_does_not_break_writes(self):
+        class Boom(LogQueue):
+            def send(self, key, event):
+                raise RuntimeError("sink down")
+
+        filer = Filer()
+        filer.notification_queue = Boom()
+        filer.create_entry(Entry(full_path="/ok.txt",
+                                 attr=Attr(mtime=1, crtime=1)))
+        assert filer.find_entry("/ok.txt")
+
+    def test_load_from_config(self, tmp_path, monkeypatch):
+        from seaweedfs_tpu.notification import load_notification_queue
+        from seaweedfs_tpu.util.config import Configuration
+
+        q = load_notification_queue(Configuration(
+            {"notification": {"file": {"enabled": True,
+                                       "path": str(tmp_path / "q.jsonl")}}}))
+        assert q.name == "file"
+        assert load_notification_queue(
+            Configuration({"notification": {}})) is None
+
+
+def exercise_store(store):
+    """Shared conformance sweep (filer/store_test analogue)."""
+    filer = Filer(store=store)
+    filer.create_entry(Entry(full_path="/a/b/one.txt",
+                             attr=Attr(mtime=1, crtime=1), content=b"1"))
+    filer.create_entry(Entry(full_path="/a/b/two.txt",
+                             attr=Attr(mtime=1, crtime=1), content=b"22"))
+    assert filer.find_entry("/a/b/one.txt").content == b"1"
+    names = [e.name for e in filer.list_directory("/a/b")]
+    assert names == ["one.txt", "two.txt"]
+    filer.rename("/a/b/one.txt", "/a/b/uno.txt")
+    assert filer.find_entry("/a/b/uno.txt").content == b"1"
+    filer.delete_entry("/a", recursive=True)
+    with pytest.raises(NotFoundError):
+        filer.find_entry("/a/b/two.txt")
+
+
+class TestExtraFilerStores:
+    def test_sharded_sqlite_conformance(self, tmp_path):
+        exercise_store(ShardedSqliteStore(str(tmp_path / "sharded"),
+                                          shard_count=4))
+
+    def test_sharded_persists(self, tmp_path):
+        path = str(tmp_path / "sharded")
+        store = ShardedSqliteStore(path, shard_count=4)
+        filer = Filer(store=store)
+        filer.create_entry(Entry(full_path="/p/x.txt",
+                                 attr=Attr(mtime=1, crtime=1),
+                                 content=b"x"))
+        store.close()
+        store2 = ShardedSqliteStore(path, shard_count=4)
+        assert Filer(store=store2).find_entry("/p/x.txt").content == b"x"
+        store2.close()
+
+    def test_perbucket_conformance_and_drop(self, tmp_path):
+        path = str(tmp_path / "pb")
+        exercise_store(PerBucketStoreRouter(str(tmp_path / "pb2")))
+        store = PerBucketStoreRouter(path)
+        filer = Filer(store=store)
+        filer.create_entry(Entry(full_path="/buckets/media/a.jpg",
+                                 attr=Attr(mtime=1, crtime=1),
+                                 content=b"img"))
+        filer.create_entry(Entry(full_path="/buckets/logs/l.txt",
+                                 attr=Attr(mtime=1, crtime=1),
+                                 content=b"log"))
+        assert os.path.exists(os.path.join(path, "bucket_media.db"))
+        listed = [e.name for e in filer.list_directory("/buckets")]
+        assert set(listed) >= {"media", "logs"}
+        # dropping the bucket removes its store file wholesale
+        filer.delete_entry("/buckets/media", recursive=True)
+        assert not os.path.exists(os.path.join(path, "bucket_media.db"))
+        assert Filer(store=store).find_entry(
+            "/buckets/logs/l.txt").content == b"log"
+        store.close()
+
+
+class TestTierReviewFixes:
+    def test_keep_local_restart_stays_sealed(self, cluster):
+        master, vs, conf, vol_dir, tier_root = cluster
+        fids = TestVolumeTiering().write_some(master, 2)
+        vid = int(fids[0][0].split(",")[0])
+        call(vs.address, "/admin/volume/tier_upload",
+             {"volume": vid, "backend": conf.name, "bucket": "vols",
+              "keep_local": True})
+        v = vs.store.find_volume(vid)
+        assert v.read_only and os.path.exists(v.file_name(".dat"))
+        # double-upload is rejected instead of round-tripping the bytes
+        with pytest.raises(RpcError) as e:
+            call(vs.address, "/admin/volume/tier_upload",
+                 {"volume": vid, "backend": conf.name, "bucket": "vols"})
+        assert "already tiered" in str(e.value)
+        vs.stop()
+        vs2 = VolumeServer([vol_dir], master.address, port=0,
+                           pulse_seconds=0.2, tier_backends=[conf])
+        vs2.start()
+        try:
+            v2 = vs2.store.find_volume(vid)
+            # restart keeps the seal: local .dat is a cache, not a
+            # write target (otherwise tier_download would lose writes)
+            assert v2.read_only
+            for fid, url, body in fids:
+                assert call(vs2.address, f"/{fid}") == body
+            # download with a current local cache skips the fetch and
+            # re-opens for writes
+            call(vs2.address, "/admin/volume/tier_download",
+                 {"volume": vid})
+            assert not vs2.store.find_volume(vid).read_only
+            remote_dat = os.path.join(
+                tier_root, "vols", os.path.basename(
+                    v2.file_name(".dat")))
+            assert not os.path.exists(remote_dat)
+        finally:
+            vs2.stop()
+
+    def test_reads_flow_during_upload(self, cluster):
+        """The volume lock is not held across the transfer."""
+        import threading
+        import time as _time
+
+        master, vs, conf, vol_dir, tier_root = cluster
+        fids = TestVolumeTiering().write_some(master, 2)
+        vid = int(fids[0][0].split(",")[0])
+        v = vs.store.find_volume(vid)
+
+        from seaweedfs_tpu.remote_storage import LocalRemoteStorage
+
+        gate = threading.Event()
+        reads_done = threading.Event()
+        orig = LocalRemoteStorage.write_file_from
+
+        def slow_write(self, loc, read_chunk, total_size):
+            gate.set()  # upload started
+            assert reads_done.wait(10), "reads blocked during upload"
+            return orig(self, loc, read_chunk, total_size)
+
+        LocalRemoteStorage.write_file_from = slow_write
+        try:
+            t = threading.Thread(target=call, args=(
+                vs.address, "/admin/volume/tier_upload",
+                {"volume": vid, "backend": conf.name, "bucket": "v"}))
+            t.start()
+            assert gate.wait(10)
+            fid, url, body = fids[0]
+            assert call(url, f"/{fid}") == body  # read mid-upload
+            reads_done.set()
+            t.join(timeout=30)
+        finally:
+            LocalRemoteStorage.write_file_from = orig

@@ -78,6 +78,28 @@ def cmd_master_follower(args):
     _wait_forever([f])
 
 
+def _parse_tier_backends(specs):
+    """-tier name=local:/dir or name=s3:endpoint[,accessKey,secretKey]"""
+    from seaweedfs_tpu.remote_storage import RemoteConf
+
+    confs = []
+    for spec in specs or []:
+        name, _, rest = spec.partition("=")
+        kind, _, params = rest.partition(":")
+        if kind == "local":
+            confs.append(RemoteConf(name=name, type="local",
+                                    directory=params))
+        elif kind == "s3":
+            parts = params.split(",")
+            confs.append(RemoteConf(
+                name=name, type="s3", endpoint=parts[0],
+                access_key=parts[1] if len(parts) > 1 else "",
+                secret_key=parts[2] if len(parts) > 2 else ""))
+        else:
+            raise ValueError(f"bad tier spec {spec!r}")
+    return confs
+
+
 def cmd_volume(args):
     from seaweedfs_tpu.volume_server.server import VolumeServer
 
@@ -89,26 +111,62 @@ def cmd_volume(args):
                       rack=args.rack, data_center=args.dataCenter,
                       max_volume_counts=maxes,
                       pulse_seconds=args.pulseSeconds,
-                      guard=_load_guard())
+                      guard=_load_guard(),
+                      tier_backends=_parse_tier_backends(args.tier))
     vs.start()
     print(f"volume server listening on {vs.address}, dirs={dirs}")
     _wait_forever([vs])
 
 
+def _make_filer_store(kind: str, path: str):
+    from seaweedfs_tpu.filer.filer_store import (PerBucketStoreRouter,
+                                                 ShardedSqliteStore,
+                                                 SqliteStore)
+
+    if kind not in ("sqlite", "sharded", "perbucket"):
+        raise SystemExit(f"unknown filer store kind {kind!r} "
+                         "(sqlite | sharded | perbucket)")
+    if not path:
+        if kind != "sqlite":
+            raise SystemExit(
+                f"-store {kind} is persistent and needs -db <path>")
+        return None  # in-memory store
+    if kind == "sqlite":
+        return SqliteStore(path)
+    if kind == "sharded":
+        return ShardedSqliteStore(path)
+    return PerBucketStoreRouter(path)
+
+
 def cmd_filer(args):
-    from seaweedfs_tpu.filer.filer_store import SqliteStore
     from seaweedfs_tpu.filer.server import FilerServer
 
-    store = SqliteStore(args.db) if args.db else None
+    store = _make_filer_store(args.store, args.db)
     f = FilerServer(args.master, host=args.ip, port=args.port, store=store,
                     chunk_size=args.maxMB * 1024 * 1024,
                     replication=args.replication,
                     collection=args.collection, guard=_load_guard(),
                     peers=args.peers.split(",") if args.peers else None,
                     persist_meta_log=args.metaLog)
+    _wire_notification(f)
     f.start()
     print(f"filer listening on {f.address}")
     _wait_forever([f])
+
+
+def _wire_notification(filer_server):
+    """Attach the notification.toml sink, if configured."""
+    from seaweedfs_tpu.notification import load_notification_queue
+    from seaweedfs_tpu.util.config import load_configuration
+
+    try:
+        queue = load_notification_queue(load_configuration("notification"))
+    except RuntimeError as e:
+        print(f"notification sink disabled: {e}")
+        return
+    if queue is not None:
+        filer_server.filer.notification_queue = queue
+        print(f"notification sink: {queue.name}")
 
 
 def _load_identities(path):
@@ -162,7 +220,6 @@ def cmd_iam(args):
 def cmd_server(args):
     """Combined master + volume + filer (+ s3) in one process
     (weed/command/server.go)."""
-    from seaweedfs_tpu.filer.filer_store import SqliteStore
     from seaweedfs_tpu.filer.server import FilerServer
     from seaweedfs_tpu.master.server import MasterServer
     from seaweedfs_tpu.s3api.server import S3ApiServer
@@ -187,9 +244,10 @@ def cmd_server(args):
     print(f"volume server on {vs.address}")
 
     if args.filer or args.s3:
-        store = SqliteStore(args.db) if args.db else None
+        store = _make_filer_store(args.store, args.db)
         filer = FilerServer(master.address, host=args.ip,
                             port=args.filerPort, store=store, guard=guard)
+        _wire_notification(filer)
         filer.start()
         stoppables.append(filer)
         print(f"filer on {filer.address}")
@@ -255,6 +313,15 @@ def _shell_handlers(env):
             vol.volume_server_evacuate(env, a[0], plan_only=plan(a))),
         "volume.server.leave": lambda a: show(
             vol.volume_server_leave(env, a[0])),
+        "volume.tier.upload": lambda a: show(vol.volume_tier_upload(
+            env, int(a[0]), a[1], flag(a, "backend", "default"),
+            bucket=flag(a, "bucket", "volumes"),
+            keep_local="-keepLocal" in a)),
+        "volume.tier.download": lambda a: show(vol.volume_tier_download(
+            env, int(a[0]), a[1])),
+        "volume.tier.move": lambda a: show(vol.volume_tier_move(
+            env, int(a[0]), flag(a, "backend", "default"),
+            bucket=flag(a, "bucket", "volumes"), plan_only=plan(a))),
         "volume.query": lambda a: show(sh.volume_query(
             env, [a[0]],
             selections=(flag(a, "select", "") or "").split(",")
@@ -678,6 +745,9 @@ def main(argv=None):
     p.add_argument("-rack", default="")
     p.add_argument("-dataCenter", default="")
     p.add_argument("-pulseSeconds", type=float, default=5.0)
+    p.add_argument("-tier", action="append", default=[],
+                   help="tier backend: name=local:/dir or "
+                        "name=s3:endpoint[,ak,sk] (repeatable)")
     p.set_defaults(fn=cmd_volume)
 
     p = sub.add_parser("filer", help="start a filer server")
@@ -686,6 +756,8 @@ def main(argv=None):
     p.add_argument("-port", type=int, default=8888)
     p.add_argument("-maxMB", type=int, default=4)
     p.add_argument("-db", default="", help="sqlite path (default: memory)")
+    p.add_argument("-store", default="sqlite",
+                   help="store kind: sqlite | sharded | perbucket")
     p.add_argument("-replication", default="")
     p.add_argument("-collection", default="")
     p.add_argument("-peers", default="",
@@ -723,6 +795,8 @@ def main(argv=None):
     p.add_argument("-filer", action="store_true")
     p.add_argument("-s3", action="store_true")
     p.add_argument("-db", default="")
+    p.add_argument("-store", default="sqlite",
+                   help="filer store kind: sqlite | sharded | perbucket")
     p.add_argument("-config", default="")
     p.add_argument("-rack", default="")
     p.set_defaults(fn=cmd_server)
