@@ -50,7 +50,13 @@ class BenchResult:
 def run_benchmark(master_address: str, num_files: int = 1000,
                   file_size: int = 1024, concurrency: int = 16,
                   delete_percent: int = 0, replication: str = "000",
-                  do_read: bool = True, quiet: bool = False):
+                  do_read: bool = True, quiet: bool = False,
+                  use_tcp: bool = False):
+    tcp_client = None
+    if use_tcp:  # benchmark -useTcp (command/benchmark.go)
+        from .wdclient.volume_tcp_client import VolumeTcpClient
+
+        tcp_client = VolumeTcpClient(max_conns_per_server=concurrency)
     payload = random.randbytes(file_size)
     fids: list[tuple[str, str]] = []
     fid_lock = threading.Lock()
@@ -91,6 +97,8 @@ def run_benchmark(master_address: str, num_files: int = 1000,
     write.seconds = time.perf_counter() - t0
 
     read = BenchResult()
+    if tcp_client is not None and not (do_read and fids):
+        tcp_client.close()
     if do_read and fids:
         reads_left = {"n": len(fids)}
 
@@ -103,23 +111,32 @@ def run_benchmark(master_address: str, num_files: int = 1000,
                 url, fid = random.choice(fids)
                 t0 = time.perf_counter()
                 try:
-                    data = call(url, f"/{fid}")
+                    # broad catch: the TCP path raises VolumeTcpError/
+                    # OSError/TimeoutError, not just RpcError — a dead
+                    # reader thread would silently skew the report
+                    data = (tcp_client.read_needle(url, fid)
+                            if tcp_client is not None
+                            else call(url, f"/{fid}"))
                     dt = (time.perf_counter() - t0) * 1e3
                     with fid_lock:
                         read.requests += 1
                         read.bytes += len(data)
                         read.latencies_ms.append(dt)
-                except RpcError:
+                except Exception:
                     with fid_lock:
                         read.errors += 1
 
         t0 = time.perf_counter()
         threads = [threading.Thread(target=read_worker)
                    for _ in range(concurrency)]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
+        try:
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        finally:
+            if tcp_client is not None:
+                tcp_client.close()
         read.seconds = time.perf_counter() - t0
 
     if delete_percent > 0:

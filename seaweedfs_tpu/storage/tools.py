@@ -1,0 +1,126 @@
+"""Offline volume tooling: index repair, export, offline compaction.
+
+Parity with the reference's maintenance commands that operate on volume
+files directly, without a running server: `weed fix` (rebuild .idx by
+scanning the .dat; command/fix.go), `weed export` (dump live needles to
+a tar; command/export.go), `weed compact` (offline vacuum;
+command/compact.go), and `weed backup`'s local volume copy
+(command/backup.go).
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import tarfile
+import time
+from typing import Callable, Optional
+
+from . import types as t
+from .backend import DiskFile
+from .needle import get_actual_size, read_needle_header
+from .needle_map import NeedleMap
+from .super_block import SuperBlock
+
+
+def _base(directory: str, collection: str, vid: int) -> str:
+    name = f"{collection}_{vid}" if collection else str(vid)
+    return os.path.join(directory, name)
+
+
+def scan_dat(dat_path: str):
+    """Yield (needle, offset) for every record in a .dat, without
+    loading an index (the `weed fix`/`weed export` walk)."""
+    data = DiskFile(dat_path)
+    try:
+        with open(dat_path, "rb") as f:
+            sb = SuperBlock.from_file(f)
+        pos = sb.block_size
+        end = data.size()
+        while pos < end:
+            header = data.read_at(t.NEEDLE_HEADER_SIZE, pos)
+            if len(header) < t.NEEDLE_HEADER_SIZE:
+                break
+            n, _ = read_needle_header(header)
+            body_len = (get_actual_size(n.size, sb.version)
+                        - t.NEEDLE_HEADER_SIZE)
+            body = data.read_at(body_len, pos + t.NEEDLE_HEADER_SIZE)
+            n.read_needle_body(body, sb.version)
+            yield n, pos
+            pos += t.NEEDLE_HEADER_SIZE + body_len
+    finally:
+        data.close()
+
+
+def rebuild_index(directory: str, collection: str, vid: int) -> int:
+    """`weed fix`: reconstruct the .idx from the .dat append log.  A
+    record with data is a put; a zero-size record is a tombstone."""
+    base = _base(directory, collection, vid)
+    dat, idx = base + ".dat", base + ".idx"
+    tmp = idx + ".rebuild"
+    if os.path.exists(tmp):
+        os.remove(tmp)
+    nm = NeedleMap(tmp)
+    count = 0
+    for n, offset in scan_dat(dat):
+        if n.size > 0 and n.data:
+            nm.put(n.id, offset, n.size)
+        else:
+            nm.delete(n.id, offset)
+        count += 1
+    nm.close()
+    os.replace(tmp, idx)
+    return count
+
+
+def export_volume(directory: str, collection: str, vid: int,
+                  output_tar: str = "",
+                  newer_than_ts: float = 0.0,
+                  include_deleted: bool = False) -> list[dict]:
+    """`weed export`: list (and optionally tar) the live needles."""
+    base = _base(directory, collection, vid)
+    live: dict[int, tuple] = {}
+    for n, offset in scan_dat(base + ".dat"):
+        if n.size > 0 and n.data:
+            live[n.id] = (n, offset)
+        elif not include_deleted:
+            live.pop(n.id, None)
+    records = []
+    tar = tarfile.open(output_tar, "w") if output_tar else None
+    try:
+        for nid, (n, offset) in sorted(live.items()):
+            last_modified = getattr(n, "last_modified", 0)
+            if newer_than_ts and last_modified \
+                    and last_modified < newer_than_ts:
+                continue
+            name = (n.name.decode(errors="replace")
+                    if getattr(n, "has_name", False) and n.name
+                    else f"{vid}_{nid}")
+            records.append({"id": nid, "name": name,
+                            "size": len(n.data), "offset": offset})
+            if tar is not None:
+                info = tarfile.TarInfo(name=name)
+                info.size = len(n.data)
+                info.mtime = last_modified or int(time.time())
+                tar.addfile(info, io.BytesIO(n.data))
+    finally:
+        if tar is not None:
+            tar.close()
+    return records
+
+
+def compact_offline(directory: str, collection: str, vid: int) -> dict:
+    """`weed compact`: run the copy-live-data vacuum on an offline
+    volume directory."""
+    from .volume import Volume
+
+    v = Volume(directory, collection, vid)
+    try:
+        before = v.data.size()
+        v.compact()
+        v.commit_compact()
+        after = v.data.size()
+    finally:
+        v.close()
+    return {"volume": vid, "before_bytes": before, "after_bytes": after,
+            "reclaimed": before - after}

@@ -47,7 +47,10 @@ class VolumeServer:
                  public_url: str = "", data_center: str = "",
                  rack: str = "", max_volume_counts: Optional[list[int]] = None,
                  pulse_seconds: float = 5.0, ec_encoder_backend=None,
-                 guard: Optional[Guard] = None, tier_backends=None):
+                 guard: Optional[Guard] = None, tier_backends=None,
+                 enable_tcp: bool = False):
+        self.enable_tcp = enable_tcp
+        self._tcp_sock = None
         # tier backends must be registered before Store discovery so
         # .vif-only (tiered) volumes load (storage/tier.py registry)
         if tier_backends:
@@ -84,14 +87,95 @@ class VolumeServer:
     # -- lifecycle -----------------------------------------------------------
     def start(self):
         self.server.start()
+        if self.enable_tcp:
+            self._start_tcp()
         self._heartbeat_thread = threading.Thread(
             target=self._heartbeat_loop, daemon=True)
         self._heartbeat_thread.start()
 
     def stop(self):
         self._stop.set()
+        if self._tcp_sock is not None:
+            try:
+                self._tcp_sock.close()
+            except OSError:
+                pass
         self.server.stop()
         self.store.close()
+
+    # -- TCP fast path (volume_server_tcp, port+20000) -----------------------
+    def _start_tcp(self):
+        import socket
+        import struct
+
+        from ..wdclient.volume_tcp_client import TCP_PORT_OFFSET
+
+        host, port = self.server.address.rsplit(":", 1)
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        wanted = int(port) + TCP_PORT_OFFSET
+        try:
+            sock.bind((host, wanted if wanted <= 65535 else 0))
+        except OSError:
+            sock.bind((host, 0))  # convention port taken: ephemeral,
+            # clients discover it via /admin/status tcp_port
+        sock.listen(64)
+        self._tcp_sock = sock
+        self.tcp_port = sock.getsockname()[1]
+
+        def reply(conn, status: int, payload: bytes):
+            conn.sendall(struct.pack(">II", status, len(payload))
+                         + payload)
+
+        def serve_conn(conn):
+            try:
+                buf = b""
+                while not self._stop.is_set():
+                    while b"\n" not in buf:
+                        chunk = conn.recv(4096)
+                        if not chunk:
+                            return
+                        buf += chunk
+                    line, _, buf = buf.partition(b"\n")
+                    parts = line.decode(errors="replace").split()
+                    if len(parts) not in (2, 3) or parts[0] != "G":
+                        reply(conn, 400, b"bad request")
+                        return
+                    fid = parts[1]
+                    # same read security as the HTTP path: an optional
+                    # JWT rides as the third token
+                    if self.guard.read_signing:
+                        try:
+                            self.guard.verify_read(
+                                parts[2] if len(parts) == 3 else "",
+                                fid)
+                        except PermissionError as e:
+                            reply(conn, 401, str(e).encode())
+                            continue
+                    try:
+                        vid, nid, cookie = t.parse_file_id(fid)
+                        n = self.store.read_needle(vid, nid,
+                                                   cookie=cookie)
+                        reply(conn, 0, n.data)
+                    except (NotFoundError, EcNotFoundError,
+                            DeletedError, EcDeletedError,
+                            CookieMismatchError):
+                        reply(conn, 404, b"not found")
+                    except Exception as e:
+                        reply(conn, 500, str(e).encode())
+            finally:
+                conn.close()
+
+        def accept_loop():
+            while not self._stop.is_set():
+                try:
+                    conn, _ = sock.accept()
+                except OSError:
+                    return
+                threading.Thread(target=serve_conn, args=(conn,),
+                                 daemon=True).start()
+
+        threading.Thread(target=accept_loop, daemon=True).start()
 
     def heartbeat_once(self):
         hb = self.store.collect_heartbeat()
@@ -135,7 +219,9 @@ class VolumeServer:
     def _register_routes(self):
         s = self.server
         g = self._guarded
-        s.add("GET", "/admin/status", g(lambda r: self.store.status()))
+        s.add("GET", "/admin/status",
+              g(lambda r: {**self.store.status(),
+                           "tcp_port": getattr(self, "tcp_port", 0)}))
         s.add("POST", "/admin/assign_volume", g(self._h_assign_volume))
         s.add("POST", "/admin/delete_volume", g(self._h_delete_volume))
         s.add("POST", "/admin/readonly", g(self._h_readonly))

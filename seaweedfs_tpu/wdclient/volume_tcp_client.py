@@ -1,0 +1,113 @@
+"""TCP fast path for volume reads (wdclient/volume_tcp_client.go).
+
+HTTP adds per-request header parsing on the hottest path — the
+reference's experimental TCP mode trades it for a trivial framed
+protocol on a dedicated port (http port + 20000).  Frame format:
+
+  request:  "G <fid>[ <jwt>]\n"          (read needle; jwt when the
+                                          cluster signs reads)
+  response: u32be status | u32be length | payload
+            status 0 = ok, 401 = unauthorized, 404 = not found,
+            500 = error
+
+Connections are pooled per server address via ResourcePool.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from typing import Optional
+
+from .resource_pool import ResourcePool
+
+TCP_PORT_OFFSET = 20000  # mirrors the reference's port+20000 convention
+
+
+class VolumeTcpError(Exception):
+    def __init__(self, message: str, status: int = 500):
+        super().__init__(message)
+        self.status = status
+
+
+class VolumeTcpClient:
+    """Pooled TCP connections to volume servers' fast-path ports."""
+
+    def __init__(self, max_conns_per_server: int = 8):
+        self._pools: dict[str, ResourcePool[socket.socket]] = {}
+        self._resolved: dict[str, str] = {}  # http url -> tcp addr
+        self._lock = threading.Lock()
+        self._max = max_conns_per_server
+
+    def _pool(self, tcp_addr: str) -> ResourcePool:
+        with self._lock:
+            pool = self._pools.get(tcp_addr)
+            if pool is None:
+                host, port = tcp_addr.rsplit(":", 1)
+
+                def factory(host=host, port=int(port)):
+                    s = socket.create_connection((host, port), timeout=30)
+                    s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                    return s
+
+                pool = ResourcePool(
+                    factory, close_fn=lambda s: s.close(),
+                    max_open=self._max, max_idle=self._max)
+                self._pools[tcp_addr] = pool
+            return pool
+
+    def tcp_address(self, http_url: str) -> str:
+        """port+20000 by convention; when that overflows (ephemeral test
+        ports) ask the server's /admin/status for its actual tcp_port."""
+        host, port = http_url.rsplit(":", 1)
+        wanted = int(port) + TCP_PORT_OFFSET
+        if wanted <= 65535:
+            return f"{host}:{wanted}"
+        with self._lock:
+            cached = self._resolved.get(http_url)
+        if cached:
+            return cached
+        from ..rpc.http_rpc import call
+
+        status = call(http_url, "/admin/status", timeout=10)
+        tcp_port = status.get("tcp_port", 0)
+        if not tcp_port:
+            raise VolumeTcpError(
+                f"{http_url} does not serve the TCP fast path", 503)
+        resolved = f"{host}:{tcp_port}"
+        with self._lock:
+            self._resolved[http_url] = resolved
+        return resolved
+
+    def read_needle(self, volume_server_url: str, fid: str,
+                    jwt: str = "") -> bytes:
+        pool = self._pool(self.tcp_address(volume_server_url))
+        with pool.use() as conn:
+            line = f"G {fid} {jwt}\n" if jwt else f"G {fid}\n"
+            conn.sendall(line.encode())
+            header = _read_exact(conn, 8)
+            status, length = struct.unpack(">II", header)
+            payload = _read_exact(conn, length)
+            if status != 0:
+                raise VolumeTcpError(
+                    payload.decode(errors="replace") or "read failed",
+                    status)
+            return payload
+
+    def close(self):
+        with self._lock:
+            pools, self._pools = list(self._pools.values()), {}
+        for pool in pools:
+            pool.close()
+
+
+def _read_exact(conn: socket.socket, n: int) -> bytes:
+    parts = []
+    while n > 0:
+        chunk = conn.recv(n)
+        if not chunk:
+            raise VolumeTcpError("connection closed mid-frame")
+        parts.append(chunk)
+        n -= len(chunk)
+    return b"".join(parts)

@@ -112,7 +112,8 @@ def cmd_volume(args):
                       max_volume_counts=maxes,
                       pulse_seconds=args.pulseSeconds,
                       guard=_load_guard(),
-                      tier_backends=_parse_tier_backends(args.tier))
+                      tier_backends=_parse_tier_backends(args.tier),
+                      enable_tcp=args.tcp)
     vs.start()
     print(f"volume server listening on {vs.address}, dirs={dirs}")
     _wait_forever([vs])
@@ -454,7 +455,7 @@ def cmd_benchmark(args):
 
     run_benchmark(args.master, num_files=args.n, file_size=args.size,
                   concurrency=args.c, delete_percent=args.deletePercent,
-                  replication=args.replication)
+                  replication=args.replication, use_tcp=args.useTcp)
 
 
 def cmd_upload(args):
@@ -620,6 +621,141 @@ def cmd_filer_meta_tail(args):
             _time.sleep(args.interval)
 
 
+def cmd_filer_copy(args):
+    """Copy local files/directories into the filer
+    (weed/command/filer_copy.go)."""
+    dest = args.path.rstrip("/")  # "" for root: targets join as /name
+    copied = 0
+    for src in args.files:
+        src = src.rstrip("/")
+        if os.path.isdir(src):
+            base = os.path.basename(src)
+            for dirpath, _, files in os.walk(src):
+                rel_dir = os.path.relpath(dirpath, src)
+                for name in sorted(files):
+                    rel = name if rel_dir == "." \
+                        else f"{rel_dir}/{name}"
+                    target = f"{dest}/{base}/{rel}"
+                    _copy_one(args.filer, os.path.join(dirpath, name),
+                              target)
+                    copied += 1
+        else:
+            _copy_one(args.filer, src,
+                      f"{dest}/{os.path.basename(src)}")
+            copied += 1
+    print(f"copied {copied} files to {args.filer}{dest}")
+
+
+def _copy_one(filer: str, local_path: str, target: str):
+    import mimetypes
+    import urllib.parse
+
+    with open(local_path, "rb") as f:
+        body = f.read()
+    mime = mimetypes.guess_type(local_path)[0] or \
+        "application/octet-stream"
+    call(filer, urllib.parse.quote(target), raw=body, method="POST",
+         headers={"Content-Type": mime}, timeout=600)
+
+
+def cmd_filer_cat(args):
+    """Stream one filer file to stdout (weed/command/filer_cat.go)."""
+    import urllib.parse
+
+    # the raw GET can't distinguish a stored .json file from a
+    # directory listing, so check the entry type via the parent listing
+    path = "/" + args.path.strip("/")
+    parent, _, name = path.rpartition("/")
+    listing = call(args.filer,
+                   urllib.parse.quote(parent or "/") + "/?limit=10000",
+                   timeout=60)
+    entry = next((e for e in listing.get("Entries", [])
+                  if e.get("FullPath", "").rsplit("/", 1)[-1] == name),
+                 None)
+    if entry is None:
+        print(f"error: {path} not found", file=sys.stderr)
+        sys.exit(1)
+    if entry.get("IsDirectory"):
+        print(f"error: {path} is a directory", file=sys.stderr)
+        sys.exit(1)
+    data = call(args.filer, urllib.parse.quote(path), parse=False,
+                timeout=600)
+    sys.stdout.buffer.write(data)
+
+
+def cmd_backup(args):
+    """Keep a local, incrementally-updated copy of one volume
+    (weed/command/backup.go): first run fetches .dat/.idx wholesale,
+    later runs tail only the new appends."""
+    from seaweedfs_tpu.storage import volume_backup
+    from seaweedfs_tpu.storage.volume import Volume
+
+    found = call(args.master, f"/dir/lookup?volumeId={args.volumeId}")
+    locations = found.get("locations", [])
+    if not locations:
+        print(f"error: volume {args.volumeId} not found")
+        sys.exit(1)
+    source = locations[0]["url"]
+    os.makedirs(args.dir, exist_ok=True)
+    name = (f"{args.collection}_{args.volumeId}" if args.collection
+            else str(args.volumeId))
+    dat_path = os.path.join(args.dir, name + ".dat")
+    if not os.path.exists(dat_path):
+        for ext in (".idx", ".dat"):
+            blob = call(source,
+                        f"/admin/ec/shard_file?volume={args.volumeId}"
+                        f"&collection={args.collection}&ext={ext}",
+                        timeout=3600)
+            with open(os.path.join(args.dir, name + ext), "wb") as f:
+                f.write(blob if isinstance(blob, bytes) else b"")
+        print(f"full copy of volume {args.volumeId} from {source}")
+        return
+    v = Volume(args.dir, args.collection, args.volumeId)
+    try:
+        applied = volume_backup.incremental_backup(
+            v, lambda since: _fetch_tail(source, args.volumeId, since))
+        print(f"applied {applied} new records from {source}")
+    finally:
+        v.close()
+
+
+def _fetch_tail(source: str, vid: int, since_ns: int) -> bytes:
+    data = call(source,
+                f"/admin/volume/tail?volume={vid}&since_ns={since_ns}",
+                timeout=600)
+    return data if isinstance(data, (bytes, bytearray)) else b""
+
+
+def cmd_compact(args):
+    """Offline vacuum of a volume directory (weed/command/compact.go)."""
+    from seaweedfs_tpu.storage.tools import compact_offline
+
+    print(json.dumps(compact_offline(args.dir, args.collection,
+                                     args.volumeId)))
+
+
+def cmd_fix(args):
+    """Rebuild the .idx from the .dat (weed/command/fix.go)."""
+    from seaweedfs_tpu.storage.tools import rebuild_index
+
+    count = rebuild_index(args.dir, args.collection, args.volumeId)
+    print(f"rebuilt index from {count} records")
+
+
+def cmd_export(args):
+    """Export a volume's live needles (weed/command/export.go)."""
+    from seaweedfs_tpu.storage.tools import export_volume
+
+    records = export_volume(args.dir, args.collection, args.volumeId,
+                            output_tar=args.o,
+                            newer_than_ts=args.newer or 0.0)
+    for r in records:
+        print(json.dumps(r))
+    if args.o:
+        print(f"wrote {len(records)} files to {args.o}",
+              file=sys.stderr)
+
+
 def cmd_filer_remote_sync(args):
     """Push local changes under a remote mount back to the remote
     storage (weed/command/filer_remote_sync.go; filer.remote.gateway is
@@ -748,6 +884,8 @@ def main(argv=None):
     p.add_argument("-tier", action="append", default=[],
                    help="tier backend: name=local:/dir or "
                         "name=s3:endpoint[,ak,sk] (repeatable)")
+    p.add_argument("-tcp", action="store_true",
+                   help="serve the TCP read fast path on port+20000")
     p.set_defaults(fn=cmd_volume)
 
     p = sub.add_parser("filer", help="start a filer server")
@@ -816,6 +954,8 @@ def main(argv=None):
     p.add_argument("-c", type=int, default=16)
     p.add_argument("-deletePercent", type=int, default=0)
     p.add_argument("-replication", default="000")
+    p.add_argument("-useTcp", action="store_true",
+                   help="read over the TCP fast path")
     p.set_defaults(fn=cmd_benchmark)
 
     p = sub.add_parser("upload", help="upload one file")
@@ -829,6 +969,47 @@ def main(argv=None):
     p.add_argument("-master", default="127.0.0.1:9333")
     p.add_argument("-output", default="")
     p.set_defaults(fn=cmd_download)
+
+    p = sub.add_parser("filer.copy",
+                       help="copy local files/dirs into the filer")
+    p.add_argument("files", nargs="+")
+    p.add_argument("-filer", default="127.0.0.1:8888")
+    p.add_argument("-path", default="/", help="destination directory")
+    p.set_defaults(fn=cmd_filer_copy)
+
+    p = sub.add_parser("filer.cat", help="stream a filer file to stdout")
+    p.add_argument("path")
+    p.add_argument("-filer", default="127.0.0.1:8888")
+    p.set_defaults(fn=cmd_filer_cat)
+
+    p = sub.add_parser("backup",
+                       help="local incremental copy of one volume")
+    p.add_argument("-master", default="127.0.0.1:9333")
+    p.add_argument("-volumeId", type=int, required=True)
+    p.add_argument("-collection", default="")
+    p.add_argument("-dir", default=".")
+    p.set_defaults(fn=cmd_backup)
+
+    p = sub.add_parser("compact", help="offline vacuum of a volume")
+    p.add_argument("-dir", default=".")
+    p.add_argument("-volumeId", type=int, required=True)
+    p.add_argument("-collection", default="")
+    p.set_defaults(fn=cmd_compact)
+
+    p = sub.add_parser("fix", help="rebuild a volume .idx from its .dat")
+    p.add_argument("-dir", default=".")
+    p.add_argument("-volumeId", type=int, required=True)
+    p.add_argument("-collection", default="")
+    p.set_defaults(fn=cmd_fix)
+
+    p = sub.add_parser("export", help="export a volume's live needles")
+    p.add_argument("-dir", default=".")
+    p.add_argument("-volumeId", type=int, required=True)
+    p.add_argument("-collection", default="")
+    p.add_argument("-o", default="", help="write a tar archive here")
+    p.add_argument("-newer", type=float, default=0,
+                   help="only needles modified after this unix time")
+    p.set_defaults(fn=cmd_export)
 
     p = sub.add_parser("filer.sync", help="sync two filers continuously")
     p.add_argument("-a", required=True, help="source filer host:port")
