@@ -326,6 +326,8 @@ class FilerServer:
         except NotFoundError:
             raise RpcError(f"{path} not found", 404)
         if entry.is_directory:
+            if "text/html" in (req.headers.get("Accept") or ""):
+                return self._render_ui(entry)  # browser surface
             return self._list_directory(entry, req)
 
         size = entry.size()
@@ -404,6 +406,38 @@ class FilerServer:
         except ValueError as e:
             raise RpcError(str(e), 400)
         return Response(b"", 204)
+
+    def _render_ui(self, entry: Entry) -> Response:
+        """Browser UI (server/filer_ui): served when a directory GET asks
+        for text/html — a dedicated /ui route would shadow a stored file
+        at that path, so content negotiation picks the surface instead."""
+        from . import remote_storage as rs
+        from ..util import ui
+
+        entries = self.filer.list_directory(entry.full_path, limit=1000)
+        prefix = entry.full_path.rstrip("/")
+        listing = ui.table(
+            ("name", "type", "size"),
+            [(f"{prefix}/{e.name}",
+              "dir" if e.is_directory else (e.attr.mime or "file"),
+              "-" if e.is_directory else e.size()) for e in entries])
+        mappings = rs.read_mount_mappings(self.filer)
+        body = ui.page(
+            f"SeaweedFS-TPU Filer {self.address} — {entry.full_path}",
+            ui.section("Filer", ui.kv_table({
+                "master": self.master_address,
+                "store": type(self.filer.store).__name__,
+                "chunk size": self.chunk_size,
+                "metadata log": "persisted"
+                if self.filer.meta_log_enabled else "in-memory",
+                "peers": ", ".join(self.meta_aggregator.peers)
+                if self.meta_aggregator else "-",
+            })),
+            ui.section(f"Listing of {entry.full_path}", listing),
+            ui.section("Remote mounts", ui.table(
+                ("directory", "remote"), sorted(mappings.items()))),
+        )
+        return Response(body, content_type="text/html; charset=utf-8")
 
     # -- remote storage mounts (weed/filer/remote_storage.go; shell
     # remote.* commands drive these endpoints) -------------------------------

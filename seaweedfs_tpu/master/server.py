@@ -133,6 +133,38 @@ class MasterServer:
         s.add("GET", "/cluster/nodes", self._handle_cluster_nodes)
         s.add("POST", "/admin/lock", g(self._handle_admin_lock))
         s.add("POST", "/admin/unlock", g(self._handle_admin_unlock))
+        s.add("GET", "/ui", self._handle_ui)
+
+    def _handle_ui(self, req):
+        """Status page (server/master_ui/master.html)."""
+        from ..rpc.http_rpc import Response
+        from ..util import ui
+
+        topo = self.topo.to_dict()
+        nodes = [(n["id"], dc["id"], rack["id"], n["volumes"],
+                  n["ecShards"], n["max"], n["free"])
+                 for dc in topo["datacenters"]
+                 for rack in dc["racks"] for n in rack["nodes"]]
+        layouts = [(l["collection"] or "(default)", l["replication"],
+                    l["ttl"], len(l["writables"]))
+                   for l in topo["layouts"]]
+        body = ui.page(
+            f"SeaweedFS-TPU Master {self.address}",
+            ui.section("Cluster", ui.kv_table({
+                "leader": self.raft.leader or self.address,
+                "raft state": self.raft.state,
+                "raft peers": ", ".join(self.raft.peers),
+                "max volume id": topo["max_volume_id"],
+                "volume size limit": self.topo.volume_size_limit,
+            })),
+            ui.section("Topology", ui.table(
+                ("node", "data center", "rack", "volumes", "ec shards",
+                 "max", "free"), nodes)),
+            ui.section("Volume layouts", ui.table(
+                ("collection", "replication", "ttl", "writables"),
+                layouts)),
+        )
+        return Response(body, content_type="text/html; charset=utf-8")
 
     # -- heartbeat (master_grpc_server.go:60-170) ----------------------------
     def _handle_heartbeat(self, req):

@@ -285,3 +285,17 @@ def metrics_handler(req):
 
     return Response(REGISTRY.expose().encode(),
                     content_type="text/plain; version=0.0.4")
+
+
+def start_metrics_server(host: str = "127.0.0.1",
+                         port: int = 0):
+    """Dedicated metrics endpoint on its own port (the reference's
+    -metricsPort; stats/metrics.go StartMetricsServer).  Daemons whose
+    main port serves a user namespace (filer paths, s3 buckets) cannot
+    mount /metrics there without shadowing user data."""
+    from ..rpc.http_rpc import RpcServer
+
+    server = RpcServer(host, port)
+    server.add("GET", "/metrics", metrics_handler)
+    server.start()
+    return server

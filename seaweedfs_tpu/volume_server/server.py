@@ -253,7 +253,44 @@ class VolumeServer:
         s.add("POST", "/admin/leave", g(self._h_leave))
         s.add("POST", "/query", self._h_query)
         s.add("GET", "/metrics", stats.metrics_handler)
+        s.add("GET", "/ui", self._h_ui)
         s.default_route = self._handle_object
+
+    def _h_ui(self, req: Request):
+        """Status page (server/volume_server_ui/volume.html)."""
+        from ..util import ui
+
+        rows = []
+        ec_rows = []
+        for loc in self.store.locations:
+            with loc.lock:
+                for vid, v in sorted(loc.volumes.items()):
+                    dat_size, _ = v.file_stat()
+                    rows.append((
+                        vid, v.collection or "(default)", dat_size,
+                        v.file_count(), v.deleted_count(),
+                        str(v.super_block.replica_placement),
+                        "readonly" if v.read_only else "writable"))
+                for vid, ev in sorted(loc.ec_volumes.items()):
+                    ec_rows.append((vid, ev.collection or "(default)",
+                                    sorted(ev.shard_bits().shard_ids())))
+        body = ui.page(
+            f"SeaweedFS-TPU Volume Server {self.address}",
+            ui.section("Server", ui.kv_table({
+                "master": self.master_address,
+                "directories": ", ".join(
+                    loc.directory for loc in self.store.locations),
+                "data center": self.store.data_center or "-",
+                "rack": self.store.rack or "-",
+                "tcp fast path": getattr(self, "tcp_port", 0) or "off",
+            })),
+            ui.section("Volumes", ui.table(
+                ("id", "collection", "size", "files", "deleted",
+                 "replication", "mode"), rows)),
+            ui.section("EC shards", ui.table(
+                ("volume", "collection", "shards"), ec_rows)),
+        )
+        return Response(body, content_type="text/html; charset=utf-8")
 
     def _h_configure_replication(self, req: Request):
         """VolumeConfigure (volume server side of

@@ -1,0 +1,160 @@
+"""Server status UIs + grace shutdown/profiling hooks
+(weed/server/{master,volume_server,filer}_ui, weed/util/grace)."""
+
+import os
+import urllib.request
+
+import pytest
+
+from seaweedfs_tpu.filer.server import FilerServer
+from seaweedfs_tpu.master.server import MasterServer
+from seaweedfs_tpu.rpc.http_rpc import call
+from seaweedfs_tpu.util import grace
+from seaweedfs_tpu.volume_server.server import VolumeServer
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    master = MasterServer(port=0, pulse_seconds=0.2)
+    master.start()
+    d = tmp_path / "v"
+    d.mkdir()
+    vs = VolumeServer([str(d)], master.address, port=0, pulse_seconds=0.2)
+    vs.start()
+    vs.heartbeat_once()
+    filer = FilerServer(master.address, port=0)
+    filer.start()
+    yield master, vs, filer
+    filer.stop()
+    vs.stop()
+    master.stop()
+
+
+def fetch_html(addr, path="/ui", accept=""):
+    req = urllib.request.Request(f"http://{addr}{path}")
+    if accept:
+        req.add_header("Accept", accept)
+    with urllib.request.urlopen(req, timeout=10) as r:
+        assert "text/html" in r.headers.get("Content-Type", "")
+        return r.read().decode()
+
+
+class TestStatusPages:
+    def test_master_ui(self, cluster):
+        master, vs, filer = cluster
+        a = call(master.address, "/dir/assign")
+        call(a["url"], f"/{a['fid']}", raw=b"x", method="POST")
+        vs.heartbeat_once()
+        html = fetch_html(master.address)
+        assert "Master" in html and vs.store.url in html
+        assert "Topology" in html and "Volume layouts" in html
+
+    def test_volume_ui(self, cluster):
+        master, vs, filer = cluster
+        a = call(master.address, "/dir/assign")
+        call(a["url"], f"/{a['fid']}", raw=b"x", method="POST")
+        html = fetch_html(vs.address)
+        assert "Volume Server" in html and "writable" in html
+
+    def test_filer_ui_via_content_negotiation(self, cluster):
+        master, vs, filer = cluster
+        call(filer.address, "/docs/a.txt", raw=b"hi", method="POST")
+        # browsers (Accept: text/html) get the UI on directory GETs
+        html = fetch_html(filer.address, "/", accept="text/html")
+        assert "Filer" in html and master.address in html
+        assert "docs" in html
+        # API clients still get the JSON listing
+        listing = call(filer.address, "/")
+        assert "Entries" in listing
+        # a stored file named /ui is NOT shadowed by any UI route
+        call(filer.address, "/ui", raw=b"user file", method="POST")
+        assert call(filer.address, "/ui", parse=False) == b"user file"
+
+    def test_filer_metrics_port(self, cluster):
+        from seaweedfs_tpu.stats.metrics import start_metrics_server
+
+        server = start_metrics_server(port=0)
+        try:
+            body = call(server.address, "/metrics", parse=False)
+            assert b"SeaweedFS_filer_request_total" in body
+        finally:
+            server.stop()
+
+    def test_ui_escapes_html(self, cluster, tmp_path):
+        """Topology values render as text, not markup."""
+        master, vs, filer = cluster
+        d2 = tmp_path / "x"
+        d2.mkdir()
+        evil = VolumeServer([str(d2)], master.address, port=0,
+                            rack="<script>alert(1)</script>",
+                            pulse_seconds=0.2)
+        evil.start()
+        evil.heartbeat_once()
+        try:
+            html = fetch_html(master.address)
+            assert "<script>alert(1)</script>" not in html
+            assert "&lt;script&gt;" in html
+        finally:
+            evil.stop()
+
+
+class TestGrace:
+    def test_hooks_run_once_in_reverse_order(self):
+        grace._reset_for_tests()
+        order = []
+        grace.on_interrupt(lambda: order.append("first"))
+        grace.on_interrupt(lambda: order.append("second"))
+        grace._run_hooks()
+        grace._run_hooks()  # idempotent
+        assert order == ["second", "first"]
+        grace._reset_for_tests()
+
+    def test_failing_hook_does_not_block_others(self):
+        grace._reset_for_tests()
+        ran = []
+
+        def boom():
+            raise RuntimeError("cleanup failed")
+
+        grace.on_interrupt(lambda: ran.append(1))
+        grace.on_interrupt(boom)
+        grace._run_hooks()
+        assert ran == [1]
+        grace._reset_for_tests()
+
+    def test_cpu_profile_samples_worker_threads(self, tmp_path):
+        import threading
+        import time
+
+        grace._reset_for_tests()
+        prof = str(tmp_path / "cpu.prof")
+        grace.setup_profiling(cpu_profile=prof)
+
+        stop = threading.Event()
+
+        def busy_worker():  # the daemon pattern: work off-main-thread
+            while not stop.is_set():
+                sum(i * i for i in range(2000))
+
+        t = threading.Thread(target=busy_worker)
+        t.start()
+        time.sleep(0.25)
+        stop.set()
+        t.join()
+        grace._run_hooks()
+        report = open(prof).read()
+        assert "sampling cpu profile" in report
+        # samples from the worker thread's hot loop are visible (the
+        # top frame is the genexpr inside busy_worker, in this file)
+        assert "test_ui_grace.py" in report
+        grace._reset_for_tests()
+
+    def test_mem_profile_dumped(self, tmp_path):
+        grace._reset_for_tests()
+        path = str(tmp_path / "heap.txt")
+        grace.setup_profiling(mem_profile=path)
+        blob = [bytes(1000) for _ in range(100)]
+        grace._run_hooks()
+        assert os.path.getsize(path) > 0
+        del blob
+        grace._reset_for_tests()

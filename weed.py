@@ -22,9 +22,11 @@ VERSION = "seaweedfs_tpu 0.1 (RS(10,4) EC on TPU via JAX/Pallas)"
 
 
 def _wait_forever(stoppables):
-    stop = lambda *a: (_stop_all(stoppables), sys.exit(0))
-    signal.signal(signal.SIGINT, stop)
-    signal.signal(signal.SIGTERM, stop)
+    from seaweedfs_tpu.util import grace
+
+    # graceful shutdown via the grace hooks (also dumps any active
+    # -cpuprofile/-memprofile on the way out)
+    grace.on_interrupt(lambda: _stop_all(stoppables))
     signal.pause()
 
 
@@ -151,8 +153,15 @@ def cmd_filer(args):
                     persist_meta_log=args.metaLog)
     _wire_notification(f)
     f.start()
+    stoppables = [f]
+    if args.metricsPort:
+        from seaweedfs_tpu.stats.metrics import start_metrics_server
+
+        m = start_metrics_server(args.ip, args.metricsPort)
+        stoppables.append(m)
+        print(f"metrics on {m.address}/metrics")
     print(f"filer listening on {f.address}")
-    _wait_forever([f])
+    _wait_forever(stoppables)
 
 
 def _wire_notification(filer_server):
@@ -195,8 +204,15 @@ def cmd_s3(args):
     s3 = S3ApiServer(filer, host=args.ip, port=args.port,
                      identities=_load_identities(args.config))
     s3.start()
+    stoppables = [s3, filer]
+    if args.metricsPort:
+        from seaweedfs_tpu.stats.metrics import start_metrics_server
+
+        m = start_metrics_server(args.ip, args.metricsPort)
+        stoppables.append(m)
+        print(f"metrics on {m.address}/metrics")
     print(f"s3 gateway on {s3.address} (filer {filer.address})")
-    _wait_forever([s3, filer])
+    _wait_forever(stoppables)
 
 
 def cmd_iam(args):
@@ -244,7 +260,7 @@ def cmd_server(args):
     stoppables.append(vs)
     print(f"volume server on {vs.address}")
 
-    if args.filer or args.s3:
+    if args.filer or args.s3 or args.iam:
         store = _make_filer_store(args.store, args.db)
         filer = FilerServer(master.address, host=args.ip,
                             port=args.filerPort, store=store, guard=guard)
@@ -252,12 +268,20 @@ def cmd_server(args):
         filer.start()
         stoppables.append(filer)
         print(f"filer on {filer.address}")
-        if args.s3:
+        if args.s3 or args.iam:
             s3 = S3ApiServer(filer, host=args.ip, port=args.s3Port,
                              identities=_load_identities(args.config))
             s3.start()
             stoppables.append(s3)
             print(f"s3 gateway on {s3.address}")
+            if args.iam:
+                from seaweedfs_tpu.iamapi.server import IamApiServer
+
+                iam = IamApiServer(filer, host=args.ip,
+                                   port=args.iamPort, s3_server=s3)
+                iam.start()
+                stoppables.append(iam)
+                print(f"iam api on {iam.address}")
     _wait_forever(stoppables)
 
 
@@ -852,6 +876,10 @@ def main(argv=None):
     parser = argparse.ArgumentParser(prog="weed", description=__doc__)
     parser.add_argument("-v", type=int, default=0,
                         help="glog verbosity level")
+    parser.add_argument("-cpuprofile", default="",
+                        help="dump a cProfile trace here on shutdown")
+    parser.add_argument("-memprofile", default="",
+                        help="dump a heap snapshot here on shutdown")
     sub = parser.add_subparsers(dest="command", required=True)
 
     p = sub.add_parser("master", help="start a master server")
@@ -890,6 +918,8 @@ def main(argv=None):
 
     p = sub.add_parser("filer", help="start a filer server")
     p.add_argument("-master", default="127.0.0.1:9333")
+    p.add_argument("-metricsPort", type=int, default=0,
+                   help="serve /metrics on a dedicated port")
     p.add_argument("-ip", default="127.0.0.1")
     p.add_argument("-port", type=int, default=8888)
     p.add_argument("-maxMB", type=int, default=4)
@@ -905,6 +935,8 @@ def main(argv=None):
     p.set_defaults(fn=cmd_filer)
 
     p = sub.add_parser("s3", help="start an s3 gateway (+embedded filer)")
+    p.add_argument("-metricsPort", type=int, default=0,
+                   help="serve /metrics on a dedicated port")
     p.add_argument("-master", default="127.0.0.1:9333")
     p.add_argument("-ip", default="127.0.0.1")
     p.add_argument("-port", type=int, default=8333)
@@ -932,6 +964,9 @@ def main(argv=None):
     p.add_argument("-pulseSeconds", type=float, default=5.0)
     p.add_argument("-filer", action="store_true")
     p.add_argument("-s3", action="store_true")
+    p.add_argument("-iam", action="store_true",
+                   help="also start the IAM management API")
+    p.add_argument("-iamPort", type=int, default=8111)
     p.add_argument("-db", default="")
     p.add_argument("-store", default="sqlite",
                    help="filer store kind: sqlite | sharded | perbucket")
@@ -1095,6 +1130,10 @@ def main(argv=None):
         from seaweedfs_tpu.util import glog
 
         glog.set_verbosity(args.v)
+    if args.cpuprofile or args.memprofile:
+        from seaweedfs_tpu.util import grace
+
+        grace.setup_profiling(args.cpuprofile, args.memprofile)
     try:
         args.fn(args)
     except BrokenPipeError:  # e.g. `weed filer.meta.tail | head`
