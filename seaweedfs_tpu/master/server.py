@@ -38,7 +38,8 @@ class MasterServer:
                  guard: Optional[Guard] = None,
                  peers: Optional[list[str]] = None,
                  raft_dir: str = "",
-                 raft_election_timeout: float = 0.8):
+                 raft_election_timeout: float = 0.8,
+                 auto_vacuum_interval: float = 15 * 60.0):
         self.topo = Topology(
             volume_size_limit=volume_size_limit_mb * 1024 * 1024,
             pulse_seconds=pulse_seconds)
@@ -68,6 +69,7 @@ class MasterServer:
         self._reaper: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._grow_lock = threading.Lock()
+        self.auto_vacuum_interval = auto_vacuum_interval
 
     @property
     def address(self) -> str:
@@ -88,8 +90,18 @@ class MasterServer:
         self.server.stop()
 
     def _reap_loop(self):
+        # periodic garbage vacuum rides the same loop (topology_vacuum.go:
+        # the reference leader vacuums on a 15-minute cadence)
+        next_vacuum = time.monotonic() + self.auto_vacuum_interval
         while not self._stop.wait(self.topo.pulse_seconds):
             self.topo.reap_dead_nodes()
+            if self.auto_vacuum_interval > 0 and self.raft.is_leader \
+                    and time.monotonic() >= next_vacuum:
+                next_vacuum = time.monotonic() + self.auto_vacuum_interval
+                try:
+                    self._vacuum_pass(self.garbage_threshold)
+                except Exception:
+                    pass  # individual node errors already skipped inside
 
     # -- routes --------------------------------------------------------------
     def _guarded(self, fn):
@@ -470,6 +482,9 @@ class MasterServer:
     def _handle_vacuum(self, req):
         threshold = float(req.param("garbageThreshold",
                                     str(self.garbage_threshold)))
+        return {"vacuumed": self._vacuum_pass(threshold)}
+
+    def _vacuum_pass(self, threshold: float) -> list[dict]:
         vacuumed = []
         with self.topo.lock:
             nodes = list(self.topo.nodes.values())
