@@ -502,4 +502,4 @@ class MasterServer:
                     vacuumed.append({"node": node.url, "volume": vid})
                 except RpcError:
                     continue
-        return {"vacuumed": vacuumed}
+        return vacuumed

@@ -15,7 +15,7 @@ import pytest
 from seaweedfs_tpu.master.server import MasterServer
 from seaweedfs_tpu.rpc.http_rpc import RpcError, call
 from seaweedfs_tpu.storage.tools import (compact_offline, export_volume,
-                                         rebuild_index, scan_dat)
+                                         rebuild_index)
 from seaweedfs_tpu.volume_server.server import VolumeServer
 from seaweedfs_tpu.wdclient.resource_pool import (PoolClosedError,
                                                   ResourcePool)
@@ -166,19 +166,19 @@ class TestOfflineTools:
     def test_export_lists_live_and_tars(self, offline_volume, tmp_path):
         vol_dir, vids = offline_volume
         total_live = 0
-        out_tar = str(tmp_path / "dump.tar")
+        all_members = []
         for vid in vids:
+            out_tar = str(tmp_path / f"dump-{vid}.tar")
             records = export_volume(vol_dir, "", vid,
                                     output_tar=out_tar)
             total_live += len(records)
+            with tarfile.open(out_tar) as tar:
+                for name in tar.getnames():
+                    all_members.append(tar.extractfile(name).read())
         # one of the six was deleted
-        assert total_live == sum(
-            1 for _ in scan_dat(os.path.join(
-                vol_dir, f"{vids[-1]}.dat"))) or total_live >= 1
-        with tarfile.open(out_tar) as tar:
-            names = tar.getnames()
-            member = tar.extractfile(names[0]).read()
-            assert member.startswith(b"needle-")
+        assert total_live == 5
+        assert len(all_members) == total_live
+        assert all(m.startswith(b"needle-") for m in all_members)
 
     def test_compact_offline_reclaims(self, offline_volume):
         vol_dir, vids = offline_volume
