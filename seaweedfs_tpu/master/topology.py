@@ -32,6 +32,7 @@ class VolumeInfo:
     replica_placement: int = 0
     ttl: int = 0
     compact_revision: int = 0
+    modified_at_second: int = 0
 
     @classmethod
     def from_dict(cls, d: dict) -> "VolumeInfo":
@@ -76,7 +77,8 @@ class DataNode:
                  "delete_count": v.delete_count,
                  "deleted_bytes": v.deleted_byte_count,
                  "read_only": v.read_only,
-                 "replication": v.replica_placement, "ttl": v.ttl}
+                 "replication": v.replica_placement, "ttl": v.ttl,
+                 "modified_at": v.modified_at_second}
                 for v in self.volumes.values()
             ],
         }
@@ -376,6 +378,7 @@ class Topology:
         with self.lock:
             return {
                 "max_volume_id": self.max_volume_id,
+                "volume_size_limit": self.volume_size_limit,
                 "datacenters": [
                     {
                         "id": dc.id,

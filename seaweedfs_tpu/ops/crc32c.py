@@ -84,3 +84,101 @@ def value(crc: int) -> int:
     crc &= 0xFFFFFFFF
     rotated = ((crc >> 15) | (crc << 17)) & 0xFFFFFFFF
     return (rotated + 0xA282EAD8) & 0xFFFFFFFF
+
+
+# ---------------------------------------------------------------------------
+# GF(2) linear-algebra view of CRC32C: combine / zeros / advance matrices.
+#
+# The CRC state update s' = (s >> 8) ^ T[(s ^ byte) & 0xFF] is jointly linear
+# over GF(2) in (state, byte), so "advance the state over n zero bytes" is a
+# 32x32 bit matrix Adv_n = A1^n.  These power the device-fused CRC kernel
+# (ops/crc_device.py) and crc32c_combine (zlib crc32_combine semantics).
+# ---------------------------------------------------------------------------
+
+import functools
+
+
+def _table0() -> np.ndarray:
+    global _TABLES
+    if _TABLES is None:
+        _TABLES = _make_tables()
+    return _TABLES[0]
+
+
+def raw_update(state: int, data: bytes) -> int:
+    """CRC state machine with NO init/final inversion (the linear core)."""
+    t0 = _table0()
+    state &= 0xFFFFFFFF
+    for b in data:
+        state = int(t0[(state ^ b) & 0xFF]) ^ (state >> 8)
+    return state
+
+
+_BIT32 = np.arange(32, dtype=np.uint64)
+
+
+def _bits_of(x: int) -> np.ndarray:
+    return ((np.uint64(x) >> _BIT32) & np.uint64(1)).astype(np.uint8)
+
+
+def _pack_bits(bits: np.ndarray) -> int:
+    return int((bits.astype(np.uint64) << _BIT32).sum() & np.uint64(0xFFFFFFFF))
+
+
+def _gf2_matmul(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    return (a.astype(np.int64) @ b.astype(np.int64) % 2).astype(np.uint8)
+
+
+@functools.lru_cache(maxsize=1)
+def _advance_one() -> np.ndarray:
+    """A1[:, i] = bits of raw_update(1 << i, b"\\x00") — one-zero-byte step."""
+    cols = [_bits_of(raw_update(1 << i, b"\x00")) for i in range(32)]
+    return np.stack(cols, axis=1)
+
+
+@functools.lru_cache(maxsize=128)
+def _advance_pow2(k: int) -> np.ndarray:
+    """A1^(2^k) via repeated squaring."""
+    if k == 0:
+        return _advance_one()
+    m = _advance_pow2(k - 1)
+    return _gf2_matmul(m, m)
+
+
+@functools.lru_cache(maxsize=4096)
+def advance_matrix(n: int) -> np.ndarray:
+    """Adv_n: 32x32 GF(2) matrix advancing the raw CRC state over n zero
+    bytes.  raw_update(s, 0^n) == Adv_n @ bits(s)."""
+    m = np.eye(32, dtype=np.uint8)
+    k = 0
+    while n:
+        if n & 1:
+            m = _gf2_matmul(_advance_pow2(k), m)
+        n >>= 1
+        k += 1
+    return m
+
+
+def advance(state: int, n: int) -> int:
+    """raw_update(state, b"\\x00" * n) without touching the data bytes."""
+    return _pack_bits(_gf2_matmul(advance_matrix(n), _bits_of(state)[:, None])
+                      .reshape(-1))
+
+
+@functools.lru_cache(maxsize=4096)
+def crc32c_zeros(n: int) -> int:
+    """crc32c of n zero bytes (standard init/final inversion applied)."""
+    return advance(0xFFFFFFFF, n) ^ 0xFFFFFFFF
+
+
+def crc32c_combine(crc_a: int, crc_b: int, len_b: int) -> int:
+    """CRC32C of A||B from crc32c(A), crc32c(B), len(B) — zlib
+    crc32_combine: the init/final inversions cancel, leaving
+    Adv_{len_b}(crc_a) ^ crc_b."""
+    return advance(crc_a, len_b) ^ (crc_b & 0xFFFFFFFF)
+
+
+def finalize_raw(raw: int, length: int) -> int:
+    """Standard crc32c of an n-byte chunk from its raw linear image
+    g(M) = raw_update(0, M): crc32c(M) = g(M) ^ crc32c(0^n)."""
+    return (raw & 0xFFFFFFFF) ^ crc32c_zeros(length)

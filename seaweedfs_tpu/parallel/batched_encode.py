@@ -1,0 +1,298 @@
+"""Streaming batched EC encode: .dat files -> 14 shard files through the
+sharded TPU encoder, with pipelined host I/O.
+
+This is the production encode path (BASELINE configs 1 + 4).  The reference
+encodes one volume at a time, feeding its CPU codec 256 KB-per-shard slices
+inside a synchronous loop (/root/reference/weed/storage/erasure_coding/
+ec_encoder.go:194-231).  Here the striped rows of MANY volumes are tiled
+into (B, 10, L) uint8 batches and pushed through one jit-compiled
+parity+CRC step (parallel/mesh.py) with a three-stage pipeline:
+
+  reader thread   — fills pinned host buffers from the .dat files and
+                    appends the data-shard bytes to .ec00-.ec09 (data
+                    shards are a pure re-interleaving of the .dat, no
+                    compute needed);
+  main thread     — device_put(batch N+1) and dispatches its encode while
+                    batch N's parity is still materializing (double
+                    buffering: transfers overlap compute via async
+                    dispatch); finalizes fused CRCs and chains them into
+                    per-shard-file rolling CRC32Cs;
+  writer thread   — appends parity bytes to .ec10-.ec13.
+
+Every shard chunk's CRC32C is computed on device, fused with the parity
+matmul (BASELINE config 5); whole-shard-file CRCs are returned and persisted
+in the .vif sidecar for scrub tooling.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import queue
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+DATA_SHARDS = 10
+PARITY_SHARDS = 4
+TOTAL_SHARDS = 14
+
+# per-dispatch target: B * 10 * L bytes of data-shard input
+TARGET_BATCH_BYTES = 64 << 20
+MAX_CHUNK_BYTES = 1 << 20
+_SLOTS = 4   # host staging buffers in flight
+_INFLIGHT = 3  # device dispatches queued before draining (hides dispatch
+               # latency — significant over the axon TPU relay)
+
+
+@dataclass
+class _Unit:
+    """One (volume, row, column-chunk): a (10, L) slice of work."""
+    vol: int
+    row_start: int     # byte offset of the row in the .dat
+    shard_off: int     # byte offset of this chunk in each shard file
+    col: int           # column offset within the row's blocks
+    block_size: int
+
+
+@dataclass
+class _VolumePlan:
+    base: str
+    dat_size: int
+    rows: list[tuple[int, int, int]] = field(default_factory=list)
+    # (row_start_in_dat, shard_offset, block_size)
+
+
+def _plan_volume(base: str, large_block: int, small_block: int) -> _VolumePlan:
+    """Row plan mirroring WriteEcFiles striping (ec_encoder.go:57-59):
+    large rows while > 10 large blocks remain, then small rows, zero-padded."""
+    dat_size = os.path.getsize(base + ".dat")
+    plan = _VolumePlan(base, dat_size)
+    remaining = dat_size
+    row_start = 0
+    shard_off = 0
+    while remaining > large_block * DATA_SHARDS:
+        plan.rows.append((row_start, shard_off, large_block))
+        row_start += large_block * DATA_SHARDS
+        shard_off += large_block
+        remaining -= large_block * DATA_SHARDS
+    while remaining > 0:
+        plan.rows.append((row_start, shard_off, small_block))
+        row_start += small_block * DATA_SHARDS
+        shard_off += small_block
+        remaining -= small_block * DATA_SHARDS
+    return plan
+
+
+def _chunk_len(large_block: int, small_block: int) -> int:
+    """Static column-chunk width L: divides every block size in the plan."""
+    cand = min(small_block, MAX_CHUNK_BYTES)
+    if large_block % cand == 0 and small_block % cand == 0:
+        return cand
+    return math.gcd(large_block, small_block)
+
+
+def _make_units(plans: list[_VolumePlan], chunk: int) -> list[_Unit]:
+    units = []
+    for vi, plan in enumerate(plans):
+        for row_start, shard_off, block in plan.rows:
+            for col in range(0, block, chunk):
+                units.append(_Unit(vi, row_start, shard_off + col, col, block))
+    return units
+
+
+def _read_unit(dat, dat_size: int, u: _Unit, chunk: int, out: np.ndarray):
+    """Fill out (10, chunk) with the unit's data-shard bytes, zero-padding
+    past EOF (the tail row's zero padding is part of the format)."""
+    for i in range(DATA_SHARDS):
+        start = u.row_start + i * u.block_size + u.col
+        view = memoryview(out[i]).cast("B")
+        if start >= dat_size:
+            out[i].fill(0)
+            continue
+        dat.seek(start)
+        got = dat.readinto(view)
+        if got < chunk:
+            out[i, got:].fill(0)
+
+
+class _ShardWriters:
+    """Open .ec00-.ec13 for one volume; tracks rolling per-file CRC32C."""
+
+    def __init__(self, base: str, to_ext):
+        self.files = [open(base + to_ext(i), "wb")
+                      for i in range(TOTAL_SHARDS)]
+        self.crcs = [0] * TOTAL_SHARDS
+
+    def close(self):
+        for f in self.files:
+            f.close()
+
+
+def encode_volumes(bases: list[str], large_block: Optional[int] = None,
+                   small_block: Optional[int] = None,
+                   mesh=None, batch_units: Optional[int] = None
+                   ) -> dict[str, list[int]]:
+    """Encode every `base` (.dat) into 14 shard files via the sharded TPU
+    pipeline.  Returns {base: [crc32c of each shard file] * 14}.
+
+    Volumes are batched together: chunks from different volumes ride the
+    same device dispatch, which is what makes the 100-volume HBM-resident
+    configuration (BASELINE config 4) one pipeline rather than 100 encodes.
+    """
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from ..ops import crc32c as crc_host
+    from ..ops.crc_device import finalize
+    from ..storage.erasure_coding import (LARGE_BLOCK_SIZE, SMALL_BLOCK_SIZE,
+                                          to_ext)
+    from .mesh import make_mesh, make_sharded_encoder
+
+    large_block = large_block or LARGE_BLOCK_SIZE
+    small_block = small_block or SMALL_BLOCK_SIZE
+    plans = [_plan_volume(b, large_block, small_block) for b in bases]
+    chunk = _chunk_len(large_block, small_block)
+    units = _make_units(plans, chunk)
+
+    writers = {vi: _ShardWriters(p.base, to_ext)
+               for vi, p in enumerate(plans)}
+    if not units:
+        out = {}
+        for vi, p in enumerate(plans):
+            writers[vi].close()
+            out[p.base] = [0] * TOTAL_SHARDS
+        return out
+
+    if mesh is None:
+        mesh = make_mesh()
+    n_data, n_block = mesh.devices.shape
+    if chunk % n_block:
+        mesh = Mesh(mesh.devices.reshape(-1, 1), mesh.axis_names)
+        n_data, n_block = mesh.devices.shape
+
+    if batch_units is None:
+        batch_units = max(1, TARGET_BATCH_BYTES // (DATA_SHARDS * chunk))
+    b = min(batch_units, len(units))
+    b = max(n_data, ((b + n_data - 1) // n_data) * n_data)
+
+    step = make_sharded_encoder(mesh)
+    sharding = NamedSharding(mesh, P("data", None, "block"))
+
+    n_batches = (len(units) + b - 1) // b
+    dats = [open(p.base + ".dat", "rb") for p in plans]
+
+    free_slots: "queue.Queue[np.ndarray]" = queue.Queue()
+    for _ in range(_SLOTS):
+        free_slots.put(np.zeros((b, DATA_SHARDS, chunk), dtype=np.uint8))
+    ready: "queue.Queue" = queue.Queue(maxsize=_SLOTS)
+    parity_q: "queue.Queue" = queue.Queue(maxsize=_SLOTS)
+    errors: list[BaseException] = []
+    stop = threading.Event()
+
+    def _put(q, item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.5)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _get(q):
+        while not stop.is_set():
+            try:
+                return q.get(timeout=0.5)
+            except queue.Empty:
+                continue
+        return None
+
+    def reader():
+        try:
+            for n in range(n_batches):
+                batch = units[n * b:(n + 1) * b]
+                buf = _get(free_slots)
+                if buf is None:
+                    return
+                for k, u in enumerate(batch):
+                    _read_unit(dats[u.vol], plans[u.vol].dat_size, u,
+                               chunk, buf[k])
+                    w = writers[u.vol]
+                    for i in range(DATA_SHARDS):
+                        w.files[i].seek(u.shard_off)
+                        w.files[i].write(buf[k, i])
+                if not _put(ready, (buf, batch)):
+                    return
+            _put(ready, None)
+        except BaseException as e:  # propagate to the main thread
+            errors.append(e)
+            stop.set()
+
+    def writer():
+        try:
+            while True:
+                item = _get(parity_q)
+                if item is None:
+                    return
+                parity, batch = item
+                for k, u in enumerate(batch):
+                    w = writers[u.vol]
+                    for i in range(PARITY_SHARDS):
+                        f = w.files[DATA_SHARDS + i]
+                        f.seek(u.shard_off)
+                        f.write(parity[k, i])
+        except BaseException as e:
+            errors.append(e)
+            stop.set()
+
+    rt = threading.Thread(target=reader, daemon=True)
+    wt = threading.Thread(target=writer, daemon=True)
+    rt.start()
+    wt.start()
+
+    inflight: list = []  # (buf, batch, parity_dev, crc_dev)
+
+    def drain_one():
+        buf, batch, parity_dev, crc_dev = inflight.pop(0)
+        # blocks until compute done; sharded gathers can come back
+        # non-contiguous, and file writes need a contiguous buffer
+        parity = np.ascontiguousarray(np.asarray(parity_dev))
+        crcs = finalize(crc_dev, chunk)
+        free_slots.put(buf)  # device consumed the input transfer
+        for k, u in enumerate(batch):
+            w = writers[u.vol]
+            for s in range(TOTAL_SHARDS):
+                w.crcs[s] = crc_host.crc32c_combine(
+                    w.crcs[s], int(crcs[k, s]), chunk)
+        _put(parity_q, (parity, batch))
+
+    try:
+        while not stop.is_set():
+            item = _get(ready)
+            if item is None:
+                break
+            buf, batch = item
+            dev = jax.device_put(buf, sharding)
+            parity_dev, crc_dev = step(dev)
+            inflight.append((buf, batch, parity_dev, crc_dev))
+            if len(inflight) >= _INFLIGHT:
+                drain_one()
+        while inflight and not stop.is_set():
+            drain_one()
+    except BaseException:
+        stop.set()
+        raise
+    finally:
+        _put(parity_q, None)
+        wt.join(timeout=60)
+        stop.set()
+        rt.join(timeout=30)
+        for f in dats:
+            f.close()
+        for w in writers.values():
+            w.close()
+    if errors:
+        raise errors[0]
+    return {p.base: writers[vi].crcs for vi, p in enumerate(plans)}

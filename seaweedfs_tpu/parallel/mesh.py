@@ -10,8 +10,9 @@ parallel axes of RS coding:
     columnwise, so the L axis shards cleanly; the sequence-parallel
     analogue per SURVEY.md §5.7)
 
-Parity needs no cross-device communication; verification checksums reduce
-over the sharded block axis, so XLA inserts the all-reduce over ICI.
+Parity needs no cross-device communication; the fused CRC32C integrity
+pass reduces over the sharded block axis, so XLA inserts the collective
+over ICI.
 """
 
 from __future__ import annotations
@@ -61,35 +62,23 @@ def _parity_bits_matmul(bit_matrix, data):
     return parity.transpose(1, 0, 2)
 
 
-def xor_fold(x, axis: int = -1):
-    """XOR-reduce along an axis by iterative halving — portable elementwise
-    XORs only (XLA CPU lacks custom-XOR lax.reduce lowering)."""
-    axis = axis % x.ndim
-    x = jnp.moveaxis(x, axis, -1)
-    length = x.shape[-1]
-    while length > 1:
-        half = length // 2
-        folded = x[..., :half] ^ x[..., half:2 * half]
-        if length % 2:
-            folded = folded.at[..., 0].set(folded[..., 0] ^ x[..., -1])
-        x = folded
-        length = half
-    return x[..., 0]
-
-
 def batched_encode_step(bit_matrix, data):
-    """The flagship jittable step: batched parity + per-shard XOR checksums.
+    """The flagship jittable step: batched parity + fused per-shard CRC32C.
 
     data: (B, 10, L) uint8 — B independent volume rows.
-    Returns (parity (B, 4, L), checksums (B, 14)): checksums are XOR-folds
-    of every shard (data + parity), the device-side integrity summary the
-    batched encode path uses for cheap cross-checks.  The fold runs over
-    the (possibly sharded) L axis, so XLA inserts the ICI all-reduce.
+    Returns (parity (B, 4, L), crc_raw (B, 14) uint32): crc_raw are the raw
+    GF(2)-linear CRC32C images of every shard chunk (10 data + 4 parity),
+    computed on device by the bit-matmul kernel in ops/crc_device.py while
+    the batch is HBM-resident (BASELINE config 5 — the reference CRCs on
+    CPU at write time only, needle/crc.go:12-33).  Host side finalizes with
+    crc32c.finalize_raw(raw, L) and chains chunks with crc32c_combine.
     """
+    from ..ops.crc_device import batched_crc32c_raw
+
     parity = _parity_bits_matmul(bit_matrix, data)
     full = jnp.concatenate([data, parity], axis=1)  # (B, 14, L)
-    checksums = xor_fold(full, axis=2)
-    return parity, checksums
+    crc_raw = batched_crc32c_raw(full)
+    return parity, crc_raw
 
 
 _ENCODER_CACHE: dict = {}
@@ -111,7 +100,7 @@ def make_sharded_encoder(mesh: Mesh, data_shards: int = 10,
     data_sharding = NamedSharding(mesh, P("data", None, "block"))
     out_shardings = (
         NamedSharding(mesh, P("data", None, "block")),  # parity
-        NamedSharding(mesh, P("data", None)),  # checksums
+        NamedSharding(mesh, P("data", None)),  # crc_raw
     )
 
     @functools.partial(
@@ -128,12 +117,18 @@ def make_sharded_encoder(mesh: Mesh, data_shards: int = 10,
 
 
 def encode_batch(data: np.ndarray, mesh: Mesh | None = None):
-    """Host convenience: shard a (B, 10, L) batch over the mesh and encode."""
+    """Host convenience: shard a (B, 10, L) batch over the mesh and encode.
+
+    Returns (parity (B, 4, L), crcs (B, 14) uint32) with the device CRC32C
+    values finalized to standard form (crc32c of each shard chunk).
+    """
+    from ..ops.crc_device import finalize
+
     if mesh is None:
         mesh = make_mesh()
     step = make_sharded_encoder(mesh)
     sharding = NamedSharding(mesh, P("data", None, "block"))
     device_data = jax.device_put(jnp.asarray(data, dtype=jnp.uint8),
                                  sharding)
-    parity, checksums = step(device_data)
-    return np.asarray(parity), np.asarray(checksums)
+    parity, crc_raw = step(device_data)
+    return np.asarray(parity), finalize(crc_raw, data.shape[-1])

@@ -32,11 +32,16 @@ class CommandEnv:
 class EcNode:
     url: str
     free_slots: int
+    dc: str = ""
+    rack: str = ""
     shards: dict[int, list[int]] = field(default_factory=dict)  # vid -> ids
     collections: dict[int, str] = field(default_factory=dict)  # vid -> name
 
     def shard_count(self) -> int:
         return sum(len(s) for s in self.shards.values())
+
+    def rack_key(self) -> tuple[str, str]:
+        return (self.dc, self.rack)
 
 
 def collect_ec_nodes(env: CommandEnv) -> list[EcNode]:
@@ -46,7 +51,9 @@ def collect_ec_nodes(env: CommandEnv) -> list[EcNode]:
     for dc in topo.get("datacenters", []):
         for rack in dc.get("racks", []):
             for n in rack.get("nodes", []):
-                nodes.append(EcNode(url=n["url"], free_slots=n["free"]))
+                nodes.append(EcNode(url=n["url"], free_slots=n["free"],
+                                    dc=n.get("dc", dc["id"]),
+                                    rack=n.get("rack", rack["id"])))
     # fill current shard placements
     for vid in topo.get("ec_volumes", []):
         try:
@@ -67,34 +74,108 @@ def collect_ec_nodes(env: CommandEnv) -> list[EcNode]:
 def balanced_ec_distribution(nodes: list[EcNode],
                              shard_count: int = TOTAL_SHARDS_COUNT
                              ) -> dict[str, list[int]]:
-    """Round-robin one shard at a time over servers with free EC slots,
-    starting at a random server (balancedEcDistribution,
-    command_ec_encode.go:253-269).  Slot budget = free volume slots in
-    shard units."""
+    """Rack-first shard spread: racks are filled round-robin (so a rack
+    failure loses at most ceil(shards/racks) <= 4 of 14 shards whenever
+    more than three racks exist), and within a rack shards round-robin
+    over the nodes with free EC slots.  Combines balancedEcDistribution
+    (command_ec_encode.go:253-269) with the rack-spreading objective of
+    ec.balance (command_ec_balance.go:27-100) at placement time instead
+    of fixing rack clustering after the fact.  Slot budget = free volume
+    slots in shard units."""
     import random
 
     if not nodes:
         raise ValueError("no ec nodes available")
     allocation: dict[str, list[int]] = {n.url: [] for n in nodes}
     free = {n.url: n.free_slots * TOTAL_SHARDS_COUNT for n in nodes}
+
+    racks: dict[tuple[str, str], list[EcNode]] = {}
+    for n in nodes:
+        racks.setdefault(n.rack_key(), []).append(n)
+    rack_keys = list(racks.keys())
+    random.shuffle(rack_keys)
+    rack_node_index = {rk: random.randrange(len(racks[rk]))
+                       for rk in rack_keys}
+
+    def rack_has_free(rk) -> bool:
+        return any(free[n.url] - len(allocation[n.url]) > 0
+                   for n in racks[rk])
+
     shard_id = 0
-    index = random.randrange(len(nodes))
+    rack_index = 0
     spins = 0
     while shard_id < shard_count:
-        node = nodes[index]
-        if free[node.url] - len(allocation[node.url]) > 0:
-            allocation[node.url].append(shard_id)
-            shard_id += 1
-            spins = 0
-        else:
+        rk = rack_keys[rack_index % len(rack_keys)]
+        rack_index += 1
+        if not rack_has_free(rk):
             spins += 1
-            if spins > len(nodes):
+            if spins > len(rack_keys):
                 raise ValueError("not enough free ec slots")
-        index = (index + 1) % len(nodes)
+            continue
+        spins = 0
+        # round-robin inside the rack, skipping slotless nodes
+        rnodes = racks[rk]
+        for _ in range(len(rnodes)):
+            node = rnodes[rack_node_index[rk] % len(rnodes)]
+            rack_node_index[rk] += 1
+            if free[node.url] - len(allocation[node.url]) > 0:
+                allocation[node.url].append(shard_id)
+                shard_id += 1
+                break
     return {url: ids for url, ids in allocation.items() if ids}
 
 
 # -- ec.encode ---------------------------------------------------------------
+
+
+def collect_volume_ids_for_ec_encode(env: CommandEnv, collection: str = "",
+                                     full_percent: float = 95.0,
+                                     quiet_seconds: float = 3600.0,
+                                     now: Optional[float] = None
+                                     ) -> list[int]:
+    """Auto-EC candidate selection (collectVolumeIdsForEcEncode,
+    command_ec_encode.go:271-302): volumes at least full_percent% of the
+    master's volume size limit AND unmodified for quiet_seconds.  The
+    reference keys on fullness + quiescence only; readonly volumes stay
+    eligible (they encode fine)."""
+    import time as _time
+
+    topo = env.master("/dir/status")
+    size_limit = topo.get("volume_size_limit", 0)
+    if not size_limit:
+        return []
+    threshold = size_limit * full_percent / 100.0
+    now = _time.time() if now is None else now
+    vids: set[int] = set()
+    for dc in topo.get("datacenters", []):
+        for rack in dc.get("racks", []):
+            for n in rack.get("nodes", []):
+                for v in n.get("volume_list", []):
+                    # exact-match selection, reference semantics
+                    # (command_ec_encode.go:288): "" selects only the
+                    # default (unnamed) collection, never a wildcard
+                    if v.get("collection", "") != collection:
+                        continue
+                    if v.get("size", 0) < threshold:
+                        continue
+                    modified = v.get("modified_at", 0)
+                    if modified and now - modified < quiet_seconds:
+                        continue
+                    vids.add(v["id"])
+    return sorted(vids)
+
+
+def ec_encode_auto(env: CommandEnv, collection: str = "",
+                   full_percent: float = 95.0,
+                   quiet_seconds: float = 3600.0,
+                   plan_only: bool = False,
+                   now: Optional[float] = None) -> list[dict]:
+    """ec.encode -fullPercent=X -quietFor=Y: select full+quiet volumes
+    from the topology and encode each (command_ec_encode.go:57-93)."""
+    vids = collect_volume_ids_for_ec_encode(
+        env, collection, full_percent, quiet_seconds, now=now)
+    return [ec_encode(env, vid, collection, plan_only=plan_only)
+            for vid in vids]
 
 
 def ec_encode(env: CommandEnv, vid: int, collection: str = "",
@@ -242,33 +323,107 @@ def ec_rebuild(env: CommandEnv, vid: int, collection: str = "",
 # -- ec.balance --------------------------------------------------------------
 
 
+def _move_shard(moves: list[dict], source: EcNode, target: EcNode,
+                vid: int, sid: int):
+    source.shards[vid].remove(sid)
+    if not source.shards[vid]:
+        del source.shards[vid]
+    target.shards.setdefault(vid, []).append(sid)
+    target.collections.setdefault(vid, source.collections.get(vid, ""))
+    moves.append({"volume": vid, "shard": sid,
+                  "collection": source.collections.get(vid, ""),
+                  "from": source.url, "to": target.url})
+
+
+def _shard_slot_budget(nodes: list[EcNode]) -> dict[str, int]:
+    """Free EC capacity per node in shard units (free volume slots x 14)."""
+    return {n.url: n.free_slots * TOTAL_SHARDS_COUNT for n in nodes}
+
+
+def _balance_racks(nodes: list[EcNode], moves: list[dict],
+                   budget: dict[str, int]):
+    """Phase 1 (doBalanceEcShardsAcrossRacks, command_ec_balance.go:27-63):
+    per volume, no rack may hold more than ceil(shards/racks) shards —
+    a rack failure must never take out more than one parity group's worth.
+    Every pick is gated on remaining shard-slot budget (the reference's
+    freeEcSlot > 0 gate in pickRackToBalanceShardsInto)."""
+    racks: dict[tuple, list[EcNode]] = {}
+    for n in nodes:
+        racks.setdefault(n.rack_key(), []).append(n)
+    if len(racks) <= 1:
+        return
+    vids = sorted({vid for n in nodes for vid in n.shards})
+    for vid in vids:
+        shards_per_rack = {
+            rk: [(n, sid) for n in rnodes for sid in n.shards.get(vid, [])]
+            for rk, rnodes in racks.items()}
+        total = sum(len(v) for v in shards_per_rack.values())
+        cap = -(-total // len(racks))  # ceil
+        for rk, holders in sorted(shards_per_rack.items(),
+                                  key=lambda kv: -len(kv[1])):
+            while len(holders) > cap:
+                node, sid = holders.pop()
+                # a node may hold several distinct shard ids of one volume
+                # (only the rack cap is a hard constraint); never duplicate
+                # the same shard id on a node, never overfill a node
+                candidates = [
+                    (rk2, n2) for rk2, rnodes2 in racks.items()
+                    if len(shards_per_rack[rk2]) < cap
+                    for n2 in rnodes2
+                    if budget[n2.url] > 0
+                    and sid not in n2.shards.get(vid, [])]
+                if not candidates:
+                    break
+                rk2, target = min(
+                    candidates,
+                    key=lambda c: (len(shards_per_rack[c[0]]),
+                                   -budget[c[1].url]))
+                _move_shard(moves, node, target, vid, sid)
+                budget[target.url] -= 1
+                budget[node.url] += 1
+                shards_per_rack[rk2].append((target, sid))
+
+
+def _balance_nodes(nodes: list[EcNode], moves: list[dict],
+                   budget: dict[str, int]):
+    """Phase 2 (doBalanceEcShardsWithinRacks + AcrossRacks node step):
+    within each rack, even shard counts over nodes, never co-locating a
+    volume's shards on one node, never overfilling a node."""
+    racks: dict[tuple, list[EcNode]] = {}
+    for n in nodes:
+        racks.setdefault(n.rack_key(), []).append(n)
+    for rnodes in racks.values():
+        total = sum(n.shard_count() for n in rnodes)
+        average = -(-total // len(rnodes))  # ceil
+        overfull = [n for n in rnodes if n.shard_count() > average]
+        for node in overfull:
+            while node.shard_count() > average:
+                vid, ids = max(node.shards.items(),
+                               key=lambda kv: len(kv[1]))
+                candidates = [n for n in rnodes if n is not node
+                              and n.shard_count() < average
+                              and budget[n.url] > 0
+                              and vid not in n.shards]
+                if not candidates:
+                    break
+                target = max(candidates, key=lambda n: budget[n.url])
+                _move_shard(moves, node, target, vid, ids[-1])
+                budget[target.url] -= 1
+                budget[node.url] += 1
+
+
 def ec_balance(env: CommandEnv, plan_only: bool = False) -> list[dict]:
-    """Even out shard counts across nodes (command_ec_balance.go):
-    move shards from above-average nodes to the roomiest below-average
-    ones, never co-locating a shard id that the target already holds."""
+    """Even out shard placement (command_ec_balance.go:27-100): first
+    spread each volume's shards across racks (no rack over
+    ceil(shards/racks)), then even node counts within each rack, never
+    co-locating a volume's shards on one node."""
     nodes = collect_ec_nodes(env)
     if not nodes:
         return []
-    moves = []
-    total = sum(n.shard_count() for n in nodes)
-    average = -(-total // len(nodes))  # ceil
-    overfull = [n for n in nodes if n.shard_count() > average]
-    for node in overfull:
-        while node.shard_count() > average:
-            vid, ids = max(node.shards.items(), key=lambda kv: len(kv[1]))
-            candidates = [n for n in nodes if n is not node
-                          and n.shard_count() < average
-                          and vid not in n.shards]
-            if not candidates:
-                break
-            target = max(candidates, key=lambda n: n.free_slots)
-            sid = ids.pop()
-            if not ids:
-                del node.shards[vid]
-            target.shards.setdefault(vid, []).append(sid)
-            moves.append({"volume": vid, "shard": sid,
-                          "collection": node.collections.get(vid, ""),
-                          "from": node.url, "to": target.url})
+    moves: list[dict] = []
+    budget = _shard_slot_budget(nodes)
+    _balance_racks(nodes, moves, budget)
+    _balance_nodes(nodes, moves, budget)
     if plan_only:
         return moves
     for move in moves:

@@ -52,6 +52,40 @@ def _find_volume(nodes: list[VolumeServerNode],
     return [(n, v) for n in nodes for v in n.volumes if v["id"] == vid]
 
 
+def is_good_move_by_placement(rp: ReplicaPlacement,
+                              locations: list[tuple[str, str]]) -> bool:
+    """Whether a replica set laid out at `locations` ((dc, rack) per
+    replica) satisfies the replica placement — the gate the reference
+    applies to every balance/evacuate move (command_volume_balance.go
+    isGoodMoveByPlacement): the replicas must span exactly diff_dc+1
+    data centers, no DC may use more than diff_rack+1 racks, and no rack
+    may hold more than same_rack+1 replicas."""
+    dcs: dict[str, set[str]] = {}
+    rack_counts: dict[tuple[str, str], int] = {}
+    for dc, rack in locations:
+        dcs.setdefault(dc, set()).add(rack)
+        rack_counts[(dc, rack)] = rack_counts.get((dc, rack), 0) + 1
+    if len(dcs) != rp.diff_dc + 1:
+        return False
+    for racks in dcs.values():
+        if len(racks) > rp.diff_rack + 1:
+            return False
+    return all(c <= rp.same_rack + 1 for c in rack_counts.values())
+
+
+def _placement_allows_move(nodes: list[VolumeServerNode], vid: int,
+                           source: VolumeServerNode,
+                           target: VolumeServerNode) -> bool:
+    """Placement check for moving one replica of vid source->target."""
+    replicas = _find_volume(nodes, vid)
+    if not replicas:
+        return False
+    rp = ReplicaPlacement.from_byte(replicas[0][1].get("replication", 0))
+    after = [(n.dc, n.rack) for n, _ in replicas if n.url != source.url]
+    after.append((target.dc, target.rack))
+    return is_good_move_by_placement(rp, after)
+
+
 # -- basic volume ops (command_volume_{mount,unmount,move,copy,delete}.go) ---
 
 def volume_mount(env: CommandEnv, vid: int, server: str,
@@ -138,7 +172,9 @@ def volume_balance(env: CommandEnv, collection: str = "ALL",
             break
         candidates = [v for v in fullest.volumes
                       if eligible(v) and not v.get("read_only")
-                      and v["id"] not in placed[emptiest.url]]
+                      and v["id"] not in placed[emptiest.url]
+                      and _placement_allows_move(nodes, v["id"],
+                                                 fullest, emptiest)]
         if not candidates:
             break
         victim = min(candidates, key=lambda v: v["size"])
@@ -150,6 +186,7 @@ def volume_balance(env: CommandEnv, collection: str = "ALL",
         placed[emptiest.url].add(victim["id"])
         fullest.volumes = [v for v in fullest.volumes
                            if v["id"] != victim["id"]]
+        emptiest.volumes.append(victim)  # keep placement checks current
     if not plan_only:
         for m in moves:
             volume_move(env, m["volume"], m["from"], m["to"],
@@ -266,7 +303,13 @@ def volume_server_evacuate(env: CommandEnv, server: str,
     load = {n.url: len(n.volumes) for n in others}
     for v in sorted(source.volumes, key=lambda v: -v["size"]):
         candidates = [n for n in others
-                      if n.url not in holders.get(v["id"], set())]
+                      if n.url not in holders.get(v["id"], set())
+                      and _placement_allows_move(nodes, v["id"], source, n)]
+        if not candidates:
+            # placement-satisfying target preferred; fall back to any
+            # non-holder so evacuation still drains the server
+            candidates = [n for n in others
+                          if n.url not in holders.get(v["id"], set())]
         if not candidates:
             moves.append({"volume": v["id"], "from": server,
                           "to": None, "error": "no free target"})

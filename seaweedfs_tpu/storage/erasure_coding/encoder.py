@@ -23,7 +23,7 @@ import numpy as np
 
 from ...ops import codec as codec_mod
 from .. import idx as idx_mod
-from ..needle_map import NeedleMap
+from ..needle_map import load_needle_map_from_idx
 from . import (DATA_SHARDS_COUNT, LARGE_BLOCK_SIZE, PARITY_SHARDS_COUNT,
                SMALL_BLOCK_SIZE, TOTAL_SHARDS_COUNT, to_ext)
 
@@ -33,9 +33,10 @@ DEFAULT_CHUNK_BYTES = 64 * 1024 * 1024  # per-shard column chunk per dispatch
 def write_sorted_file_from_idx(base_file_name: str, ext: str = ".ecx"):
     """Generate .ecx (ascending-id sorted copy of live .idx entries) —
     WriteSortedFileFromIdx (ec_encoder.go:27-54).  Entries whose latest
-    state is a deletion are omitted (readNeedleMap drops them)."""
-    nm = NeedleMap()
-    idx_mod.walk_index_file(base_file_name + ".idx", nm._apply)
+    state is a deletion are omitted (readNeedleMap drops them).  Uses the
+    compact (numpy) map kind: its vectorised bulk loader keeps .ecx
+    generation O(n log n) array work at 100M-needle scale."""
+    nm = load_needle_map_from_idx(base_file_name + ".idx", kind="compact")
     with open(base_file_name + ext, "wb") as f:
         for nid, nv in nm.items_ascending():
             if nv.offset > 0 and nv.size >= 0:
@@ -45,8 +46,29 @@ def write_sorted_file_from_idx(base_file_name: str, ext: str = ".ecx"):
 def write_ec_files(base_file_name: str, encoder=None,
                    large_block_size: int = LARGE_BLOCK_SIZE,
                    small_block_size: int = SMALL_BLOCK_SIZE,
-                   chunk_bytes: int = DEFAULT_CHUNK_BYTES):
-    """Generate .ec00..ec13 from .dat (WriteEcFiles, ec_encoder.go:57-59)."""
+                   chunk_bytes: int = DEFAULT_CHUNK_BYTES,
+                   batched: Optional[bool] = None):
+    """Generate .ec00..ec13 from .dat (WriteEcFiles, ec_encoder.go:57-59).
+
+    Default path (no explicit codec): the streaming batched TPU pipeline
+    (parallel/batched_encode.py) — device-batched parity with fused CRC32C
+    and pipelined host I/O.  Returns the 14 shard-file CRC32Cs it computed.
+    With an explicit `encoder` (or batched=False) falls back to the
+    synchronous per-row host loop and returns None.  When the JAX backend
+    does not answer device enumeration in time (wedged TPU transport),
+    falls back to the host codec rather than hanging a daemon.
+    """
+    if batched is None:
+        from ...util.platform import jax_usable
+
+        batched = encoder is None and jax_usable()
+    if batched:
+        from ...parallel.batched_encode import encode_volumes
+
+        crcs = encode_volumes([base_file_name],
+                              large_block=large_block_size,
+                              small_block=small_block_size)
+        return crcs[base_file_name]
     if encoder is None:
         encoder = codec_mod.new_encoder(DATA_SHARDS_COUNT, PARITY_SHARDS_COUNT)
     dat_size = os.path.getsize(base_file_name + ".dat")

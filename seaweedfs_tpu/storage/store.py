@@ -132,15 +132,51 @@ class Store:
 
     # -- EC admin (volume_grpc_erasure_coding.go handlers) --------------------
     def ec_generate(self, vid: int):
-        """VolumeEcShardsGenerate: encode a local volume into shard files."""
+        """VolumeEcShardsGenerate: encode a local volume into shard files.
+
+        Default backend is the streaming batched TPU pipeline; the fused
+        per-shard-file CRC32Cs it produces are persisted in the .vif
+        sidecar for scrub tooling.
+        """
         v = self.find_volume(vid)
         if v is None:
             raise NotFoundError(f"volume {vid} not found")
         base = v.file_name()
         v.sync()
-        ec_encoder.write_ec_files(base, encoder=self.ec_encoder_backend)
+        crcs = ec_encoder.write_ec_files(base,
+                                         encoder=self.ec_encoder_backend)
         ec_encoder.write_sorted_file_from_idx(base)
-        ec_encoder.save_volume_info(base, version=v.version)
+        extra = {"shard_crc32c": crcs} if crcs else None
+        ec_encoder.save_volume_info(base, version=v.version, extra=extra)
+
+    def ec_generate_batch(self, vids: list[int]):
+        """Batched VolumeEcShardsGenerate: encode MANY local volumes in one
+        device pipeline — their row chunks share (B, 10, L) dispatches
+        (BASELINE config 4; no reference analogue, per-volume sequential at
+        ec_encoder.go:194).  Only used when no explicit CPU codec backend
+        is configured."""
+        from ..util.platform import jax_usable
+
+        if self.ec_encoder_backend is not None or not jax_usable():
+            for vid in vids:
+                self.ec_generate(vid)
+            return
+        from ..parallel.batched_encode import encode_volumes
+
+        vols = []
+        for vid in vids:
+            v = self.find_volume(vid)
+            if v is None:
+                raise NotFoundError(f"volume {vid} not found")
+            v.sync()
+            vols.append(v)
+        crc_map = encode_volumes([v.file_name() for v in vols])
+        for v in vols:
+            base = v.file_name()
+            ec_encoder.write_sorted_file_from_idx(base)
+            ec_encoder.save_volume_info(
+                base, version=v.version,
+                extra={"shard_crc32c": crc_map[base]})
 
     def ec_rebuild(self, vid: int, collection: str = "") -> list[int]:
         """VolumeEcShardsRebuild: regenerate missing local shard files."""
@@ -187,6 +223,7 @@ class Store:
                         "ttl": v.ttl.to_uint32(),
                         "compact_revision":
                             v.super_block.compaction_revision,
+                        "modified_at_second": int(v.last_modified_ts),
                     })
                 for vid, ev in loc.ec_volumes.items():
                     ec_shards.append({

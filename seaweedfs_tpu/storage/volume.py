@@ -26,7 +26,7 @@ from . import types as t
 from .backend import DiskFile
 from .needle import (CURRENT_VERSION, Needle, NeedleError, get_actual_size,
                      read_needle_header)
-from .needle_map import NeedleMap
+from .needle_map import NeedleMap, new_needle_map
 from .super_block import SUPER_BLOCK_SIZE, ReplicaPlacement, SuperBlock
 from .ttl import EMPTY_TTL, TTL
 
@@ -50,10 +50,12 @@ class CookieMismatchError(VolumeError):
 class Volume:
     def __init__(self, directory: str, collection: str, vid: int,
                  replica_placement: Optional[ReplicaPlacement] = None,
-                 ttl: TTL = EMPTY_TTL, preallocate: int = 0):
+                 ttl: TTL = EMPTY_TTL, preallocate: int = 0,
+                 needle_map_kind: str = "memory"):
         self.dir = directory
         self.collection = collection
         self.id = vid
+        self.needle_map_kind = needle_map_kind
         self.lock = threading.RLock()
         self.data: Optional[DiskFile] = None
         self.nm: Optional[NeedleMap] = None
@@ -124,7 +126,11 @@ class Volume:
         idx_path = self.file_name(".idx")
         if exists or tiered is not None:
             self.last_append_at_ns = self._check_integrity(idx_path)
-        self.nm = NeedleMap(idx_path)
+        if exists:
+            # seed quiescence tracking from the .dat mtime so -quietFor
+            # gates survive a restart (volume_loading.go:63 semantics)
+            self.last_modified_ts = int(os.path.getmtime(dat))
+        self.nm = new_needle_map(self.needle_map_kind, idx_path)
 
     def _check_integrity(self, idx_path: str) -> int:
         """Verify index<->dat consistency; truncate corrupt tails.

@@ -1,16 +1,76 @@
-"""Small platform probes shared across modules."""
+"""Small platform probes shared across modules.
+
+All probes are TIMED and run OUT OF PROCESS: a daemon must never hang (or
+leak a GIL-holding stuck thread) because the TPU transport (the axon relay
+tunnel) is wedged.  Device enumeration is attempted in a subprocess with a
+deadline; on timeout the caller falls back to host codecs (the reference's
+only mode, so behaviour degrades to reference parity, never to a hang).
+Negative answers are cached with a TTL so a wedged transport costs one
+probe per window, not one per operation.
+"""
 
 from __future__ import annotations
 
-import functools
+import subprocess
+import sys
+import threading
+import time
+
+_INIT_TIMEOUT_S = 30.0
+_NEGATIVE_TTL_S = 300.0
+_lock = threading.Lock()
+_cache: dict = {}  # {"ready": bool, "platform": str, "at": monotonic}
 
 
-@functools.lru_cache(maxsize=1)
-def on_tpu() -> bool:
-    """True when the default JAX backend is a real TPU."""
+def _parent_platforms() -> str:
+    """The platform set the parent process would use: the live jax config
+    if jax is already imported (tests pin it to cpu after import), else
+    the environment."""
+    import os
+
+    mod = sys.modules.get("jax")
+    if mod is not None:
+        try:
+            value = mod.config.jax_platforms
+            if value:
+                return value
+        except Exception:
+            pass
+    return os.environ.get("JAX_PLATFORMS", "")
+
+
+def _probe(timeout: float) -> tuple[bool, str]:
+    """(devices_ready, platform_name) via a subprocess with a deadline."""
+    with _lock:
+        if _cache:
+            fresh = (_cache["ready"]
+                     or time.monotonic() - _cache["at"] < _NEGATIVE_TTL_S)
+            if fresh:
+                return _cache["ready"], _cache["platform"]
+    plat = _parent_platforms()
+    pin = (f"jax.config.update('jax_platforms', {plat!r}); "
+           if plat else "")
     try:
-        import jax
+        out = subprocess.run(
+            [sys.executable, "-c",
+             f"import jax; {pin}print(jax.devices()[0].platform)"],
+            capture_output=True, timeout=timeout, text=True)
+        ready = out.returncode == 0
+        platform = out.stdout.strip().splitlines()[-1] if ready else ""
+    except (subprocess.TimeoutExpired, OSError):
+        ready, platform = False, ""
+    with _lock:
+        _cache.update(ready=ready, platform=platform, at=time.monotonic())
+    return ready, platform
 
-        return jax.devices()[0].platform == "tpu"
-    except Exception:
-        return False
+
+def jax_usable(timeout: float = _INIT_TIMEOUT_S) -> bool:
+    """True when the JAX backend answered device enumeration in time."""
+    ready, _ = _probe(timeout)
+    return ready
+
+
+def on_tpu(timeout: float = _INIT_TIMEOUT_S) -> bool:
+    """True when the default JAX backend is a real TPU (never hangs)."""
+    ready, platform = _probe(timeout)
+    return ready and platform == "tpu"

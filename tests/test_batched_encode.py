@@ -1,0 +1,101 @@
+"""Streaming batched TPU encode pipeline: byte parity with the host path,
+fused shard-file CRC32Cs, multi-volume batching (parallel/batched_encode.py).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.ops import crc32c as crc_host
+from seaweedfs_tpu.parallel.batched_encode import (_chunk_len, _plan_volume,
+                                                   encode_volumes)
+from seaweedfs_tpu.storage.erasure_coding import encoder as ec_encoder
+from seaweedfs_tpu.storage.erasure_coding import to_ext
+
+LARGE, SMALL = 10000, 100  # ec_test.go's scaled-down block sizes
+
+
+def _make_volume(tmp_path, name: str, size: int, seed: int) -> str:
+    base = str(tmp_path / name)
+    rng = np.random.default_rng(seed)
+    with open(base + ".dat", "wb") as f:
+        f.write(rng.integers(0, 256, size).astype(np.uint8).tobytes())
+    return base
+
+
+def _host_reference(tmp_path, base: str, tag: str) -> str:
+    ref = str(tmp_path / tag)
+    os.link(base + ".dat", ref + ".dat")
+    ec_encoder.write_ec_files(ref, large_block_size=LARGE,
+                              small_block_size=SMALL, batched=False)
+    return ref
+
+
+class TestBatchedEncode:
+    @pytest.mark.parametrize("size", [1, 999, SMALL * 10, SMALL * 10 * 7 + 13,
+                                      LARGE * 10 + 1, LARGE * 10 * 2 + 12345])
+    def test_bytes_match_host_path(self, tmp_path, size):
+        base = _make_volume(tmp_path, "v", size, size)
+        crcs = encode_volumes([base], large_block=LARGE, small_block=SMALL)
+        ref = _host_reference(tmp_path, base, "ref")
+        for i in range(14):
+            with open(base + to_ext(i), "rb") as f:
+                got = f.read()
+            with open(ref + to_ext(i), "rb") as f:
+                want = f.read()
+            assert got == want, f"shard {i} differs for size {size}"
+            assert crcs[base][i] == crc_host.crc32c(got), f"crc shard {i}"
+
+    def test_multi_volume_one_pipeline(self, tmp_path):
+        """Chunks of several volumes share device dispatches (config 4)."""
+        bases = [_make_volume(tmp_path, f"v{k}", 997 * (k + 1) + k, k)
+                 for k in range(5)]
+        crcs = encode_volumes(bases, large_block=LARGE, small_block=SMALL)
+        for k, base in enumerate(bases):
+            ref = _host_reference(tmp_path, base, f"ref{k}")
+            for i in range(14):
+                with open(base + to_ext(i), "rb") as f:
+                    got = f.read()
+                with open(ref + to_ext(i), "rb") as f:
+                    want = f.read()
+                assert got == want, f"vol {k} shard {i}"
+                assert crcs[base][i] == crc_host.crc32c(got)
+
+    def test_empty_volume(self, tmp_path):
+        base = _make_volume(tmp_path, "empty", 0, 0)
+        crcs = encode_volumes([base], large_block=LARGE, small_block=SMALL)
+        assert crcs[base] == [0] * 14
+        for i in range(14):
+            assert os.path.getsize(base + to_ext(i)) == 0
+
+    def test_write_ec_files_default_is_batched(self, tmp_path):
+        """write_ec_files with no codec returns the fused shard CRCs."""
+        from seaweedfs_tpu.util.platform import jax_usable
+
+        if not jax_usable():
+            pytest.skip("jax backend unreachable; default path falls back")
+        base = _make_volume(tmp_path, "w", 54321, 3)
+        crcs = ec_encoder.write_ec_files(base, large_block_size=LARGE,
+                                         small_block_size=SMALL)
+        assert isinstance(crcs, list) and len(crcs) == 14
+        with open(base + to_ext(12), "rb") as f:
+            assert crcs[12] == crc_host.crc32c(f.read())
+
+
+class TestPlan:
+    def test_row_plan_matches_striping(self, tmp_path):
+        base = _make_volume(tmp_path, "p", LARGE * 10 * 2 + 5, 9)
+        plan = _plan_volume(base, LARGE, SMALL)
+        # two large rows (the loop keeps striping while remaining exceeds
+        # one large row, ec_encoder.go:201), then small rows for the tail
+        assert plan.rows[0][2] == LARGE and plan.rows[1][2] == LARGE
+        assert all(b == SMALL for _, _, b in plan.rows[2:])
+        # shard offsets accumulate block sizes
+        assert plan.rows[1][1] == LARGE
+        assert plan.rows[2][1] == 2 * LARGE
+
+    def test_chunk_len_divides_blocks(self):
+        assert _chunk_len(1 << 30, 1 << 20) == 1 << 20
+        assert _chunk_len(10000, 100) == 100
+        assert _chunk_len(300, 77) == 1  # gcd fallback

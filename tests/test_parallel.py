@@ -1,14 +1,15 @@
-"""Multi-chip sharded encode on the virtual 8-device CPU mesh."""
+"""Multi-chip sharded encode + fused device CRC32C on the 8-device CPU mesh."""
 
 import jax
 import numpy as np
 import pytest
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import NamedSharding, PartitionSpec as P
 
-from seaweedfs_tpu.ops import gf256
+from seaweedfs_tpu.ops import crc32c as crc_host
+from seaweedfs_tpu.ops import crc_device, gf256
 from seaweedfs_tpu.ops.rs_numpy import gf_apply_matrix
 from seaweedfs_tpu.parallel.mesh import (encode_batch, make_mesh,
-                                         make_sharded_encoder, xor_fold)
+                                         make_sharded_encoder)
 
 
 @pytest.fixture(scope="module")
@@ -17,14 +18,45 @@ def mesh():
     return make_mesh()
 
 
-class TestXorFold:
-    @pytest.mark.parametrize("length", [1, 2, 7, 64, 1000])
-    def test_matches_numpy(self, length):
+class TestDeviceCrc32c:
+    @pytest.mark.parametrize("length", [1, 7, 100, 256, 1000, 4096, 65536])
+    def test_matches_host_crc(self, length):
+        """Device bit-matmul CRC == ops.crc32c.crc32c on random needles."""
         rng = np.random.default_rng(length)
-        x = rng.integers(0, 256, size=(3, length)).astype(np.uint8)
-        got = np.asarray(xor_fold(jax.numpy.asarray(x), axis=1))
-        expect = np.bitwise_xor.reduce(x, axis=1)
-        assert np.array_equal(got, expect)
+        data = rng.integers(0, 256, size=(3, length)).astype(np.uint8)
+        raw = jax.jit(crc_device.batched_crc32c_raw)(jax.numpy.asarray(data))
+        got = crc_device.finalize(raw, length)
+        for i in range(3):
+            assert int(got[i]) == crc_host.crc32c(data[i].tobytes())
+
+    def test_combine_chains_chunks(self):
+        """Per-chunk device CRCs chain into the whole-stream CRC."""
+        rng = np.random.default_rng(0)
+        chunks = rng.integers(0, 256, size=(4, 512)).astype(np.uint8)
+        raw = jax.jit(crc_device.batched_crc32c_raw)(
+            jax.numpy.asarray(chunks))
+        per_chunk = crc_device.finalize(raw, 512)
+        rolling = 0
+        for i in range(4):
+            rolling = crc_host.crc32c_combine(rolling, int(per_chunk[i]), 512)
+        assert rolling == crc_host.crc32c(chunks.tobytes())
+
+
+class TestHostCrcAlgebra:
+    def test_combine(self):
+        rng = np.random.default_rng(3)
+        a = rng.integers(0, 256, 1000).astype(np.uint8).tobytes()
+        b = rng.integers(0, 256, 377).astype(np.uint8).tobytes()
+        assert crc_host.crc32c_combine(
+            crc_host.crc32c(a), crc_host.crc32c(b), len(b)
+        ) == crc_host.crc32c(a + b)
+
+    def test_zeros_and_finalize(self):
+        for n in (1, 8, 100):
+            assert crc_host.crc32c_zeros(n) == crc_host.crc32c(b"\x00" * n)
+            m = bytes(range(n))
+            assert crc_host.finalize_raw(
+                crc_host.raw_update(0, m), n) == crc_host.crc32c(m)
 
 
 class TestShardedEncode:
@@ -32,17 +64,18 @@ class TestShardedEncode:
         assert mesh.devices.size == 8
         assert mesh.axis_names == ("data", "block")
 
-    def test_parity_matches_reference(self, mesh):
+    def test_parity_and_crc_match_reference(self, mesh):
         rng = np.random.default_rng(0)
         data = rng.integers(0, 256, size=(8, 10, 4096)).astype(np.uint8)
-        parity, checksums = encode_batch(data, mesh)
+        parity, crcs = encode_batch(data, mesh)
         matrix = gf256.parity_matrix(10, 14)
         for b in range(8):
             expect = gf_apply_matrix(matrix, data[b])
             assert np.array_equal(parity[b], expect), f"batch {b}"
             full = np.concatenate([data[b], expect], axis=0)
-            assert np.array_equal(checksums[b],
-                                  np.bitwise_xor.reduce(full, axis=1))
+            for s in range(14):
+                assert int(crcs[b, s]) == crc_host.crc32c(
+                    full[s].tobytes()), f"batch {b} shard {s}"
 
     def test_sharding_layout(self, mesh):
         """Outputs stay sharded over the mesh (no implicit full gather)."""
@@ -52,7 +85,7 @@ class TestShardedEncode:
         sharded = jax.device_put(
             jax.numpy.asarray(data),
             NamedSharding(mesh, P("data", None, "block")))
-        parity, checksums = step(sharded)
+        parity, _ = step(sharded)
         assert parity.sharding.spec == P("data", None, "block")
         # each device holds 1/8 of the parity bytes
         shard_shapes = {s.data.shape for s in parity.addressable_shards}

@@ -1,0 +1,235 @@
+"""Plan-only placement logic: rack-first EC spread, rack-aware ec.balance,
+placement-gated volume moves, auto-EC volume selection.  Hand-built
+topologies with no RPCs — the reference's shell test style
+(command_ec_test.go, command_volume_balance_test.go with
+applyBalancing=false)."""
+
+import pytest
+
+from seaweedfs_tpu.shell import commands as sh
+from seaweedfs_tpu.shell import commands_volume as vol
+from seaweedfs_tpu.shell.commands import (EcNode, _balance_nodes,
+                                          _balance_racks,
+                                          _shard_slot_budget,
+                                          balanced_ec_distribution)
+from seaweedfs_tpu.shell.commands_volume import (VolumeServerNode,
+                                                 is_good_move_by_placement)
+from seaweedfs_tpu.storage.super_block import ReplicaPlacement
+
+
+def _nodes(racks: dict[str, int], free: int = 10) -> list[EcNode]:
+    out = []
+    for rack, count in racks.items():
+        for i in range(count):
+            out.append(EcNode(url=f"{rack}-n{i}:8080", free_slots=free,
+                              dc="dc1", rack=rack))
+    return out
+
+
+class TestRackFirstDistribution:
+    def test_four_racks_cap_at_four(self):
+        alloc = balanced_ec_distribution(_nodes({"r1": 2, "r2": 2,
+                                                 "r3": 2, "r4": 2}))
+        assert sorted(s for ids in alloc.values() for s in ids) == list(
+            range(14))
+        per_rack: dict[str, int] = {}
+        for url, ids in alloc.items():
+            rack = url.split("-")[0]
+            per_rack[rack] = per_rack.get(rack, 0) + len(ids)
+        # ceil(14/4) = 4: a rack failure can never take out > 4 shards
+        assert max(per_rack.values()) <= 4
+        assert len(per_rack) == 4
+
+    def test_two_racks_split_seven_seven(self):
+        alloc = balanced_ec_distribution(_nodes({"a": 3, "b": 3}))
+        per_rack: dict[str, int] = {}
+        for url, ids in alloc.items():
+            per_rack[url.split("-")[0]] = (
+                per_rack.get(url.split("-")[0], 0) + len(ids))
+        assert sorted(per_rack.values()) == [7, 7]
+
+    def test_slotless_rack_skipped(self):
+        nodes = (_nodes({"full": 2}, free=0) + _nodes({"ok": 2}, free=10))
+        alloc = balanced_ec_distribution(nodes)
+        assert all(url.startswith("ok") for url in alloc)
+
+    def test_insufficient_slots_raises(self):
+        with pytest.raises(ValueError):
+            balanced_ec_distribution(_nodes({"r": 1}, free=0))
+
+
+class TestEcBalancePhases:
+    def test_rack_phase_spreads_clustered_volume(self):
+        nodes = _nodes({"r1": 2, "r2": 2, "r3": 2})
+        # all 14 shards of volume 7 clustered in rack r1
+        nodes[0].shards[7] = list(range(7))
+        nodes[1].shards[7] = list(range(7, 14))
+        moves: list[dict] = []
+        _balance_racks(nodes, moves, _shard_slot_budget(nodes))
+        per_rack: dict[str, int] = {}
+        for n in nodes:
+            per_rack[n.rack] = per_rack.get(n.rack, 0) + len(
+                n.shards.get(7, []))
+        # ceil(14/3) = 5
+        assert max(per_rack.values()) <= 5
+        assert all(m["volume"] == 7 for m in moves)
+
+    def test_node_phase_evens_within_rack(self):
+        nodes = _nodes({"r1": 3})
+        nodes[0].shards = {1: [0, 1], 2: [3, 4], 3: [5, 6]}
+        moves: list[dict] = []
+        _balance_nodes(nodes, moves, _shard_slot_budget(nodes))
+        counts = [n.shard_count() for n in nodes]
+        assert max(counts) - min(counts) <= 2
+        # never co-locate a volume's shards with an existing holder twice
+        for n in nodes:
+            for vid, ids in n.shards.items():
+                assert len(ids) == len(set(ids))
+
+    def test_balanced_cluster_no_moves(self):
+        nodes = _nodes({"r1": 2, "r2": 2})
+        # 7 shards per rack (cap = ceil(14/2) = 7): nothing to do
+        nodes[0].shards[9] = [0, 1, 2, 3]
+        nodes[1].shards[9] = [4, 5, 6]
+        nodes[2].shards[9] = [7, 8, 9, 10]
+        nodes[3].shards[9] = [11, 12, 13]
+        moves: list[dict] = []
+        _balance_racks(nodes, moves, _shard_slot_budget(nodes))
+        assert moves == []
+
+
+class TestPlacementGate:
+    def test_is_good_move_placement_byte(self):
+        rp = ReplicaPlacement.parse("010")  # 2 copies, different racks
+        assert is_good_move_by_placement(
+            rp, [("dc1", "r1"), ("dc1", "r2")])
+        assert not is_good_move_by_placement(
+            rp, [("dc1", "r1"), ("dc1", "r1")])
+        rp = ReplicaPlacement.parse("100")  # 2 copies, different DCs
+        assert is_good_move_by_placement(
+            rp, [("dc1", "r1"), ("dc2", "r1")])
+        assert not is_good_move_by_placement(
+            rp, [("dc1", "r1"), ("dc1", "r2")])
+        rp = ReplicaPlacement.parse("001")  # 2 copies, same rack allowed
+        assert is_good_move_by_placement(
+            rp, [("dc1", "r1"), ("dc1", "r1")])
+
+    def _cluster(self):
+        """vid 5 replicated 010 across racks; one overloaded server."""
+        def mk(url, rack, vols):
+            return VolumeServerNode(url=url, dc="dc1", rack=rack, free=5,
+                                    max=10, volumes=vols)
+
+        v = {"id": 5, "size": 100, "collection": "", "replication": 10,
+             "read_only": False}
+        filler = [{"id": 100 + i, "size": 10, "collection": "",
+                   "replication": 0, "read_only": False} for i in range(4)]
+        return [
+            mk("a:1", "r1", [dict(v)] + [dict(f) for f in filler]),
+            mk("b:1", "r2", [dict(v)]),
+            mk("c:1", "r1", []),
+        ]
+
+    def test_balance_respects_placement(self, monkeypatch):
+        nodes = self._cluster()
+        monkeypatch.setattr(vol, "collect_volume_servers",
+                            lambda env: nodes)
+        env = sh.CommandEnv("fake:9333")
+        moves = vol.volume_balance(env, plan_only=True)
+        # volume 5 must never move to c:1 (same rack r1 as... a:1 leaving
+        # would be fine, but b:1 holds the other replica in r2; moving the
+        # a:1 copy to c:1 keeps racks distinct, moving b:1's copy to c:1
+        # would co-locate).  Verify every planned move keeps placement.
+        for m in moves:
+            if m["volume"] != 5:
+                continue
+            target = next(n for n in nodes if n.url == m["to"])
+            others = [n for n in nodes
+                      if n.url != m["from"]
+                      and any(v["id"] == 5 for v in n.volumes)]
+            locs = [(n.dc, n.rack) for n in others] + [
+                (target.dc, target.rack)]
+            assert is_good_move_by_placement(
+                ReplicaPlacement.parse("010"), locs)
+
+    def test_evacuate_prefers_placement_safe_target(self, monkeypatch):
+        nodes = self._cluster()
+        monkeypatch.setattr(vol, "collect_volume_servers",
+                            lambda env: nodes)
+        env = sh.CommandEnv("fake:9333")
+        moves = vol.volume_server_evacuate(env, "b:1", plan_only=True)
+        move5 = next(m for m in moves if m["volume"] == 5)
+        # replica on a:1 is in r1 — the evacuated copy must not land on
+        # the other r1 server while a placement-safe server exists... all
+        # remaining servers are r1 here, so fallback applies; it must
+        # still pick a non-holder
+        assert move5["to"] == "c:1"
+
+
+class TestAutoEcSelection:
+    TOPO = {
+        "volume_size_limit": 1000,
+        "datacenters": [{
+            "id": "dc1",
+            "racks": [{
+                "id": "r1",
+                "nodes": [{
+                    "id": "n1", "url": "n1:8080", "free": 5,
+                    "volume_list": [
+                        {"id": 1, "size": 990, "collection": "",
+                         "modified_at": 1000},       # full + quiet
+                        {"id": 2, "size": 990, "collection": "",
+                         "modified_at": 99_000},     # full but active
+                        {"id": 3, "size": 100, "collection": "",
+                         "modified_at": 1000},       # quiet but empty
+                        {"id": 4, "size": 960, "collection": "hot",
+                         "modified_at": 1000},       # other collection
+                    ],
+                }],
+            }],
+        }],
+        "layouts": [], "ec_volumes": [],
+    }
+
+    def _env(self):
+        env = sh.CommandEnv("fake:9333")
+        env.master = lambda path, payload=None, **kw: self.TOPO
+        return env
+
+    def test_selects_full_and_quiet(self):
+        # "" selects only the default collection (reference semantics),
+        # so the full+quiet volume in collection "hot" is excluded
+        vids = sh.collect_volume_ids_for_ec_encode(
+            self._env(), full_percent=95, quiet_seconds=3600,
+            now=100_000.0)
+        assert vids == [1]
+
+    def test_collection_filter(self):
+        vids = sh.collect_volume_ids_for_ec_encode(
+            self._env(), collection="hot", full_percent=95,
+            quiet_seconds=3600, now=100_000.0)
+        assert vids == [4]
+
+    def test_quiet_window(self):
+        vids = sh.collect_volume_ids_for_ec_encode(
+            self._env(), full_percent=95, quiet_seconds=10_000_000,
+            now=100_000.0)
+        assert vids == []
+
+    def test_auto_encode_drives_each_selected_volume(self, monkeypatch):
+        encoded = []
+        monkeypatch.setattr(
+            sh, "ec_encode",
+            lambda env, vid, collection="", plan_only=False: encoded.append(
+                (vid, plan_only)) or {"volume": vid})
+        out = sh.ec_encode_auto(self._env(), full_percent=95,
+                                quiet_seconds=3600, plan_only=True,
+                                now=100_000.0)
+        assert [v for v, _ in encoded] == [1]
+        assert all(p for _, p in encoded)
+        assert len(out) == 1
+        encoded.clear()
+        sh.ec_encode_auto(self._env(), collection="hot", full_percent=95,
+                          quiet_seconds=3600, plan_only=True,
+                          now=100_000.0)
+        assert [v for v, _ in encoded] == [4]

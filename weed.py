@@ -353,9 +353,20 @@ def _shell_handlers(env):
             if flag(a, "select") else None,
             field=flag(a, "field", ""), op=flag(a, "op", ""),
             value=flag(a, "value", ""), csv="-csv" in a)),
-        # ec family
-        "ec.encode": lambda a: show(sh.ec_encode(
-            env, int(a[0]), plan_only=plan(a))),
+        # ec family — ec.encode takes an explicit volume id, or selects
+        # full+quiet volumes with -fullPercent/-quietFor (seconds), the
+        # reference's auto-EC trigger (command_ec_encode.go:271-302)
+        "ec.encode": lambda a: show(
+            (lambda vids: sh.ec_encode(
+                env, int(vids[0]), collection=flag(a, "collection", ""),
+                plan_only=plan(a))
+             if vids else
+             sh.ec_encode_auto(
+                env, collection=flag(a, "collection", ""),
+                full_percent=float(flag(a, "fullPercent", "95")),
+                quiet_seconds=float(flag(a, "quietFor", "3600")),
+                plan_only=plan(a)))(
+            [x for x in a if not x.startswith("-")])),
         "ec.decode": lambda a: show(sh.ec_decode(
             env, int(a[0]), plan_only=plan(a))),
         "ec.rebuild": lambda a: show(sh.ec_rebuild(
