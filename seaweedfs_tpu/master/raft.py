@@ -3,14 +3,17 @@
 The reference runs hashicorp/raft with a deliberately tiny FSM: the only
 replicated state is MaxVolumeId (weed/server/raft_server.go:52-100 — the
 FSM's Apply handles one command type, MaxVolumeIdCommand), persisted in
-boltdb with leader election deciding which master may assign volume ids.
+boltdb with snapshots.
 
-This implementation keeps that shape: full leader election (randomized
-timeouts, term voting) with the single-integer FSM shipped inline on every
-AppendEntries — because the state is one monotonically-increasing integer
-and only the leader mutates it, the heartbeat IS the log replication, and
-a majority ack of the new value before use gives the same linearizable
-volume-id allocation the reference gets from raft.Apply.
+This implementation keeps that FSM but runs the full raft machinery over
+it: a persisted replicated LOG of MaxVolumeId commands with
+prev-index/term consistency checks, per-follower next/match tracking,
+majority commit, and log-compaction snapshots (the applied FSM value +
+last included index/term) shipped to stragglers.  Volume-id allocation is
+at-most-once: an id is returned only after its log entry COMMITS — a
+failed quorum leaves the entry uncommitted and the value unreturned, so a
+competing leader can never hand out the same committed id
+(the round-2 review's id-burn-on-failed-quorum hazard).
 """
 
 from __future__ import annotations
@@ -26,6 +29,8 @@ from ..rpc.http_rpc import RpcError, call
 from ..util import glog
 
 FOLLOWER, CANDIDATE, LEADER = "follower", "candidate", "leader"
+
+SNAPSHOT_THRESHOLD = 64  # applied entries kept before compaction
 
 
 class RaftNode:
@@ -45,8 +50,19 @@ class RaftNode:
         self.term = 0
         self.voted_for: Optional[str] = None
         self.leader: Optional[str] = None
-        self.max_volume_id = 0
         self.on_become_leader: Optional[Callable[[], None]] = None
+
+        # -- replicated log + snapshot (boltdb store analogue) ---------------
+        # entry: {"index": i, "term": t, "max_volume_id": N}; the entry at
+        # global index i lives at log[i - snapshot_index - 1]
+        self.log: list[dict] = []
+        self.snapshot_index = 0
+        self.snapshot_term = 0
+        self.snapshot_value = 0  # FSM value at the snapshot point
+        self.commit_index = 0
+        self.max_volume_id = 0   # the applied FSM value
+        self._next_index: dict[str, int] = {}
+        self._match_index: dict[str, int] = {}
 
         self._last_heard = time.monotonic()
         self._stop = threading.Event()
@@ -56,11 +72,65 @@ class RaftNode:
             # raft safety requires durable term/vote: a restarted node with
             # amnesia can double-vote in one term and elect two leaders
             glog.warningf(
-                "raft: %d-peer cluster without -mdir: term/vote state is "
-                "NOT persisted; a master restart can elect split leaders",
+                "raft: %d-peer cluster without -mdir: term/vote/log state "
+                "is NOT persisted; a master restart can elect split leaders",
                 len(self.peers))
 
-    # -- persistence (raft_server.go boltdb store analogue) ------------------
+    # -- log helpers (lock held) ----------------------------------------------
+    def _last_index(self) -> int:
+        return self.snapshot_index + len(self.log)
+
+    def _last_term(self) -> int:
+        return self.log[-1]["term"] if self.log else self.snapshot_term
+
+    def _entry(self, index: int) -> Optional[dict]:
+        k = index - self.snapshot_index - 1
+        if 0 <= k < len(self.log):
+            return self.log[k]
+        return None
+
+    def _term_at(self, index: int) -> Optional[int]:
+        if index == self.snapshot_index:
+            return self.snapshot_term
+        e = self._entry(index)
+        return e["term"] if e else None
+
+    def _pending_value(self) -> int:
+        """Highest MaxVolumeId anywhere in the log (committed or not) —
+        the allocation floor, so concurrent/unacked entries never collide."""
+        value = self.max_volume_id
+        for e in self.log:
+            if e["max_volume_id"] > value:
+                value = e["max_volume_id"]
+        return max(value, self.snapshot_value)
+
+    def _advance_commit(self, new_commit: int):
+        """Apply newly-committed entries to the FSM, then maybe compact."""
+        new_commit = min(new_commit, self._last_index())
+        if new_commit <= self.commit_index:
+            return
+        for i in range(self.commit_index + 1, new_commit + 1):
+            e = self._entry(i)
+            if e and e["max_volume_id"] > self.max_volume_id:
+                self.max_volume_id = e["max_volume_id"]
+        self.commit_index = new_commit
+        self._maybe_snapshot()
+        self._save_state()
+
+    def _maybe_snapshot(self):
+        """Compact the applied prefix once it outgrows the threshold
+        (raft_server.go:91-100 snapshot persistence)."""
+        applied = self.commit_index - self.snapshot_index
+        if applied < SNAPSHOT_THRESHOLD:
+            return
+        cut = self.commit_index - self.snapshot_index  # entries to drop
+        self.snapshot_term = self._term_at(self.commit_index) or \
+            self.snapshot_term
+        self.snapshot_index = self.commit_index
+        self.snapshot_value = self.max_volume_id
+        self.log = self.log[cut:]
+
+    # -- persistence -----------------------------------------------------------
     def _state_path(self) -> str:
         return os.path.join(self.state_dir, "raft_state.json")
 
@@ -72,7 +142,20 @@ class RaftNode:
                 d = json.load(f)
             self.term = int(d.get("term", 0))
             self.voted_for = d.get("voted_for")
-            self.max_volume_id = int(d.get("max_volume_id", 0))
+            snap = d.get("snapshot", {})
+            self.snapshot_index = int(snap.get("index", 0))
+            self.snapshot_term = int(snap.get("term", 0))
+            self.snapshot_value = int(snap.get("max_volume_id",
+                                               d.get("max_volume_id", 0)))
+            self.log = list(d.get("log", []))
+            self.commit_index = max(int(d.get("commit_index", 0)),
+                                    self.snapshot_index)
+            # replay the committed suffix into the FSM
+            self.max_volume_id = self.snapshot_value
+            for e in self.log:
+                if (e["index"] <= self.commit_index
+                        and e["max_volume_id"] > self.max_volume_id):
+                    self.max_volume_id = e["max_volume_id"]
             # peers are persisted only once membership was changed via
             # cluster.raft.add/remove — a plain restart keeps the
             # configured list (addresses are identity here, so saving the
@@ -87,8 +170,14 @@ class RaftNode:
     def _save_state(self):
         if not self.state_dir:
             return
-        state = {"term": self.term, "voted_for": self.voted_for,
-                 "max_volume_id": self.max_volume_id}
+        state = {
+            "term": self.term, "voted_for": self.voted_for,
+            "commit_index": self.commit_index,
+            "snapshot": {"index": self.snapshot_index,
+                         "term": self.snapshot_term,
+                         "max_volume_id": self.snapshot_value},
+            "log": self.log,
+        }
         if getattr(self, "_peers_persisted", False):
             state["peers"] = self.peers
         tmp = self._state_path() + ".tmp"
@@ -152,6 +241,8 @@ class RaftNode:
             if address in self.peers:
                 return
             self.peers = sorted(set(self.peers) | {address})
+            self._next_index[address] = self._last_index() + 1
+            self._match_index[address] = 0
             self._peers_persisted = True
             self._save_state()
             notify = set(self.peers)
@@ -173,7 +264,7 @@ class RaftNode:
     def _run(self):
         while not self._stop.is_set():
             if self.state == LEADER:
-                self._broadcast_heartbeat()
+                self._broadcast_round()
                 self._stop.wait(self.heartbeat_interval)
             else:
                 timeout = self.election_timeout * (1 + random.random())
@@ -188,6 +279,8 @@ class RaftNode:
             self.voted_for = self.address
             self.leader = None
             term = self.term
+            last_index = self._last_index()
+            last_term = self._last_term()
             self._save_state()
         votes = 1
         for peer in self.peers:
@@ -196,7 +289,8 @@ class RaftNode:
             try:
                 r = call(peer, "/raft/request_vote",
                          {"term": term, "candidate": self.address,
-                          "max_volume_id": self.max_volume_id},
+                          "last_log_index": last_index,
+                          "last_log_term": last_term},
                          timeout=1)
                 if r.get("granted"):
                     votes += 1
@@ -213,13 +307,16 @@ class RaftNode:
                            self.address, term, votes)
                 self.state = LEADER
                 self.leader = self.address
+                for peer in self.peers:
+                    self._next_index[peer] = self._last_index() + 1
+                    self._match_index[peer] = 0
             else:
                 self.state = FOLLOWER
                 self._last_heard = time.monotonic()
                 return
         if self.on_become_leader:
             self.on_become_leader()
-        self._broadcast_heartbeat()
+        self._broadcast_round()
 
     def _step_down(self, term: int):
         with self.lock:
@@ -233,31 +330,80 @@ class RaftNode:
             self.state = FOLLOWER
             self._last_heard = time.monotonic()
 
-    def _broadcast_heartbeat(self) -> int:
-        """Returns the number of peers (incl. self) sharing our state."""
+    # -- leader-side replication ----------------------------------------------
+    def _replicate_to(self, peer: str) -> bool:
+        """One AppendEntries (or snapshot-install) round to a follower."""
         with self.lock:
-            payload = {"term": self.term, "leader": self.address,
-                       "max_volume_id": self.max_volume_id}
+            if self.state != LEADER:
+                return False
+            term = self.term
+            ni = self._next_index.get(peer, self._last_index() + 1)
+            payload = {"term": term, "leader": self.address,
+                       "commit_index": self.commit_index}
+            if ni <= self.snapshot_index:
+                # follower is behind the compaction horizon: ship the
+                # snapshot (InstallSnapshot), then the remaining log
+                payload["snapshot"] = {
+                    "index": self.snapshot_index,
+                    "term": self.snapshot_term,
+                    "max_volume_id": self.snapshot_value}
+                payload["prev_index"] = self.snapshot_index
+                payload["prev_term"] = self.snapshot_term
+                payload["entries"] = list(self.log)
+            else:
+                payload["prev_index"] = ni - 1
+                payload["prev_term"] = self._term_at(ni - 1) or 0
+                payload["entries"] = [
+                    e for e in self.log if e["index"] >= ni]
+            sent_last = self._last_index()
+        try:
+            r = call(peer, "/raft/append_entries", payload, timeout=1)
+        except RpcError:
+            return False
+        with self.lock:
+            if r.get("term", 0) > self.term:
+                pass  # handled below, outside the lock
+            elif r.get("ok"):
+                self._match_index[peer] = sent_last
+                self._next_index[peer] = sent_last + 1
+                return True
+            else:
+                # consistency miss: back off to the follower's tail
+                follower_last = int(r.get("last_index", 0))
+                self._next_index[peer] = max(
+                    min(ni - 1, follower_last + 1), 1)
+        if r.get("term", 0) > term:
+            self._step_down(r["term"])
+        return False
+
+    def _broadcast_round(self) -> int:
+        """Replicate to every follower; advance commit on majority match.
+        Returns the number of peers (incl. self) matching our last index."""
+        peers = [p for p in self.peers if p != self.address]
         acked = 1
-        for peer in self.peers:
-            if peer == self.address:
-                continue
-            try:
-                r = call(peer, "/raft/append_entries", payload, timeout=1)
-                if r.get("term", 0) > payload["term"]:
-                    self._step_down(r["term"])
-                    return acked
-                if r.get("ok"):
-                    acked += 1
-            except RpcError:
-                continue
+        for peer in peers:
+            if self._replicate_to(peer):
+                acked += 1
+        with self.lock:
+            if self.state != LEADER:
+                return acked
+            # majority-match commit rule (only entries of the current term
+            # commit by counting, per the raft paper's §5.4.2 restriction)
+            for n in range(self._last_index(), self.commit_index, -1):
+                matches = 1 + sum(
+                    1 for p in peers if self._match_index.get(p, 0) >= n)
+                if matches >= self.quorum() \
+                        and self._term_at(n) == self.term:
+                    self._advance_commit(n)
+                    break
         return acked
 
     # -- RPC handlers --------------------------------------------------------
     def handle_request_vote(self, req: dict) -> dict:
         term = int(req["term"])
         candidate = req["candidate"]
-        candidate_state = int(req.get("max_volume_id", 0))
+        c_last_term = int(req.get("last_log_term", 0))
+        c_last_index = int(req.get("last_log_index", 0))
         with self.lock:
             if term < self.term:
                 return {"granted": False, "term": self.term}
@@ -266,8 +412,11 @@ class RaftNode:
                 self.voted_for = None
                 if self.state != FOLLOWER:
                     self.state = FOLLOWER
-            if (self.voted_for in (None, candidate)
-                    and candidate_state >= self.max_volume_id):
+            # up-to-date check on the LOG (raft §5.4.1), not the FSM
+            up_to_date = (c_last_term > self._last_term()
+                          or (c_last_term == self._last_term()
+                              and c_last_index >= self._last_index()))
+            if self.voted_for in (None, candidate) and up_to_date:
                 self.voted_for = candidate
                 self._last_heard = time.monotonic()
                 self._save_state()
@@ -279,39 +428,102 @@ class RaftNode:
         term = int(req["term"])
         with self.lock:
             if term < self.term:
-                return {"ok": False, "term": self.term}
+                return {"ok": False, "term": self.term,
+                        "last_index": self._last_index()}
             if term > self.term:
                 self.term = term
                 self.voted_for = None
-                self._save_state()
             self.state = FOLLOWER
             self.leader = req["leader"]
             self._last_heard = time.monotonic()
-            incoming = int(req.get("max_volume_id", 0))
-            if incoming > self.max_volume_id:
-                self.max_volume_id = incoming
+
+            snap = req.get("snapshot")
+            if snap and snap["index"] > self.snapshot_index:
+                # InstallSnapshot: replace everything up to the snapshot
+                self.snapshot_index = int(snap["index"])
+                self.snapshot_term = int(snap["term"])
+                self.snapshot_value = int(snap["max_volume_id"])
+                self.log = []
+                self.commit_index = self.snapshot_index
+                if self.snapshot_value > self.max_volume_id:
+                    self.max_volume_id = self.snapshot_value
+
+            prev_index = int(req.get("prev_index", 0))
+            prev_term = int(req.get("prev_term", 0))
+            if prev_index > self._last_index():
                 self._save_state()
-            return {"ok": True, "term": self.term}
+                return {"ok": False, "term": self.term,
+                        "last_index": self._last_index()}
+            if prev_index > self.snapshot_index:
+                local = self._term_at(prev_index)
+                if local != prev_term:
+                    # conflicting suffix: drop it and report our new tail
+                    self.log = self.log[:prev_index - self.snapshot_index
+                                        - 1]
+                    self._save_state()
+                    return {"ok": False, "term": self.term,
+                            "last_index": self._last_index()}
+            for e in req.get("entries", []):
+                idx = int(e["index"])
+                if idx <= self.snapshot_index:
+                    continue  # already compacted (thus committed)
+                existing = self._entry(idx)
+                if existing is not None:
+                    if existing["term"] == e["term"]:
+                        continue
+                    self.log = self.log[:idx - self.snapshot_index - 1]
+                self.log.append({"index": idx, "term": int(e["term"]),
+                                 "max_volume_id": int(e["max_volume_id"])})
+            self._advance_commit(int(req.get("commit_index", 0)))
+            self._save_state()
+            return {"ok": True, "term": self.term,
+                    "last_index": self._last_index()}
 
     # -- the FSM: MaxVolumeId allocation (raft_server.go:78) -----------------
     def next_volume_id(self) -> int:
-        """Allocate the next volume id, majority-replicated before use."""
+        """Allocate the next volume id; returns only after the allocation's
+        log entry is COMMITTED (majority-replicated).  A failed quorum
+        leaves the entry uncommitted and the id unreturned — at-most-once,
+        no id can be double-allocated by competing leaders."""
         with self.lock:
             if self.state != LEADER:
                 raise RpcError("not raft leader", 409)
-            self.max_volume_id += 1
-            vid = self.max_volume_id
+            value = self._pending_value() + 1
+            entry = {"index": self._last_index() + 1, "term": self.term,
+                     "max_volume_id": value}
+            self.log.append(entry)
             self._save_state()
-        if len(self.peers) > 1:
-            acked = self._broadcast_heartbeat()
-            if acked < self.quorum():
-                raise RpcError(
-                    f"volume id {vid} not replicated to quorum", 503)
-        return vid
+            if len(self.peers) == 1:
+                self._advance_commit(entry["index"])
+                return value
+        # two rounds: the second lets a consistency-miss follower that
+        # backed off in round one catch up and count toward the quorum
+        for _ in range(2):
+            self._broadcast_round()
+            with self.lock:
+                if self.commit_index >= entry["index"]:
+                    if self._term_at(entry["index"]) == entry["term"]:
+                        return value
+                    # compacted below the snapshot horizon: the entry is
+                    # committed provided WE are still the leader of its
+                    # term (no competing leader could have replaced it
+                    # without first bumping our term and demoting us)
+                    if (entry["index"] <= self.snapshot_index
+                            and self.state == LEADER
+                            and self.term == entry["term"]):
+                        return value
+        raise RpcError(
+            f"volume id {value} not replicated to quorum", 503)
 
     def observe_volume_id(self, vid: int):
-        """Fold in a volume id seen in a heartbeat (SetMax semantics)."""
+        """Fold in a volume id seen in a heartbeat (SetMax semantics): the
+        leader appends a log entry so the observation replicates; followers
+        ignore it (their leader will replicate its own observation)."""
         with self.lock:
-            if vid > self.max_volume_id:
-                self.max_volume_id = vid
-                self._save_state()
+            if self.state != LEADER or vid <= self._pending_value():
+                return
+            self.log.append({"index": self._last_index() + 1,
+                             "term": self.term, "max_volume_id": vid})
+            if len(self.peers) == 1:
+                self._advance_commit(self._last_index())
+            self._save_state()

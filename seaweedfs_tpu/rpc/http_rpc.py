@@ -49,7 +49,13 @@ class Request:
 
 
 class Response:
-    """Return from a route: json dict, bytes, or a (status, headers, body)."""
+    """Return from a route: json dict, bytes, or a (status, headers, body).
+
+    `body` may also be an ITERATOR of byte chunks — the server then
+    streams it without buffering: with a Content-Length header the chunks
+    are written raw; without one the reply uses HTTP/1.1 chunked
+    transfer-encoding (the substrate for VolumeCopy/CopyFile-style
+    streaming RPCs, volume_server.proto:49-53)."""
 
     def __init__(self, body=b"", status: int = 200,
                  content_type: str = "application/octet-stream",
@@ -58,6 +64,29 @@ class Response:
         self.status = status
         self.content_type = content_type
         self.headers = headers or {}
+
+
+def stream_file(path: str, chunk_size: int = 4 << 20,
+                headers: Optional[dict] = None) -> Response:
+    """Response that streams a file with a fixed Content-Length snapshot
+    (bytes appended mid-stream are not sent)."""
+    import os
+
+    length = os.path.getsize(path)
+
+    def gen():
+        left = length
+        with open(path, "rb") as f:
+            while left > 0:
+                chunk = f.read(min(chunk_size, left))
+                if not chunk:
+                    break
+                left -= len(chunk)
+                yield chunk
+
+    h = dict(headers or {})
+    h["Content-Length"] = str(length)
+    return Response(gen(), headers=h)
 
 
 Route = Callable[[Request], object]
@@ -113,6 +142,9 @@ class RpcServer:
                 body = resp.body
                 if isinstance(body, str):
                     body = body.encode()
+                if not isinstance(body, (bytes, bytearray)):
+                    self._reply_stream(resp, body)
+                    return
                 self.send_response(resp.status)
                 self.send_header("Content-Type", resp.content_type)
                 if "Content-Length" not in resp.headers:
@@ -122,6 +154,31 @@ class RpcServer:
                 self.end_headers()
                 if self.command != "HEAD":
                     self.wfile.write(body)
+
+            def _reply_stream(self, resp: Response, chunks):
+                """Stream an iterator body: raw writes under a known
+                Content-Length, chunked transfer-encoding otherwise."""
+                chunked = "Content-Length" not in resp.headers
+                self.send_response(resp.status)
+                self.send_header("Content-Type", resp.content_type)
+                for k, v in resp.headers.items():
+                    self.send_header(k, v)
+                if chunked:
+                    self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                if self.command == "HEAD":
+                    return
+                for chunk in chunks:
+                    if not chunk:
+                        continue
+                    if chunked:
+                        self.wfile.write(b"%x\r\n" % len(chunk))
+                        self.wfile.write(chunk)
+                        self.wfile.write(b"\r\n")
+                    else:
+                        self.wfile.write(chunk)
+                if chunked:
+                    self.wfile.write(b"0\r\n\r\n")
 
             def do_GET(self):
                 self._dispatch("GET")
@@ -228,3 +285,46 @@ def call(addr: str, path: str, payload: Optional[dict] = None,
     if parse and "application/json" in ctype:
         return json.loads(body) if body else {}
     return body
+
+
+def call_stream(addr: str, path: str, payload: Optional[dict] = None,
+                method: Optional[str] = None, timeout: float = 600.0,
+                chunk_size: int = 4 << 20,
+                headers: Optional[dict] = None):
+    """Like call() but returns an iterator of response-body chunks —
+    nothing is buffered beyond one chunk (receiver side of the streaming
+    RPCs; urllib decodes chunked transfer-encoding transparently).
+    Errors before the first byte raise RpcError like call()."""
+    url = f"http://{addr}{path}"
+    data = None
+    req_headers = dict(headers or {})
+    if payload is not None:
+        data = json.dumps(payload).encode()
+        req_headers["Content-Type"] = "application/json"
+    if method is None:
+        method = "POST" if data is not None else "GET"
+    req = urllib.request.Request(url, data=data, method=method,
+                                 headers=req_headers)
+    try:
+        resp = urllib.request.urlopen(req, timeout=timeout)
+    except urllib.error.HTTPError as e:
+        body = e.read()
+        try:
+            message = json.loads(body).get("error", body.decode())
+        except Exception:
+            message = body.decode(errors="replace")
+        raise RpcError(message, e.code) from None
+    except (urllib.error.URLError, socket.timeout, ConnectionError) as e:
+        raise RpcError(f"cannot reach {addr}: {e}", 503) from None
+
+    def gen():
+        try:
+            while True:
+                chunk = resp.read(chunk_size)
+                if not chunk:
+                    return
+                yield chunk
+        finally:
+            resp.close()
+
+    return gen()

@@ -13,7 +13,7 @@ import json
 from dataclasses import dataclass, field
 from typing import Optional
 
-from ..rpc.http_rpc import RpcError, call
+from ..rpc.http_rpc import RpcError, call, call_stream
 from ..storage.super_block import ReplicaPlacement
 from .commands import CommandEnv
 
@@ -333,6 +333,23 @@ def volume_server_leave(env: CommandEnv, server: str) -> dict:
     return call(server, "/admin/leave", {})
 
 
+def _stream_ndjson(url: str, path: str):
+    """Iterate NDJSON records from a streaming endpoint without buffering
+    the whole body (read_all streams chunked for billion-needle volumes)."""
+    buf = b""
+    for chunk in call_stream(url, path, timeout=600):
+        buf += chunk
+        while True:
+            nl = buf.find(b"\n")
+            if nl < 0:
+                break
+            line, buf = buf[:nl], buf[nl + 1:]
+            if line.strip():
+                yield json.loads(line)
+    if buf.strip():
+        yield json.loads(buf)
+
+
 # -- volume.check.disk (command_volume_check_disk.go) ------------------------
 
 def volume_check_disk(env: CommandEnv,
@@ -351,14 +368,9 @@ def volume_check_disk(env: CommandEnv,
             continue
         id_sets: dict[str, set[int]] = {}
         for n in holders:
-            data = call(n.url, f"/admin/volume/read_all?volume={vid}",
-                        timeout=600)
-            raw = data if isinstance(data, (bytes, bytearray)) else b""
-            ids = set()
-            for line in raw.splitlines():
-                if line.strip():
-                    ids.add(json.loads(line)["id"])
-            id_sets[n.url] = ids
+            id_sets[n.url] = {
+                rec["id"] for rec in _stream_ndjson(
+                    n.url, f"/admin/volume/read_all?volume={vid}")}
         union: set[int] = set()
         for ids in id_sets.values():
             union |= ids
@@ -395,13 +407,10 @@ def volume_fsck(env: CommandEnv, filer_address: str = "",
     stored: dict[int, set[int]] = {}
     for n in nodes:
         for v in n.volumes:
-            data = call(n.url, f"/admin/volume/read_all?volume={v['id']}",
-                        timeout=600)
-            raw = data if isinstance(data, (bytes, bytearray)) else b""
             ids = stored.setdefault(v["id"], set())
-            for line in raw.splitlines():
-                if line.strip():
-                    ids.add(json.loads(line)["id"])
+            for rec in _stream_ndjson(
+                    n.url, f"/admin/volume/read_all?volume={v['id']}"):
+                ids.add(rec["id"])
     report: dict = {"volumes": len(stored),
                     "stored_needles": sum(len(s) for s in stored.values())}
     if not filer_address:

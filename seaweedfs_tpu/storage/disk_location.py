@@ -25,11 +25,14 @@ _SHARD_RE = re.compile(r"^(?:(?P<collection>.+)_)?(?P<vid>\d+)\.ec(?P<shard>\d{2
 
 class DiskLocation:
     def __init__(self, directory: str, max_volume_count: int = 8,
-                 min_free_space_ratio: float = 0.0):
+                 min_free_space_ratio: float = 0.0,
+                 needle_map_kind: str = "memory", fsync: bool = False):
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
         self.max_volume_count = max_volume_count
         self.min_free_space_ratio = min_free_space_ratio
+        self.needle_map_kind = needle_map_kind
+        self.fsync = fsync
         self.volumes: dict[int, Volume] = {}
         self.ec_volumes: dict[int, EcVolume] = {}
         self.lock = threading.RLock()
@@ -71,7 +74,9 @@ class DiskLocation:
                     if vid not in self.volumes:
                         try:
                             self.volumes[vid] = Volume(
-                                self.directory, collection, vid)
+                                self.directory, collection, vid,
+                                needle_map_kind=self.needle_map_kind,
+                                fsync=self.fsync)
                         except Exception:
                             continue  # damaged volume: skip, don't crash
             self.load_all_ec_shards()
@@ -112,7 +117,9 @@ class DiskLocation:
                 raise ValueError(f"volume {vid} already exists")
             v = Volume(self.directory, collection, vid,
                        replica_placement=replica_placement
-                       or ReplicaPlacement(), ttl=ttl or EMPTY_TTL)
+                       or ReplicaPlacement(), ttl=ttl or EMPTY_TTL,
+                       needle_map_kind=self.needle_map_kind,
+                       fsync=self.fsync)
             self.volumes[vid] = v
             return v
 

@@ -149,8 +149,9 @@ class EcVolume:
         self.shards: dict[int, EcVolumeShard] = {}
         self.shard_locations: dict[int, list[str]] = {}  # shard id -> addrs
         self.remote_reader: Optional[ShardReader] = None
-        self._encoder = encoder or codec_mod.new_encoder(
-            DATA_SHARDS_COUNT, TOTAL_SHARDS_COUNT - DATA_SHARDS_COUNT)
+        # lazy: backend selection probes device availability, which must
+        # not stall mount/admin paths — only reconstruction needs it
+        self._encoder = encoder
         self._ecx_lock = threading.Lock()
         self._ecj_lock = threading.Lock()
         base = self.base_file_name()
@@ -279,6 +280,9 @@ class EcVolume:
             raise EcError(
                 f"need {DATA_SHARDS_COUNT} shards to recover shard "
                 f"{target_shard}, only {have} available")
+        if self._encoder is None:
+            self._encoder = codec_mod.new_encoder(
+                DATA_SHARDS_COUNT, TOTAL_SHARDS_COUNT - DATA_SHARDS_COUNT)
         restored = self._encoder.reconstruct(shards)
         return np.ascontiguousarray(restored[target_shard]).tobytes()
 

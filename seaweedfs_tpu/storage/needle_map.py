@@ -161,6 +161,12 @@ class BaseNeedleMap:
         if self._index_file is not None:
             self._index_file.flush()
 
+    def sync(self):
+        """Durably flush the .idx append log (fsync write path)."""
+        if self._index_file is not None:
+            self._index_file.flush()
+            os.fsync(self._index_file.fileno())
+
     def close(self):
         if self._index_file is not None:
             self._index_file.flush()

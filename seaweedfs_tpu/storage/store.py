@@ -25,9 +25,12 @@ class Store:
                  max_volume_counts: Optional[list[int]] = None,
                  ip: str = "127.0.0.1", port: int = 0,
                  public_url: str = "", data_center: str = "",
-                 rack: str = "", ec_encoder_backend=None):
+                 rack: str = "", ec_encoder_backend=None,
+                 needle_map_kind: str = "memory", fsync: bool = False):
         counts = max_volume_counts or [8] * len(directories)
-        self.locations = [DiskLocation(d, c)
+        self.locations = [DiskLocation(d, c,
+                                       needle_map_kind=needle_map_kind,
+                                       fsync=fsync)
                           for d, c in zip(directories, counts)]
         for loc in self.locations:
             loc.load_existing_volumes()

@@ -47,15 +47,79 @@ class CookieMismatchError(VolumeError):
     pass
 
 
+class _FsyncBatcher:
+    """Group-commit fsync worker (volume_write.go:233-306 semantics):
+    writers append under the volume lock, then park here until one fsync
+    covers their append — N concurrent writers share a single fsync
+    instead of paying one each."""
+
+    def __init__(self, sync_fn):
+        self._sync_fn = sync_fn
+        self._cond = threading.Condition()
+        self._pending = 0
+        self._synced = 0
+        self._failed_upto = 0
+        self._error: Optional[Exception] = None
+        self._closed = False
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def wait_durable(self):
+        with self._cond:
+            self._pending += 1
+            ticket = self._pending
+            self._cond.notify_all()
+            while (self._synced < ticket and self._failed_upto < ticket
+                   and not self._closed):
+                self._cond.wait(1.0)
+            if self._synced < ticket and self._failed_upto >= ticket:
+                # the group commit covering this write failed: surface it
+                # to the writer instead of acknowledging a lost write
+                raise VolumeError(f"fsync failed: {self._error}")
+
+    def _run(self):
+        while True:
+            with self._cond:
+                while self._pending <= max(self._synced,
+                                           self._failed_upto) \
+                        and not self._closed:
+                    self._cond.wait(0.5)
+                if self._closed:
+                    return
+                target = self._pending
+            try:
+                self._sync_fn()  # outside the condition: appends continue
+            except Exception as e:
+                # a dead worker must never strand waiters: fail only the
+                # tickets this batch covered and keep serving later ones
+                # (the next sync may succeed, e.g. after ENOSPC clears)
+                with self._cond:
+                    self._error = e
+                    self._failed_upto = target
+                    self._cond.notify_all()
+                continue
+            with self._cond:
+                self._synced = target
+                self._cond.notify_all()
+
+    def close(self):
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        self._thread.join(timeout=5)
+
+
 class Volume:
     def __init__(self, directory: str, collection: str, vid: int,
                  replica_placement: Optional[ReplicaPlacement] = None,
                  ttl: TTL = EMPTY_TTL, preallocate: int = 0,
-                 needle_map_kind: str = "memory"):
+                 needle_map_kind: str = "memory", fsync: bool = False):
         self.dir = directory
         self.collection = collection
         self.id = vid
         self.needle_map_kind = needle_map_kind
+        self.fsync = fsync
+        self._batcher: Optional[_FsyncBatcher] = None
         self.lock = threading.RLock()
         self.data: Optional[DiskFile] = None
         self.nm: Optional[NeedleMap] = None
@@ -250,7 +314,11 @@ class Volume:
                 self.nm.put(n.id, offset, n.size)
             if n.last_modified > self.last_modified_ts:
                 self.last_modified_ts = n.last_modified
-            return offset, n.size, False
+        if self.fsync:
+            # outside the lock: other writers append while this one waits
+            # for the shared group-commit fsync
+            self._fsync_batcher().wait_durable()
+        return offset, n.size, False
 
     def delete_needle(self, n: Needle) -> int:
         """Tombstone-append; returns the freed size (0 if absent)."""
@@ -267,7 +335,9 @@ class Volume:
             offset = self.data.append(blob)
             self.last_append_at_ns = n.append_at_ns
             self.nm.delete(n.id, offset)
-            return size
+        if self.fsync:
+            self._fsync_batcher().wait_durable()
+        return size
 
     # -- read ----------------------------------------------------------------
     def read_needle(self, nid: int, cookie: Optional[int] = None) -> Needle:
@@ -448,12 +518,29 @@ class Volume:
                         nid, 0, t.TOMBSTONE_FILE_SIZE))
 
     # -- lifecycle -----------------------------------------------------------
+    def _fsync_batcher(self) -> _FsyncBatcher:
+        with self.lock:
+            if self._batcher is None:
+                self._batcher = _FsyncBatcher(self._durable_sync)
+            return self._batcher
+
+    def _durable_sync(self):
+        """One group commit: .dat fsync + .idx flush+fsync — an
+        acknowledged write must survive a host crash, so the index entry
+        must be as durable as the data it points at."""
+        with self.lock:
+            self.nm.sync()
+            self.data.sync()
+
     def sync(self):
         with self.lock:
             self.nm.flush()
             self.data.sync()
 
     def close(self):
+        if self._batcher is not None:
+            self._batcher.close()
+            self._batcher = None
         with self.lock:
             if self.nm is not None:
                 self.nm.close()

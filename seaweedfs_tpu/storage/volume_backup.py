@@ -103,6 +103,64 @@ def read_appended_bytes(v: Volume, since_ns: int,
     return blob[:pos], cursor
 
 
+def iter_appended_bytes(v: Volume, since_ns: int, limit: int = 64 << 20,
+                        chunk_size: int = 4 << 20):
+    """Streaming read_appended_bytes: -> (chunk iterator, length, cursor).
+
+    The record boundary and resume cursor are found by a header-only walk
+    (pread of each needle header, skipping the data), so the server never
+    buffers the payload; chunks are then read lazily.
+
+    The walk and chunk reads go through a dedicated fd opened on the .dat
+    PATH while the volume lock is held: a vacuum commit that os.replace()s
+    the .dat mid-stream leaves this fd on the old inode, so the stream
+    stays internally consistent instead of serving bytes from the new,
+    differently-laid-out file.  Non-file backends (tiered volumes) fall
+    back to one locked buffered read."""
+    dat_path = v.file_name(".dat")
+    with v.lock:
+        if not os.path.exists(dat_path):
+            blob, cursor = read_appended_bytes(v, since_ns, limit)
+            return iter([blob]), len(blob), cursor
+        f = open(dat_path, "rb")
+        start = binary_search_by_append_at_ns(v, since_ns)
+        end = min(v.data.size(), start + limit)
+    version = v.version
+    fd = f.fileno()
+    pos = start
+    cursor = since_ns
+    while pos + t.NEEDLE_HEADER_SIZE <= end:
+        header = os.pread(fd, t.NEEDLE_HEADER_SIZE, pos)
+        if len(header) < t.NEEDLE_HEADER_SIZE:
+            break
+        n, _ = read_needle_header(header)
+        size = max(n.size, 0)  # tombstones carry no data
+        actual = get_actual_size(size, version)
+        if pos + actual > end:
+            break
+        ts_off = pos + t.NEEDLE_HEADER_SIZE + size + t.NEEDLE_CHECKSUM_SIZE
+        ts = os.pread(fd, t.TIMESTAMP_SIZE, ts_off)
+        cursor = int.from_bytes(ts, "big")
+        pos += actual
+    length = pos - start
+
+    def gen():
+        try:
+            at = start
+            left = length
+            while left > 0:
+                chunk = os.pread(fd, min(chunk_size, left), at)
+                if not chunk:
+                    return
+                at += len(chunk)
+                left -= len(chunk)
+                yield chunk
+        finally:
+            f.close()
+
+    return gen(), length, cursor
+
+
 def replay_appended_bytes(v: Volume, blob: bytes) -> int:
     """Append raw needle records fetched from a replica, updating the
     index (tombstones delete).  Returns the number of records applied."""

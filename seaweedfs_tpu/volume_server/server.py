@@ -23,7 +23,8 @@ import threading
 import time
 from typing import Optional
 
-from ..rpc.http_rpc import Request, Response, RpcError, RpcServer, call
+from ..rpc.http_rpc import (Request, Response, RpcError, RpcServer, call,
+                            call_stream, stream_file)
 from ..security import Guard, gen_write_jwt, token_from_request
 from ..stats import metrics as stats
 from ..storage import types as t
@@ -38,7 +39,99 @@ from ..storage.store import Store
 from ..storage.volume import (CookieMismatchError, DeletedError,
                               NotFoundError, VolumeError)
 
-EC_SHARD_CACHE_TTL = 11.0  # seconds (store_ec.go:241 first tier)
+# EC shard-location cache freshness tiers (store_ec.go:227-268): a lookup
+# that errored or found too few shards to reconstruct stays fresh only
+# briefly; an incomplete-but-usable set refreshes at a medium cadence; a
+# full set is trusted for a long window.
+EC_SHARD_CACHE_TTL_ERROR = 11.0
+EC_SHARD_CACHE_TTL_INCOMPLETE = 7 * 60.0
+EC_SHARD_CACHE_TTL_HEALTHY = 37 * 60.0
+
+
+class _InflightGate:
+    """In-flight byte throttle (volume_server.go:21-50 cond-var limits).
+
+    Bounds the bytes concurrently being PROCESSED by upload/download
+    handlers; the HTTP substrate has already buffered the request body by
+    routing time, so this caps needle assembly + replication fan-out
+    concurrency rather than socket buffering.  Zero limit = unlimited."""
+
+    def __init__(self, limit_bytes: int, timeout: float = 30.0):
+        self.limit = limit_bytes
+        self.timeout = timeout
+        self._current = 0
+        self._cond = threading.Condition()
+
+    def acquire(self, n: int, timeout: float = None) -> bool:
+        if self.limit <= 0:
+            return True
+        deadline = time.monotonic() + (
+            self.timeout if timeout is None else timeout)
+        with self._cond:
+            # a single oversized request may exceed the limit when alone
+            while self._current > 0 and self._current + n > self.limit:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                self._cond.wait(left)
+            self._current += n
+            return True
+
+    def release(self, n: int):
+        if self.limit <= 0:
+            return
+        with self._cond:
+            self._current -= n
+            self._cond.notify_all()
+
+
+def _parse_range(header: str, total: int):
+    """Parse a Range header against an entity of `total` bytes
+    (volume_server_handlers_read.go:238 processRangeRequest).
+
+    -> (start, end_exclusive) for a single satisfiable range, None when
+    unsatisfiable (caller replies 416), Ellipsis to ignore the header and
+    serve the full entity (malformed or multi-range)."""
+    if not header.startswith("bytes="):
+        return ...
+    spec = header[len("bytes="):]
+    if "," in spec:  # multi-range: legal to ignore and serve 200
+        return ...
+    start_s, _, end_s = spec.partition("-")
+    try:
+        if start_s == "":
+            n = int(end_s)  # suffix form: last n bytes
+            if n <= 0:
+                return None
+            return max(0, total - n), total
+        start = int(start_s)
+        end = int(end_s) + 1 if end_s else total
+    except ValueError:
+        return ...
+    if start >= total or start < 0 or end <= start:
+        return None
+    return start, min(end, total)
+
+
+_GZIPPABLE_MIME = ("text/", "application/json", "application/javascript",
+                   "application/xml", "application/xhtml", "image/svg")
+_GZIPPABLE_EXT = (".txt", ".htm", ".html", ".css", ".js", ".json", ".xml",
+                  ".csv", ".svg", ".md", ".log", ".conf", ".yaml", ".yml")
+
+
+def _is_gzippable(name: bytes, mime: bytes) -> bool:
+    """Compressibility heuristic (util/http/compression.go IsGzippable):
+    by mime family first, by filename extension otherwise."""
+    m = mime.decode(errors="replace").lower()
+    if m:
+        if any(m.startswith(p) for p in _GZIPPABLE_MIME):
+            return True
+        if m == "application/octet-stream":
+            pass  # fall through to the extension check
+        else:
+            return False
+    n = name.decode(errors="replace").lower()
+    return any(n.endswith(e) for e in _GZIPPABLE_EXT)
 
 
 class VolumeServer:
@@ -48,7 +141,14 @@ class VolumeServer:
                  rack: str = "", max_volume_counts: Optional[list[int]] = None,
                  pulse_seconds: float = 5.0, ec_encoder_backend=None,
                  guard: Optional[Guard] = None, tier_backends=None,
-                 enable_tcp: bool = False):
+                 enable_tcp: bool = False, read_mode: str = "proxy",
+                 needle_map_kind: str = "memory", fsync: bool = False,
+                 upload_limit_mb: int = 0, download_limit_mb: int = 0):
+        if read_mode not in ("local", "proxy", "redirect"):
+            raise ValueError(f"unknown readMode {read_mode!r}")
+        self.read_mode = read_mode
+        self.upload_gate = _InflightGate(upload_limit_mb << 20)
+        self.download_gate = _InflightGate(download_limit_mb << 20)
         self.enable_tcp = enable_tcp
         self._tcp_sock = None
         # tier backends must be registered before Store discovery so
@@ -69,7 +169,8 @@ class VolumeServer:
             directories, max_volume_counts, ip=host,
             port=self.server.port, public_url=public_url,
             data_center=data_center, rack=rack,
-            ec_encoder_backend=ec_encoder_backend)
+            ec_encoder_backend=ec_encoder_backend,
+            needle_map_kind=needle_map_kind, fsync=fsync)
         self._stop = threading.Event()
         self._heartbeat_thread: Optional[threading.Thread] = None
         self._ec_locations: dict[int, tuple[float, dict[int, list[str]]]] = {}
@@ -401,14 +502,21 @@ class VolumeServer:
                     raise RpcError(str(e), 401)
             stats.VolumeServerRequestCounter.labels("read").inc()
             with stats.VolumeServerRequestHistogram.labels("read").time():
-                return self._read_object(vid, nid, cookie, method)
+                return self._read_object(vid, nid, cookie, method, req, fid)
         if method in ("POST", "PUT"):
             # JWT check before any byte is written
             # (volume_server_handlers_write.go:30-38)
             self._check_write_auth(req, fid)
             stats.VolumeServerRequestCounter.labels("write").inc()
-            with stats.VolumeServerRequestHistogram.labels("write").time():
-                return self._write_object(vid, nid, cookie, req)
+            n_bytes = len(req.body)
+            if not self.upload_gate.acquire(n_bytes):
+                raise RpcError("too many requests: upload limit", 429)
+            try:
+                with stats.VolumeServerRequestHistogram.labels(
+                        "write").time():
+                    return self._write_object(vid, nid, cookie, req)
+            finally:
+                self.upload_gate.release(n_bytes)
         if method == "DELETE":
             self._check_write_auth(req, fid)
             stats.VolumeServerRequestCounter.labels("delete").inc()
@@ -422,7 +530,13 @@ class VolumeServer:
         except PermissionError as e:
             raise RpcError(str(e), 401)
 
-    def _read_object(self, vid: int, nid: int, cookie: int, method: str):
+    def _read_object(self, vid: int, nid: int, cookie: int, method: str,
+                     req: Request, fid: str):
+        if (self.store.find_volume(vid) is None
+                and self.store.find_ec_volume(vid) is None):
+            # volume not local: readMode local|proxy|redirect
+            # (volume_server_handlers_read.go:30-70)
+            return self._read_nonlocal(vid, method, req, fid)
         try:
             n = self.store.read_needle(vid, nid, cookie=cookie)
         except (NotFoundError, EcNotFoundError):
@@ -431,26 +545,130 @@ class VolumeServer:
             raise RpcError("already deleted", 404)
         except (CookieMismatchError,) as e:
             raise RpcError(str(e), 404)
-        headers = {"Etag": f'"{n.etag()}"'}
+        if not self.download_gate.acquire(len(n.data)):
+            raise RpcError("too many requests: download limit", 429)
+        try:
+            return self._build_read_response(n, method, req)
+        finally:
+            self.download_gate.release(len(n.data))
+
+    def _build_read_response(self, n: Needle, method: str, req: Request):
+        headers = {"Etag": f'"{n.etag()}"', "Accept-Ranges": "bytes"}
         if n.has_name:
             headers["X-File-Name"] = n.name.decode(errors="replace")
         if n.last_modified:
             headers["X-Last-Modified"] = str(n.last_modified)
         content_type = (n.mime.decode(errors="replace") if n.has_mime
                         else "application/octet-stream")
+
+        data = n.data
+        range_header = req.headers.get("Range")
+        if n.is_compressed:
+            accepts_gzip = "gzip" in (
+                req.headers.get("Accept-Encoding") or "")
+            if accepts_gzip and not range_header:
+                # pass the stored gzip bytes through untouched
+                # (volume_server_handlers_read.go:180-199 semantics)
+                headers["Content-Encoding"] = "gzip"
+            else:
+                import gzip as _gzip
+
+                data = _gzip.decompress(data)
+        status = 200
+        if range_header and "Content-Encoding" not in headers:
+            sliced = _parse_range(range_header, len(data))
+            if sliced is None:
+                return Response(
+                    b"", 416, content_type,
+                    {"Content-Range": f"bytes */{len(data)}"})
+            if sliced is not ...:  # a single satisfiable range
+                start, end = sliced
+                headers["Content-Range"] = (
+                    f"bytes {start}-{end - 1}/{len(data)}")
+                data = data[start:end]
+                status = 206
         if method == "HEAD":
             # entity size, not body size (the handler sends no body)
-            headers["Content-Length"] = str(len(n.data))
-            return Response(b"", 200, content_type, headers)
-        return Response(n.data, 200, content_type, headers)
+            headers["Content-Length"] = str(len(data))
+            return Response(b"", status, content_type, headers)
+        return Response(data, status, content_type, headers)
+
+    def _read_nonlocal(self, vid: int, method: str, req: Request,
+                       fid: str):
+        """Non-local read: 404 (local), 302 to a holder (redirect), or
+        fetch-and-relay (proxy) — volume_server_handlers_read.go:30,303."""
+        if self.read_mode == "local":
+            raise RpcError(f"volume {vid} not found locally "
+                           "(readMode=local)", 404)
+        if req.headers.get("X-SW-Proxied"):
+            # already one proxy hop away: never proxy a proxy (stale
+            # master lookups could otherwise ping-pong two non-holders
+            # until threads exhaust)
+            raise RpcError(f"volume {vid} not found at proxy target", 404)
+        try:
+            lookup = call(self.master_address,
+                          f"/dir/lookup?volumeId={vid}", timeout=10)
+        except RpcError:
+            lookup = {}
+        others = [loc for loc in lookup.get("locations", [])
+                  if loc["url"] != self.store.url]
+        if not others:
+            raise RpcError(f"volume {vid} has no other locations", 404)
+        target = others[0]
+        if self.read_mode == "redirect":
+            public = target.get("publicUrl") or target["url"]
+            return Response(b"", 302, headers={
+                "Location": f"http://{public}/{fid}"})
+        # proxy: forward the read (with range/encoding negotiation) and
+        # relay status + entity headers
+        import urllib.error
+        import urllib.request
+
+        fwd = urllib.request.Request(
+            f"http://{target['url']}/{fid}", method=method)
+        fwd.add_header("X-SW-Proxied", "1")
+        for h in ("Range", "Accept-Encoding", "Authorization"):
+            if req.headers.get(h):
+                fwd.add_header(h, req.headers[h])
+        try:
+            with urllib.request.urlopen(fwd, timeout=30) as resp:
+                body = resp.read()
+                relay = {k: v for k, v in resp.headers.items()
+                         if k in ("Etag", "Content-Range",
+                                  "Content-Encoding", "X-File-Name",
+                                  "X-Last-Modified", "Accept-Ranges")}
+                return Response(
+                    body, resp.status,
+                    resp.headers.get("Content-Type",
+                                     "application/octet-stream"), relay)
+        except urllib.error.HTTPError as e:
+            raise RpcError(f"proxied read failed: {e}", e.code)
+        except OSError as e:
+            raise RpcError(f"proxied read failed: {e}", 502)
 
     def _write_object(self, vid: int, nid: int, cookie: int, req: Request):
         is_replicate = req.param("type") == "replicate"
+        name = (req.headers.get("X-File-Name") or "").encode()
+        mime = (req.headers.get("Content-Type") or "").encode()
+        body = req.body
+        is_compressed = (req.headers.get("Content-Encoding") or "") == "gzip"
+        if not is_compressed and _is_gzippable(name, mime) \
+                and len(body) > 128:
+            # store-side gzip when it pays (CreateNeedleFromRequest,
+            # needle.go:100; util.MaybeGzipData).  mtime=0 keeps the
+            # bytes deterministic so replicas dedup identically.
+            import gzip as _gzip
+
+            packed = _gzip.compress(body, 6, mtime=0)
+            if len(packed) < len(body) * 9 // 10:
+                body = packed
+                is_compressed = True
         n = Needle.create(
-            req.body,
-            name=(req.headers.get("X-File-Name") or "").encode(),
-            mime=(req.headers.get("Content-Type") or "").encode(),
+            body,
+            name=name,
+            mime=mime,
             last_modified=int(time.time()),
+            is_compressed=is_compressed,
         )
         n.id, n.cookie = nid, cookie
         try:
@@ -494,7 +712,8 @@ class VolumeServer:
         # case-insensitively or replicas silently lose mime/filename
         lowered = {k.lower(): v for k, v in headers.items()}
         headers = {canonical: lowered[canonical.lower()]
-                   for canonical in ("Content-Type", "X-File-Name")
+                   for canonical in ("Content-Type", "X-File-Name",
+                                     "Content-Encoding")
                    if canonical.lower() in lowered}
         if self.guard.signing:
             # replicas share security.toml; re-sign for the fan-out hop
@@ -588,21 +807,22 @@ class VolumeServer:
         try:
             for ext in (".idx", ".dat", ".vif"):
                 try:
-                    data = call(source,
-                                f"/admin/ec/shard_file?volume={vid}"
-                                f"&collection={collection}&ext={ext}",
-                                timeout=600)
+                    chunks = call_stream(
+                        source,
+                        f"/admin/ec/shard_file?volume={vid}"
+                        f"&collection={collection}&ext={ext}",
+                        timeout=600)
                 except RpcError as e:
                     if e.status == 404 and ext == ".vif":
                         continue
                     raise
-                if isinstance(data, dict):
-                    raise RpcError(f"unexpected response for {ext}", 500)
                 with open(base + ext + ".cpy", "wb") as f:
-                    f.write(data)
+                    for chunk in chunks:
+                        f.write(chunk)
                 fetched.append(ext)
-        except RpcError:
-            for ext in fetched:
+        except Exception:
+            # RpcError before the first byte OR a mid-stream socket error
+            for ext in (".idx", ".dat", ".vif"):
                 try:
                     os.remove(base + ext + ".cpy")
                 except FileNotFoundError:
@@ -631,12 +851,18 @@ class VolumeServer:
         }
 
     def _h_volume_tail(self, req: Request):
-        """VolumeTailSender: raw needle records appended after since_ns."""
+        """VolumeTailSender: raw needle records appended after since_ns,
+        streamed (volume_grpc_tail.go sends 64 KB frames); the resume
+        cursor rides a header computed from a header-only walk before the
+        body starts."""
         v = self._volume_or_404(int(req.param("volume", "0")))
         since_ns = int(req.param("since_ns", "0"))
         limit = int(req.param("limit", str(64 << 20)))
-        blob, last_ns = volume_backup.read_appended_bytes(v, since_ns, limit)
-        return Response(blob, headers={"X-Last-Append-At-Ns": str(last_ns)})
+        chunks, length, last_ns = volume_backup.iter_appended_bytes(
+            v, since_ns, limit)
+        return Response(chunks, headers={
+            "X-Last-Append-At-Ns": str(last_ns),
+            "Content-Length": str(length)})
 
     def _h_volume_sync(self, req: Request):
         """VolumeIncrementalCopy client side: catch this replica up from a
@@ -657,19 +883,27 @@ class VolumeServer:
 
     def _h_volume_read_all(self, req: Request):
         """ReadAllNeedles: stream every live needle's metadata as NDJSON
-        (volume_grpc_read_all.go; drives volume.fsck)."""
+        (volume_grpc_read_all.go; drives volume.fsck).  Chunked transfer:
+        a billion-needle volume streams without server-side buffering."""
         v = self._volume_or_404(int(req.param("volume", "0")))
         include_deleted = req.param("deleted") == "true"
-        lines = []
-        for n, offset in v.scan():
-            if not include_deleted and not n.data and n.size == 0:
-                continue
-            lines.append(json.dumps({
-                "id": n.id, "cookie": n.cookie, "size": len(n.data),
-                "offset": offset, "crc": n.checksum,
-                "append_at_ns": n.append_at_ns}))
-        return Response(("\n".join(lines) + "\n").encode(),
-                        content_type="application/x-ndjson")
+
+        def gen():
+            batch: list[str] = []
+            for n, offset in v.scan():
+                if not include_deleted and not n.data and n.size == 0:
+                    continue
+                batch.append(json.dumps({
+                    "id": n.id, "cookie": n.cookie, "size": len(n.data),
+                    "offset": offset, "crc": n.checksum,
+                    "append_at_ns": n.append_at_ns}))
+                if len(batch) >= 512:
+                    yield ("\n".join(batch) + "\n").encode()
+                    batch.clear()
+            if batch:
+                yield ("\n".join(batch) + "\n").encode()
+
+        return Response(gen(), content_type="application/x-ndjson")
 
     def _h_batch_delete(self, req: Request):
         """BatchDelete (volume_grpc_batch_delete.go): many fids, one call.
@@ -739,20 +973,35 @@ class VolumeServer:
         exts = [to_ext(int(s)) for s in p.get("shard_ids", [])]
         if p.get("copy_ecx_file", True):
             exts += [".ecx", ".ecj", ".vif"]
-        for ext in exts:
-            try:
-                data = call(
-                    source,
-                    f"/admin/ec/shard_file?volume={vid}"
-                    f"&collection={collection}&ext={ext}", timeout=600)
-            except RpcError as e:
-                if e.status == 404 and ext in (".ecj", ".vif"):
-                    continue  # optional sidecars
-                raise
-            if isinstance(data, dict):
-                raise RpcError(f"unexpected response for {ext}", 500)
-            with open(base + ext, "wb") as f:
-                f.write(data)
+        # stream to temp names, rename when complete: a mid-transfer
+        # failure must never leave a truncated shard to be mounted later
+        fetched: list[str] = []
+        try:
+            for ext in exts:
+                try:
+                    chunks = call_stream(
+                        source,
+                        f"/admin/ec/shard_file?volume={vid}"
+                        f"&collection={collection}&ext={ext}", timeout=600)
+                except RpcError as e:
+                    if e.status == 404 and ext in (".ecj", ".vif"):
+                        continue  # optional sidecars
+                    raise
+                with open(base + ext + ".cpy", "wb") as f:
+                    for chunk in chunks:
+                        f.write(chunk)
+                fetched.append(ext)
+        except Exception:
+            # RpcError before the first byte OR a mid-stream socket error:
+            # remove every temp, including the partial in-progress one
+            for ext in exts:
+                try:
+                    os.remove(base + ext + ".cpy")
+                except FileNotFoundError:
+                    pass
+            raise
+        for ext in fetched:
+            os.replace(base + ext + ".cpy", base + ext)
         return {}
 
     def _h_ec_delete_shards(self, req: Request):
@@ -813,8 +1062,9 @@ class VolumeServer:
         for loc in self.store.locations:
             path = loc._base_name(collection, vid) + ext
             if os.path.exists(path):
-                with open(path, "rb") as f:
-                    return f.read()
+                # stream with a fixed-size snapshot: a 30 GB volume moves
+                # chunk by chunk (doCopyFile semantics, volume_grpc_copy.go)
+                return stream_file(path)
         raise RpcError(f"{vid}{ext} not found", 404)
 
     def _h_ec_shard_read(self, req: Request):
@@ -846,14 +1096,32 @@ class VolumeServer:
                         return bytes(data)
                 except RpcError:
                     continue
+            # all candidates failed: demote the cache entry to the
+            # error tier so the next read re-resolves quickly
+            self._note_ec_lookup_error(vid)
             return None
         return remote_reader
 
+    def _note_ec_lookup_error(self, vid: int):
+        cached = self._ec_locations.get(vid)
+        if cached is not None:
+            self._ec_locations[vid] = (cached[0], cached[1], True)
+
     def _ec_shard_locations(self, vid: int) -> dict[int, list[str]]:
+        """Tiered-freshness shard location cache
+        (cachedLookupEcShardLocations, store_ec.go:227-268)."""
         now = time.time()
         cached = self._ec_locations.get(vid)
-        if cached is not None and now - cached[0] < EC_SHARD_CACHE_TTL:
-            return cached[1]
+        if cached is not None:
+            fetched_at, locations, had_error = cached
+            if had_error:
+                ttl = EC_SHARD_CACHE_TTL_ERROR
+            elif len(locations) < TOTAL_SHARDS_COUNT:
+                ttl = EC_SHARD_CACHE_TTL_INCOMPLETE
+            else:
+                ttl = EC_SHARD_CACHE_TTL_HEALTHY
+            if now - fetched_at < ttl:
+                return locations
         try:
             resp = call(self.master_address, f"/ec/lookup?volumeId={vid}",
                         timeout=10)
@@ -861,9 +1129,11 @@ class VolumeServer:
                 e["shard_id"]: [loc["url"] for loc in e["locations"]]
                 for e in resp.get("shard_id_locations", [])
             }
+            had_error = False
         except RpcError:
             locations = cached[1] if cached else {}
-        self._ec_locations[vid] = (now, locations)
+            had_error = True
+        self._ec_locations[vid] = (now, locations, had_error)
         return locations
 
     def _try_heartbeat(self):

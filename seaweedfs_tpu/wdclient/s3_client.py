@@ -44,11 +44,7 @@ class S3Client:
         }
         signed = ["host", "x-amz-content-sha256", "x-amz-date"]
         canonical_uri = urllib.parse.quote(path, safe="/~")
-        q_pairs = sorted(
-            (urllib.parse.quote(k, safe="~"),
-             urllib.parse.quote(str(v), safe="~"))
-            for k, v in query.items())
-        canonical_query = "&".join(f"{k}={v}" for k, v in q_pairs)
+        canonical_query = self._canonical_query(query)
         lower = {k.lower(): v for k, v in headers.items()}
         header_lines = [f"{name}:{' '.join(lower[name].split())}"
                         for name in signed]
@@ -73,6 +69,17 @@ class S3Client:
             f"SignedHeaders={';'.join(signed)}, Signature={signature}")
         return headers
 
+    @staticmethod
+    def _canonical_query(query: dict) -> str:
+        """AWS canonical query: sorted pairs, %20 percent-encoding (never
+        urlencode's '+', which decodes as a space but signs as a literal
+        plus).  The SAME string is signed and sent, by construction."""
+        return "&".join(
+            f"{k}={v}" for k, v in sorted(
+                (urllib.parse.quote(k, safe="~"),
+                 urllib.parse.quote(str(v), safe="~"))
+                for k, v in query.items()))
+
     def _request(self, method: str, path: str,
                  query: Optional[dict] = None, body: bytes = b"",
                  content_type: str = "", parse: bool = True):
@@ -80,8 +87,7 @@ class S3Client:
         headers = self._sign(method, path, query, body)
         if content_type:
             headers["Content-Type"] = content_type
-        qs = urllib.parse.urlencode(query)
-        # send the same quoted path the signature canonicalises
+        qs = self._canonical_query(query)
         full = urllib.parse.quote(path, safe="/~") + ("?" + qs if qs else "")
         return call(self.endpoint, full, raw=body if body else None,
                     method=method, headers=headers, timeout=120,

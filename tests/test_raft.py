@@ -249,3 +249,77 @@ class TestRaftMembershipChange:
              {"address": "127.0.0.1:19999"})
         assert wait_for(lambda: all(
             "127.0.0.1:19999" not in m.raft.peers for m in masters))
+
+
+class TestReplicatedLog:
+    def test_log_replicates_and_commits(self, trio):
+        assert wait_for(lambda: len(leaders(trio)) == 1)
+        leader = leaders(trio)[0]
+        vids = [leader.raft.next_volume_id() for _ in range(5)]
+        assert vids == sorted(set(vids))  # unique + monotonic
+        # followers converge on the committed FSM value
+        assert wait_for(lambda: all(
+            m.raft.max_volume_id == vids[-1] for m in trio))
+        follower = next(m for m in trio if not m.raft.is_leader)
+        assert follower.raft.commit_index >= leader.raft.snapshot_index
+
+    def test_snapshot_compacts_log(self, trio):
+        from seaweedfs_tpu.master import raft as raft_mod
+
+        assert wait_for(lambda: len(leaders(trio)) == 1)
+        leader = leaders(trio)[0]
+        n = raft_mod.SNAPSHOT_THRESHOLD + 10
+        last = 0
+        for _ in range(n):
+            last = leader.raft.next_volume_id()
+        r = leader.raft
+        assert r.snapshot_index > 0, "no snapshot taken"
+        assert len(r.log) < n, "log never compacted"
+        assert r.max_volume_id == last
+
+    def test_straggler_catches_up_via_snapshot(self, trio):
+        from seaweedfs_tpu.master import raft as raft_mod
+
+        assert wait_for(lambda: len(leaders(trio)) == 1)
+        leader = leaders(trio)[0]
+        straggler = next(m for m in trio if not m.raft.is_leader)
+        # isolate the straggler by dropping it from nothing — instead just
+        # stop its raft loop so it misses the next N commits
+        straggler.raft._stop.set()
+        straggler.raft._thread.join(timeout=5)
+        last = 0
+        for _ in range(raft_mod.SNAPSHOT_THRESHOLD + 20):
+            last = leader.raft.next_volume_id()
+        assert leader.raft.snapshot_index > 0
+        # revive: the next leader round ships the snapshot + tail
+        straggler.raft._stop.clear()
+        import threading as _t
+        straggler.raft._thread = _t.Thread(
+            target=straggler.raft._run, daemon=True)
+        straggler.raft._thread.start()
+        assert wait_for(
+            lambda: straggler.raft.max_volume_id == last, timeout=15)
+
+    def test_failed_quorum_does_not_return_id(self, tmp_path):
+        """With every peer down, allocation must raise — and the failed
+        value must never be handed out as a committed id later."""
+        ports = free_ports(3)
+        addrs = [f"127.0.0.1:{p}" for p in ports]
+        d = tmp_path / "solo"
+        d.mkdir()
+        m = MasterServer(port=ports[0], peers=addrs, raft_dir=str(d),
+                         raft_election_timeout=0.2, pulse_seconds=1.0)
+        m.start()
+        try:
+            # force leadership despite dead peers (term self-election will
+            # not reach quorum, so install leader state directly — the
+            # point is exercising the commit gate, not the election)
+            with m.raft.lock:
+                m.raft.state = "leader"
+                m.raft.leader = m.raft.address
+            with pytest.raises(RpcError):
+                m.raft.next_volume_id()
+            assert m.raft.max_volume_id == 0  # FSM never advanced
+            assert m.raft.commit_index == 0
+        finally:
+            m.stop()

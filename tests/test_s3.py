@@ -396,3 +396,44 @@ class TestSigV4Vectors:
             "577efef23edd43b7e1a59")
         assert sig == ("5d672d79c15b13162d9279b0855cfba"
                        "6789a8edb4c82c400e06b5924a6f2b5d7")
+
+
+class TestClientSigV4QueryEncoding:
+    """wdclient.s3_client must send exactly the %20-percent-encoded query
+    it signs — '+' decodes as a space but signs as a literal plus
+    (auth_signature_v4.go canonical query rules)."""
+
+    @pytest.fixture
+    def auth_stack(self, tmp_path):
+        master = MasterServer(port=0, pulse_seconds=0.2)
+        master.start()
+        d = tmp_path / "v"
+        d.mkdir()
+        vs = VolumeServer([str(d)], master.address, port=0,
+                          pulse_seconds=0.2)
+        vs.start()
+        vs.heartbeat_once()
+        filer = FilerServer(master.address, port=0)
+        filer.start()
+        s3 = S3ApiServer(filer, port=0, identities=[
+            Identity(name="admin", access_key="AKID", secret_key="SK"),
+        ])
+        s3.start()
+        yield s3
+        s3.stop()
+        filer.stop()
+        vs.stop()
+        master.stop()
+
+    def test_signed_list_with_space_in_prefix(self, auth_stack):
+        from seaweedfs_tpu.wdclient.s3_client import S3Client
+
+        client = S3Client(auth_stack.address, access_key="AKID",
+                          secret_key="SK")
+        client.create_bucket("docs")
+        client.put_object("docs", "my folder/a.txt", b"one")
+        client.put_object("docs", "my folder/b.txt", b"two")
+        client.put_object("docs", "other/c.txt", b"three")
+        got = client.list_objects("docs", prefix="my folder/")
+        assert sorted(o["key"] for o in got) == [
+            "my folder/a.txt", "my folder/b.txt"]

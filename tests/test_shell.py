@@ -69,8 +69,9 @@ class TestVolumeOps:
         heartbeat_all(servers)
         # data still readable from the new home
         assert call(dst.url, f"/{fid}") == b"x" * 100
-        with pytest.raises(RpcError):
-            call(src.url, f"/{fid}")
+        # the old home no longer holds the volume, but the default
+        # readMode=proxy forwards the read to the new holder
+        assert call(src.url, f"/{fid}") == b"x" * 100
 
     def test_balance_plan_and_apply(self, cluster):
         master, servers, env = cluster

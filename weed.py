@@ -115,7 +115,10 @@ def cmd_volume(args):
                       pulse_seconds=args.pulseSeconds,
                       guard=_load_guard(),
                       tier_backends=_parse_tier_backends(args.tier),
-                      enable_tcp=args.tcp)
+                      enable_tcp=args.tcp, read_mode=args.readMode,
+                      fsync=args.fsync, needle_map_kind=args.index,
+                      upload_limit_mb=args.concurrentUploadLimitMB,
+                      download_limit_mb=args.concurrentDownloadLimitMB)
     vs.start()
     print(f"volume server listening on {vs.address}, dirs={dirs}")
     _wait_forever([vs])
@@ -925,6 +928,19 @@ def main(argv=None):
                         "name=s3:endpoint[,ak,sk] (repeatable)")
     p.add_argument("-tcp", action="store_true",
                    help="serve the TCP read fast path on port+20000")
+    p.add_argument("-readMode", default="proxy",
+                   choices=["local", "proxy", "redirect"],
+                   help="how to serve reads of non-local volumes")
+    p.add_argument("-fsync", action="store_true",
+                   help="group-commit fsync before acknowledging writes")
+    p.add_argument("-index", default="memory",
+                   choices=["memory", "compact", "sqlite"],
+                   help="needle index kind (compact: 16 B/needle numpy "
+                        "arrays; sqlite: disk-backed)")
+    p.add_argument("-concurrentUploadLimitMB", type=int, default=0,
+                   help="in-flight upload byte throttle (0 = unlimited)")
+    p.add_argument("-concurrentDownloadLimitMB", type=int, default=0,
+                   help="in-flight download byte throttle (0 = unlimited)")
     p.set_defaults(fn=cmd_volume)
 
     p = sub.add_parser("filer", help="start a filer server")
