@@ -296,3 +296,132 @@ def encode_volumes(bases: list[str], large_block: Optional[int] = None,
     if errors:
         raise errors[0]
     return {p.base: writers[vi].crcs for vi, p in enumerate(plans)}
+
+
+def rebuild_matrix(present: list[int], missing: list[int],
+                   data_shards: int = DATA_SHARDS,
+                   total_shards: int = TOTAL_SHARDS):
+    """(survivor_ids, M) with M (len(missing) x data_shards) mapping the
+    chosen survivors directly to the missing shards: data rows come from
+    the inverted survivor submatrix, parity rows from encode-rows times
+    that inverse (the one-matmul form of klauspost Reconstruct)."""
+    from ..ops import gf256
+
+    full = gf256.build_matrix(data_shards, total_shards)
+    chosen = present[:data_shards]
+    inv = gf256.gf_invert(full[chosen])
+    rows = []
+    for m in missing:
+        if m < data_shards:
+            rows.append(inv[m])
+        else:
+            rows.append(gf256.gf_matmul(full[m:m + 1], inv)[0])
+    return chosen, np.stack(rows).astype(np.uint8)
+
+
+def rebuild_shards(base: str, mesh=None,
+                   batch_units: Optional[int] = None) -> dict[int, int]:
+    """Regenerate every missing .ecNN from survivors through the batched
+    device pipeline (RebuildEcFiles, ec_encoder.go:233-287 — the
+    reference loops 1 MB buffers through its CPU codec; here survivor
+    chunks batch into (B, 10, L) device dispatches with fused CRC32C of
+    the rebuilt shards).  Returns {shard_id: crc32c of the rebuilt file}.
+    """
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from ..ops import crc32c as crc_host
+    from ..ops.crc_device import finalize
+    from ..storage.erasure_coding import to_ext
+    from .mesh import make_mesh, make_sharded_apply
+
+    present = [i for i in range(TOTAL_SHARDS)
+               if os.path.exists(base + to_ext(i))]
+    missing = [i for i in range(TOTAL_SHARDS) if i not in present]
+    if not missing:
+        return {}
+    if len(present) < DATA_SHARDS:
+        raise ValueError(
+            f"too few shards to rebuild: {len(present)} < {DATA_SHARDS}")
+    chosen, matrix = rebuild_matrix(present, missing)
+    sizes = {os.path.getsize(base + to_ext(i)) for i in chosen}
+    if len(sizes) != 1:
+        raise ValueError(f"survivor shard sizes differ: {sorted(sizes)}")
+    shard_size = sizes.pop()
+    if shard_size == 0:
+        for sid in missing:
+            open(base + to_ext(sid), "wb").close()
+        return {sid: 0 for sid in missing}
+
+    chunk = min(MAX_CHUNK_BYTES, shard_size)
+    offsets = list(range(0, shard_size, chunk))
+
+    if mesh is None:
+        mesh = make_mesh()
+    n_data, n_block = mesh.devices.shape
+    if chunk % n_block:
+        mesh = Mesh(mesh.devices.reshape(-1, 1), mesh.axis_names)
+        n_data, n_block = mesh.devices.shape
+    if batch_units is None:
+        batch_units = max(1, TARGET_BATCH_BYTES // (DATA_SHARDS * chunk))
+    b = min(batch_units, len(offsets))
+    b = max(n_data, ((b + n_data - 1) // n_data) * n_data)
+
+    step = make_sharded_apply(mesh, matrix)
+    sharding = NamedSharding(mesh, P("data", None, "block"))
+
+    inputs = [open(base + to_ext(i), "rb") for i in chosen]
+    outputs = {sid: open(base + to_ext(sid), "wb") for sid in missing}
+    crcs = {sid: 0 for sid in missing}
+    try:
+        inflight: list = []
+
+        def drain_one():
+            batch_offs, out_dev, crc_dev = inflight.pop(0)
+            out = np.ascontiguousarray(np.asarray(out_dev))
+            raw = np.asarray(crc_dev)
+            for k, off in enumerate(batch_offs):
+                width = min(chunk, shard_size - off)
+                fin = finalize(raw[k], chunk)
+                for j, sid in enumerate(missing):
+                    outputs[sid].seek(off)
+                    outputs[sid].write(out[k, j, :width])
+                    # chunks are full except possibly the last; a short
+                    # final chunk was zero-padded on device, and CRCs of
+                    # zero-extended data un-extend via combine algebra
+                    chunk_crc = int(fin[j]) if width == chunk else \
+                        crc_host.crc32c(out[k, j, :width].tobytes())
+                    crcs[sid] = crc_host.crc32c_combine(
+                        crcs[sid], chunk_crc, width)
+            return None
+
+        # two staging buffers: a buffer is refilled only after its batch
+        # drained (which implies the host->device transfer completed)
+        bufs = [np.zeros((b, DATA_SHARDS, chunk), dtype=np.uint8)
+                for _ in range(2)]
+        for step_i, start in enumerate(range(0, len(offsets), b)):
+            buf = bufs[step_i % 2]
+            batch_offs = offsets[start:start + b]
+            for k, off in enumerate(batch_offs):
+                width = min(chunk, shard_size - off)
+                for i, f in enumerate(inputs):
+                    f.seek(off)
+                    view = memoryview(buf[k, i])[:width]
+                    got = f.readinto(view)
+                    if got < width:
+                        buf[k, i, got:width] = 0
+                    if width < chunk:
+                        buf[k, i, width:] = 0
+            dev = jax.device_put(buf, sharding)
+            out_dev, crc_dev = step(dev)
+            inflight.append((batch_offs, out_dev, crc_dev))
+            if len(inflight) >= 2:
+                drain_one()
+        while inflight:
+            drain_one()
+    finally:
+        for f in inputs:
+            f.close()
+        for f in outputs.values():
+            f.close()
+    return crcs

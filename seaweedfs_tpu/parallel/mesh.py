@@ -82,6 +82,46 @@ def batched_encode_step(bit_matrix, data):
 
 
 _ENCODER_CACHE: dict = {}
+_APPLY_CACHE: dict = {}
+
+
+def make_sharded_apply(mesh: Mesh, matrix: np.ndarray):
+    """jit-compiled batched GF(2^8) matrix application with fused CRC32C
+    over the OUTPUT rows: data (B, d, L) -> (out (B, k, L) uint8,
+    crc_raw (B, k) uint32).  The generalization of the encoder step that
+    rebuild uses with reconstruction matrices (survivors -> missing
+    shards; RebuildEcFiles, ec_encoder.go:233-287)."""
+    from ..ops.crc_device import batched_crc32c_raw
+
+    m = np.ascontiguousarray(matrix, dtype=np.uint8)
+    cache_key = (mesh, m.tobytes(), m.shape)
+    cached = _APPLY_CACHE.get(cache_key)
+    if cached is not None:
+        return cached
+    if len(_APPLY_CACHE) >= 32:
+        # bounded: there are C(14,1..4) ~ 1470 distinct reconstruction
+        # matrices — unbounded caching would pin a compiled executable
+        # per missing-shard pattern forever
+        _APPLY_CACHE.pop(next(iter(_APPLY_CACHE)))
+    bit_matrix = jnp.asarray(_bit_matrix_cached(*_matrix_key(m)))
+    data_sharding = NamedSharding(mesh, P("data", None, "block"))
+    out_shardings = (
+        NamedSharding(mesh, P("data", None, "block")),
+        NamedSharding(mesh, P("data", None)),
+    )
+
+    @functools.partial(
+        jax.jit,
+        in_shardings=(data_sharding,),
+        out_shardings=out_shardings,
+        donate_argnums=(0,),
+    )
+    def step(data):
+        out = _parity_bits_matmul(bit_matrix, data)
+        return out, batched_crc32c_raw(out)
+
+    _APPLY_CACHE[cache_key] = step
+    return step
 
 
 def make_sharded_encoder(mesh: Mesh, data_shards: int = 10,

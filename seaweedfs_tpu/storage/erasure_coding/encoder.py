@@ -114,17 +114,34 @@ def _encode_one_row(dat, encoder, block_size: int, outputs,
 
 
 def rebuild_ec_files(base_file_name: str, encoder=None,
-                     buffer_size: int = SMALL_BLOCK_SIZE) -> list[int]:
+                     buffer_size: int = SMALL_BLOCK_SIZE,
+                     batched: Optional[bool] = None) -> dict:
     """Regenerate missing .ecNN files from survivors
     (RebuildEcFiles/generateMissingEcFiles, ec_encoder.go:61-118,233-287).
-    Returns the generated shard ids."""
+    Returns {shard_id: crc32c-or-None} of the generated shards — CRCs
+    come fused from the device path, None from the host loop.
+
+    Default path (no explicit codec): the batched device pipeline —
+    survivor chunks stream through one reconstruction bit-matmul with
+    fused CRC32C (BASELINE config 3).  Falls back to the synchronous
+    host loop with an explicit `encoder`, batched=False, or an
+    unreachable JAX backend.
+    """
+    if batched is None:
+        from ...util.platform import jax_usable
+
+        batched = encoder is None and jax_usable()
+    if batched:
+        from ...parallel.batched_encode import rebuild_shards
+
+        return rebuild_shards(base_file_name)
     if encoder is None:
         encoder = codec_mod.new_encoder(DATA_SHARDS_COUNT, PARITY_SHARDS_COUNT)
     has_data = [os.path.exists(base_file_name + to_ext(i))
                 for i in range(TOTAL_SHARDS_COUNT)]
     generated = [i for i in range(TOTAL_SHARDS_COUNT) if not has_data[i]]
     if not generated:
-        return []
+        return {}
     inputs = {i: open(base_file_name + to_ext(i), "rb")
               for i in range(TOTAL_SHARDS_COUNT) if has_data[i]}
     outputs = {i: open(base_file_name + to_ext(i), "wb") for i in generated}
@@ -137,7 +154,7 @@ def rebuild_ec_files(base_file_name: str, encoder=None,
                 f.seek(offset)
                 buf = f.read(buffer_size)
                 if not buf:
-                    return generated
+                    return {i: None for i in generated}
                 if n == 0:
                     n = len(buf)
                 elif len(buf) != n:

@@ -182,12 +182,30 @@ class Store:
                 extra={"shard_crc32c": crc_map[base]})
 
     def ec_rebuild(self, vid: int, collection: str = "") -> list[int]:
-        """VolumeEcShardsRebuild: regenerate missing local shard files."""
+        """VolumeEcShardsRebuild: regenerate missing local shard files.
+
+        When the batched device path produced fused CRCs AND the .vif
+        records the original shard CRCs, the rebuilt values are VERIFIED
+        against the record — a correct rebuild reproduces the original
+        bytes, so a mismatch means a survivor is silently corrupt and the
+        rebuild is reported rather than laundered into the record."""
+        from .erasure_coding import TOTAL_SHARDS_COUNT
+
         loc = self.location_of(vid)
         base = (loc._base_name(collection, vid) if loc
                 else self.locations[0]._base_name(collection, vid))
-        return ec_encoder.rebuild_ec_files(base,
+        crcs = ec_encoder.rebuild_ec_files(base,
                                            encoder=self.ec_encoder_backend)
+        info = ec_encoder.load_volume_info(base) or {}
+        stored = info.get("shard_crc32c")
+        if isinstance(stored, list) and len(stored) == TOTAL_SHARDS_COUNT:
+            bad = [sid for sid, crc in crcs.items()
+                   if crc is not None and crc != stored[sid]]
+            if bad:
+                raise VolumeError(
+                    f"rebuilt shards {bad} of volume {vid} do not match "
+                    "the recorded CRCs — a survivor shard is corrupt")
+        return sorted(crcs)
 
     def ec_mount(self, collection: str, vid: int, shard_ids: list[int]):
         loc = self.location_of(vid) or self.locations[0]

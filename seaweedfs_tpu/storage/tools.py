@@ -124,3 +124,87 @@ def compact_offline(directory: str, collection: str, vid: int) -> dict:
         v.close()
     return {"volume": vid, "before_bytes": before, "after_bytes": after,
             "reclaimed": before - after}
+
+
+def scrub_ec_volume(directory: str, collection: str, vid: int,
+                    repair: bool = False) -> dict:
+    """Verify every local .ecNN against the CRC32Cs the batched encode
+    fused on device and persisted in the .vif sidecar (no reference
+    analogue — the reference has no stored shard checksums to scrub
+    against).  With repair=True, corrupt/missing shards are deleted and
+    regenerated from survivors via the batched rebuild pipeline.
+
+    Returns {"checked": [...], "corrupt": [...], "missing": [...],
+    "repaired": [...]}."""
+    from ..ops.crc32c import crc32c
+    from .erasure_coding import TOTAL_SHARDS_COUNT, to_ext
+    from .erasure_coding.encoder import load_volume_info
+
+    base = _base(directory, collection, vid)
+    info = load_volume_info(base) or {}
+    stored = info.get("shard_crc32c")
+    if not isinstance(stored, list) or len(stored) != TOTAL_SHARDS_COUNT:
+        raise ValueError(
+            f"{base}.vif has no shard_crc32c record to scrub against")
+    checked, corrupt, missing = [], [], []
+    for sid in range(TOTAL_SHARDS_COUNT):
+        path = base + to_ext(sid)
+        if not os.path.exists(path):
+            missing.append(sid)
+            continue
+        crc = 0
+        with open(path, "rb") as f:
+            while True:
+                chunk = f.read(4 << 20)
+                if not chunk:
+                    break
+                crc = crc32c(chunk, crc)
+        if crc == stored[sid]:
+            checked.append(sid)
+        else:
+            corrupt.append(sid)
+    repaired: list[int] = []
+    if repair and (corrupt or missing):
+        from .erasure_coding.encoder import rebuild_ec_files
+
+        if len(checked) < 10:  # DATA_SHARDS_COUNT clean survivors needed
+            raise ValueError(
+                f"only {len(checked)} clean shards — cannot rebuild "
+                f"{sorted(corrupt + missing)}; corrupt files left in place")
+        # move corrupt shards ASIDE (never destroy potentially-useful
+        # bytes before the rebuild is known to succeed)
+        for sid in corrupt:
+            os.replace(base + to_ext(sid), base + to_ext(sid) + ".corrupt")
+        try:
+            crcs = rebuild_ec_files(base)  # device path or host fallback
+        except Exception:
+            for sid in corrupt:  # restore the evidence
+                os.replace(base + to_ext(sid) + ".corrupt",
+                           base + to_ext(sid))
+            raise
+        # verify EVERY rebuilt shard against the record; host-path
+        # rebuilds (crc None) hash the produced file
+        bad = []
+        for sid, crc in crcs.items():
+            if crc is None:
+                crc = 0
+                with open(base + to_ext(sid), "rb") as f:
+                    while True:
+                        chunk = f.read(4 << 20)
+                        if not chunk:
+                            break
+                        crc = crc32c(chunk, crc)
+            if crc != stored[sid]:
+                bad.append(sid)
+        if bad:
+            for sid in corrupt:
+                os.replace(base + to_ext(sid) + ".corrupt",
+                           base + to_ext(sid))
+            raise ValueError(
+                f"rebuilt shards {bad} still mismatch the recorded CRCs "
+                "— survivors are corrupt beyond the stored checksums")
+        for sid in corrupt:
+            os.remove(base + to_ext(sid) + ".corrupt")
+        repaired = sorted(crcs)
+    return {"checked": checked, "corrupt": corrupt,
+            "missing": missing, "repaired": repaired}

@@ -194,13 +194,13 @@ class TestRebuild:
         for i in (2, 7, 11, 13):
             os.remove(base + to_ext(i))
         generated = enc.rebuild_ec_files(base)
-        assert sorted(generated) == [2, 7, 11, 13]
+        assert sorted(generated) == [2, 7, 11, 13]  # dict of sid -> crc
         for i in range(TOTAL_SHARDS_COUNT):
             assert open(base + to_ext(i), "rb").read() == golden[i], i
 
     def test_rebuild_noop_when_complete(self, encoded):
         base, _ = encoded
-        assert enc.rebuild_ec_files(base) == []
+        assert enc.rebuild_ec_files(base) == {}
 
 
 class TestEcxEcj:

@@ -780,6 +780,20 @@ def cmd_fix(args):
     print(f"rebuilt index from {count} records")
 
 
+def cmd_scrub(args):
+    """Verify local EC shards against the fused-CRC record in .vif; with
+    -repair, regenerate corrupt/missing shards from survivors."""
+    import json as _json
+
+    from seaweedfs_tpu.storage.tools import scrub_ec_volume
+
+    report = scrub_ec_volume(args.dir, args.collection, args.volumeId,
+                             repair=args.repair)
+    print(_json.dumps(report, indent=2))
+    if (report["corrupt"] or report["missing"]) and not args.repair:
+        raise SystemExit(1)  # degraded redundancy is not healthy
+
+
 def cmd_export(args):
     """Export a volume's live needles (weed/command/export.go)."""
     from seaweedfs_tpu.storage.tools import export_volume
@@ -1063,6 +1077,15 @@ def main(argv=None):
     p.add_argument("-volumeId", type=int, required=True)
     p.add_argument("-collection", default="")
     p.set_defaults(fn=cmd_fix)
+
+    p = sub.add_parser("scrub", help="verify EC shards against the CRCs "
+                       "recorded by the device-fused encode (.vif)")
+    p.add_argument("-dir", default=".")
+    p.add_argument("-volumeId", type=int, required=True)
+    p.add_argument("-collection", default="")
+    p.add_argument("-repair", action="store_true",
+                   help="rebuild corrupt/missing shards from survivors")
+    p.set_defaults(fn=cmd_scrub)
 
     p = sub.add_parser("export", help="export a volume's live needles")
     p.add_argument("-dir", default=".")
