@@ -136,19 +136,25 @@ def bench_tpu_kernel(method: str, length: int, block: int | None = None,
 
 
 def bench_hbm_fused(batch: int, length: int,
-                    chains: tuple[int, int] = (2, 6), reps: int = 2
-                    ) -> float:
+                    chains: tuple[int, int] = (2, 6), reps: int = 2,
+                    variant: str = "xla") -> float:
     """Slope throughput of the production batched step (parity + fused
-    CRC32C) on an HBM-resident (B, 10, L) batch."""
+    CRC32C) on an HBM-resident (B, 10, L) batch.  variant: "xla" (the
+    portable formulation) or "pallas" (the fused single-expansion
+    kernel)."""
     import jax
     import jax.numpy as jnp
 
     from seaweedfs_tpu.ops import gf256
     from seaweedfs_tpu.ops.rs_jax import _bit_matrix_cached, _matrix_key
+    from seaweedfs_tpu.ops.rs_pallas import fused_encode_pallas
     from seaweedfs_tpu.parallel.mesh import batched_encode_step
 
     matrix = gf256.parity_matrix(10, 14)
     bm = jnp.asarray(_bit_matrix_cached(*_matrix_key(matrix)))
+    if variant == "pallas":
+        def batched_encode_step(_, acc):  # noqa: F811 — same signature
+            return fused_encode_pallas(matrix, acc, interpret=False)
 
     @jax.jit
     def gen(key):
@@ -276,12 +282,17 @@ def main():
         kernel = bench_tpu_kernel(method, length, block=block)
 
     # -- HBM-resident fused batched step (parity + CRC) ----------------------
-    hbm_fused = 0.0
-    try:
-        b, length = (6, 1 << 20) if on_tpu else (6, 1 << 18)
-        hbm_fused = bench_hbm_fused(b, length)
-    except Exception as e:
-        print(f"note: hbm_fused failed: {e}", file=sys.stderr)
+    hbm_fused, hbm_variants = 0.0, {}
+    b, length = (6, 1 << 20) if on_tpu else (6, 1 << 18)
+    for variant in (("pallas", "xla") if on_tpu else ("xla",)):
+        try:
+            hbm_variants[variant] = bench_hbm_fused(b, length,
+                                                    variant=variant)
+        except Exception as e:
+            print(f"note: hbm_fused[{variant}] failed: {e}",
+                  file=sys.stderr)
+    if hbm_variants:
+        hbm_fused = max(hbm_variants.values())
 
     # -- host<->device link bandwidth (attributes the e2e gap) ---------------
     h2d_mbps = d2h_mbps = 0.0
@@ -331,6 +342,8 @@ def main():
         "cpu_e2e_gibps": round(cpu_e2e, 3),
         "e2e_vs_cpu_e2e": (round(e2e_batched / cpu_e2e, 3)
                            if cpu_e2e > 0 else 0.0),
+        "hbm_fused_variants": {k: round(v, 3)
+                               for k, v in hbm_variants.items()},
         "link_h2d_mbps": round(h2d_mbps, 1),
         "link_d2h_mbps": round(d2h_mbps, 1),
         "note": ("value = HBM-resident batched parity+CRC step (BASELINE "

@@ -105,6 +105,15 @@ def batched_crc32c_raw(data: jax.Array) -> jax.Array:
     bits = bits.reshape(*lead, nseg, 8 * seg)
     w = jnp.asarray(_segment_matrix(seg))  # (8*seg, 32) plane-major rows
     state = jnp.matmul(bits, w, preferred_element_type=jnp.int32) & 1
+    return combine_tree(state, seg, nseg)
+
+
+def combine_tree(state, seg: int, nseg: int):
+    """Fold per-segment raw-CRC bit images into whole-chunk values:
+    state (..., nseg, 32) 0/1 -> (...,) uint32.  Level k merges nodes of
+    seg * 2^k bytes by advancing the LEFT image over the right's span
+    (g(A||B) = Adv_{|B|}(g(A)) ^ g(B)) — shared by the XLA formulation
+    above and the fused Pallas kernel (ops/rs_pallas.py)."""
     for advt in _tree_matrices(seg, nseg):
         left = state[..., 0::2, :]
         right = state[..., 1::2, :]

@@ -86,3 +86,127 @@ def apply_matrix_pallas(matrix: np.ndarray, data, block: int = DEFAULT_BLOCK,
     if interpret is None:
         interpret = not on_tpu()
     return _apply_pallas(bm, data, p, block, interpret)
+
+
+# ---------------------------------------------------------------------------
+# Fused batched parity + CRC32C kernel (the production encode step).
+#
+# The XLA formulation (parallel/mesh.batched_encode_step) materializes the
+# 8x bit expansion in HBM twice (parity matmul input + CRC matmul input).
+# Here one VMEM-resident expansion feeds both: each grid program computes a
+# (d, BLOCK) tile's parity AND its CRC32C segment image (the per-segment
+# raw CRC of all 14 shards), so HBM traffic stays at parity-kernel levels
+# and only (B, nseg, 14) uint32 segment images are added.  Segments combine
+# into whole-chunk CRCs with the log-tree of 32x32 advance matrices from
+# ops/crc_device.py, outside the kernel (tiny).
+# ---------------------------------------------------------------------------
+
+
+def _fused_kernel(bm_ref, w_ref, x_ref, par_ref, crc_ref, *, d: int, p: int):
+    x = x_ref[0].astype(jnp.int32)  # (d, BLOCK)
+    block = x.shape[-1]
+    shifts = jax.lax.broadcasted_iota(jnp.int32, (1, 8, 1), 1)
+    bits = ((x[:, None, :] >> shifts) & 1).astype(jnp.int8)
+    bits = bits.reshape(d * 8, block)
+    prod = jax.lax.dot(
+        bm_ref[:], bits, preferred_element_type=jnp.int32)  # (p*8, BLOCK)
+    out_bits = (prod & 1)
+    weights = jnp.left_shift(1, shifts)  # (1, 8, 1)
+    par_ref[0] = (out_bits.reshape(p, 8, block) * weights).sum(
+        axis=1).astype(jnp.uint8)
+    # CRC of every shard's BLOCK-byte segment: rows (shard, bit-plane,
+    # byte) flatten to plane-major (shard, 8*BLOCK) for free (row-major
+    # layout), matching w_ref's plane-major row order
+    full_bits = jnp.concatenate(
+        [bits, out_bits.astype(jnp.int8)], axis=0)  # ((d+p)*8, BLOCK)
+    seg_in = full_bits.reshape(d + p, 8 * block)
+    crc_bits = (jax.lax.dot(
+        seg_in, w_ref[:], preferred_element_type=jnp.int32) & 1
+    ).astype(jnp.uint32)  # (d+p, 32)
+    w32 = jnp.left_shift(
+        jnp.uint32(1),
+        jax.lax.broadcasted_iota(jnp.uint32, (1, 32), 1))
+    crc_ref[0, 0] = (crc_bits * w32).sum(axis=-1, dtype=jnp.uint32)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("d", "p", "block", "interpret"))
+def _fused_encode_pallas(bit_matrix, w, data, d: int, p: int, block: int,
+                         interpret: bool):
+    b, _, length = data.shape
+    nseg = length // block
+    kernel = functools.partial(_fused_kernel, d=d, p=p)
+    return pl.pallas_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((b, p, length), jnp.uint8),
+            jax.ShapeDtypeStruct((b, nseg, d + p), jnp.uint32),
+        ),
+        grid=(b, nseg),
+        in_specs=[
+            pl.BlockSpec((p * 8, d * 8), lambda bi, i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((8 * block, 32), lambda bi, i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, d, block), lambda bi, i: (bi, 0, i),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, p, block), lambda bi, i: (bi, 0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, d + p), lambda bi, i: (bi, i, 0),
+                         memory_space=pltpu.VMEM),
+        ),
+        interpret=interpret,
+        cost_estimate=pl.CostEstimate(
+            flops=2 * (p * 8 * d * 8 + (d + p) * 32 * 8) * length * b,
+            bytes_accessed=(d + p) * length * b,
+            transcendentals=0,
+        ),
+    )(bit_matrix, w, data)
+
+
+def fused_encode_block(length: int, block: int = DEFAULT_BLOCK) -> int:
+    """Largest kernel block that divides length with a power-of-two
+    segment count, or 0 when the fused kernel cannot handle this shape."""
+    while block >= 512:
+        nseg = length // block
+        if length % block == 0 and nseg > 0 and nseg & (nseg - 1) == 0:
+            return block
+        block //= 2
+    return 0
+
+
+def fused_encode_pallas(matrix: np.ndarray, data,
+                        block: int | None = None,
+                        interpret: bool | None = None):
+    """Batched parity + per-shard raw CRC32C in one fused kernel.
+
+    data: (B, d, L) uint8 -> (parity (B, p, L) uint8, crc_raw (B, d+p)
+    uint32), same contract as parallel.mesh.batched_encode_step.  L must
+    divide into a power-of-two count of `block`-byte segments (check
+    with fused_encode_block first).
+    """
+    from ..util.platform import on_tpu
+    from .crc_device import _segment_matrix, combine_tree
+    from .rs_jax import _bit_matrix_cached, _matrix_key
+
+    p, d = matrix.shape
+    data = jnp.asarray(data, dtype=jnp.uint8)
+    length = data.shape[-1]
+    if block is None:
+        block = fused_encode_block(length)
+    if not block:
+        raise ValueError(f"length {length} unsupported by fused kernel")
+    nseg = length // block
+    bm = jnp.asarray(_bit_matrix_cached(*_matrix_key(matrix)))
+    w = jnp.asarray(_segment_matrix(block))
+    if interpret is None:
+        interpret = not on_tpu()
+    parity, seg = _fused_encode_pallas(bm, w, data, d, p, block, interpret)
+    # combine segment images left-to-right with the advance-matrix tree
+    # (the shared fold from crc_device)
+    shifts = jnp.arange(32, dtype=jnp.uint32)
+    state = ((seg[..., None] >> shifts) & 1).astype(jnp.int8)
+    state = state.transpose(0, 2, 1, 3)  # (B, shards, nseg, 32)
+    return parity, combine_tree(state, block, nseg)

@@ -26,6 +26,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops import gf256
 from ..ops.rs_jax import _bit_matrix_cached, _matrix_key
+from ..util import glog
 
 
 def make_mesh(devices=None, axes: tuple[str, str] = ("data", "block")
@@ -83,6 +84,49 @@ def batched_encode_step(bit_matrix, data):
 
 _ENCODER_CACHE: dict = {}
 _APPLY_CACHE: dict = {}
+_PALLAS_OK: dict = {}
+
+
+def _pallas_fused_ok(matrix) -> bool:
+    """One-time self-test (per matrix geometry) of the fused Mosaic
+    kernel on this backend: compile+run at a production-representative
+    shape (the production 8192-byte block with a multi-segment combine)
+    checked against the host codec.  A Mosaic lowering regression then
+    degrades the production encode path to the portable XLA step instead
+    of crashing it."""
+    from ..ops.rs_pallas import DEFAULT_BLOCK
+
+    m = np.ascontiguousarray(matrix, dtype=np.uint8)
+    key = (m.tobytes(), m.shape)
+    if key in _PALLAS_OK:
+        return _PALLAS_OK[key]
+    try:
+        from ..ops.rs_pallas import fused_encode_pallas
+        from ..ops.rs_numpy import gf_apply_matrix
+        from ..ops import crc32c as crc_host
+
+        rng = np.random.default_rng(0)
+        data = rng.integers(0, 256, (1, m.shape[1], 2 * DEFAULT_BLOCK),
+                            dtype=np.uint8)
+        parity, crcs = fused_encode_pallas(m, data, interpret=False)
+        expect = gf_apply_matrix(m, data[0])
+        ok = np.array_equal(np.asarray(parity)[0], expect)
+        full = np.concatenate([data[0], expect], axis=0)
+        ok = ok and all(
+            int(np.asarray(crcs)[0, s]) == crc_host.raw_update(
+                0, full[s].tobytes())
+            for s in range(full.shape[0]))
+        if not ok:
+            glog.warningf(
+                "fused pallas encode self-test MISMATCHED on this "
+                "backend; falling back to the XLA step")
+    except Exception as e:
+        glog.warningf(
+            "fused pallas encode unavailable (%s: %s); falling back to "
+            "the XLA step", type(e).__name__, e)
+        ok = False
+    _PALLAS_OK[key] = ok
+    return ok
 
 
 def make_sharded_apply(mesh: Mesh, matrix: np.ndarray):
@@ -129,7 +173,15 @@ def make_sharded_encoder(mesh: Mesh, data_shards: int = 10,
     """jit-compiled batched encoder with shardings over the mesh:
     batch -> "data" axis, byte columns -> "block" axis.  Cached per
     (mesh, geometry) so repeated callers reuse the jit cache instead of
-    recompiling every batch."""
+    recompiling every batch.
+
+    On a single real-TPU device the fused Pallas kernel serves the step
+    (one VMEM bit expansion feeds parity AND CRC — HBM traffic stays at
+    parity-kernel levels); multi-device meshes and CPU use the portable
+    XLA formulation, which GSPMD can partition."""
+    from ..ops.rs_pallas import fused_encode_block, fused_encode_pallas
+    from ..util.platform import on_tpu
+
     cache_key = (mesh, data_shards, parity_shards)
     cached = _ENCODER_CACHE.get(cache_key)
     if cached is not None:
@@ -137,6 +189,8 @@ def make_sharded_encoder(mesh: Mesh, data_shards: int = 10,
     matrix = gf256.parity_matrix(
         data_shards, data_shards + parity_shards)
     bit_matrix = jnp.asarray(_bit_matrix_cached(*_matrix_key(matrix)))
+    use_pallas = (mesh.devices.size == 1 and on_tpu()
+                  and _pallas_fused_ok(matrix))
     data_sharding = NamedSharding(mesh, P("data", None, "block"))
     out_shardings = (
         NamedSharding(mesh, P("data", None, "block")),  # parity
@@ -150,6 +204,8 @@ def make_sharded_encoder(mesh: Mesh, data_shards: int = 10,
         donate_argnums=(0,),
     )
     def step(data):
+        if use_pallas and fused_encode_block(data.shape[-1]):
+            return fused_encode_pallas(matrix, data, interpret=False)
         return batched_encode_step(bit_matrix, data)
 
     _ENCODER_CACHE[cache_key] = step

@@ -41,9 +41,16 @@ class TestFsyncGroupCommit:
         monkeypatch.setattr(v, "_durable_sync", slow_sync)
         v._batcher = None  # rebuild the worker against the patched sync
         n_writers = 16
-        threads = [threading.Thread(
-            target=lambda i=i: v.write_needle(_mk(10 + i, b"x" * 100)))
-            for i in range(n_writers)]
+        gate = threading.Barrier(n_writers)
+
+        def writer(i):
+            gate.wait()  # all writers race at once: group commit must
+            # coalesce them (without the barrier, staggered starts could
+            # legally produce one sync per write on a 1-core box)
+            v.write_needle(_mk(10 + i, b"x" * 100))
+
+        threads = [threading.Thread(target=writer, args=(i,))
+                   for i in range(n_writers)]
         for th in threads:
             th.start()
         for th in threads:

@@ -98,3 +98,39 @@ class TestShardedEncode:
         parity, _ = encode_batch(data, mesh)
         matrix = gf256.parity_matrix(10, 14)
         assert np.array_equal(parity[11], gf_apply_matrix(matrix, data[11]))
+
+
+class TestFusedPallasKernel:
+    """The single-expansion Pallas step must agree with the XLA
+    formulation bit for bit (interpret mode on CPU)."""
+
+    @pytest.mark.parametrize("batch,length,block",
+                             [(1, 512, None), (2, 2048, 512),   # nseg 4
+                              (3, 4096, 512),                   # nseg 8
+                              (1, 16384, None)])                # nseg 2
+    def test_matches_xla_step(self, batch, length, block):
+        from seaweedfs_tpu.ops import gf256
+        from seaweedfs_tpu.ops.rs_jax import (_bit_matrix_cached,
+                                              _matrix_key)
+        from seaweedfs_tpu.ops.rs_pallas import fused_encode_pallas
+        from seaweedfs_tpu.parallel.mesh import batched_encode_step
+
+        matrix = gf256.parity_matrix(10, 14)
+        bm = jax.numpy.asarray(
+            _bit_matrix_cached(*_matrix_key(matrix)))
+        rng = np.random.default_rng(batch * length)
+        data = rng.integers(0, 256, (batch, 10, length), dtype=np.uint8)
+        want_par, want_crc = batched_encode_step(
+            bm, jax.numpy.asarray(data))
+        got_par, got_crc = fused_encode_pallas(matrix, data, block=block)
+        assert np.array_equal(np.asarray(got_par), np.asarray(want_par))
+        assert np.array_equal(np.asarray(got_crc), np.asarray(want_crc))
+
+    def test_block_selector(self):
+        from seaweedfs_tpu.ops.rs_pallas import fused_encode_block
+
+        assert fused_encode_block(1 << 20) == 8192  # nseg = 128
+        assert fused_encode_block(512) == 512
+        assert fused_encode_block(100) == 0  # unsupported shape
+        # 1536 = 3*512: nseg = 3 is not a power of two at any block
+        assert fused_encode_block(1536, 512) == 0
