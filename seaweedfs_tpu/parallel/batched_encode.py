@@ -295,6 +295,9 @@ def encode_volumes(bases: list[str], large_block: Optional[int] = None,
             w.close()
     if errors:
         raise errors[0]
+    from ..stats import metrics as stats
+
+    stats.EcEncodeBytesCounter.inc(sum(p.dat_size for p in plans))
     return {p.base: writers[vi].crcs for vi, p in enumerate(plans)}
 
 

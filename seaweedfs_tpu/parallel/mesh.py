@@ -106,16 +106,21 @@ def _pallas_fused_ok(matrix) -> bool:
         from ..ops import crc32c as crc_host
 
         rng = np.random.default_rng(0)
-        data = rng.integers(0, 256, (1, m.shape[1], 2 * DEFAULT_BLOCK),
+        # batch >= 2 so BOTH grid dimensions take nonzero indices on the
+        # hardware — a bi>0-only miscompile must not pass the guard
+        data = rng.integers(0, 256, (2, m.shape[1], 2 * DEFAULT_BLOCK),
                             dtype=np.uint8)
         parity, crcs = fused_encode_pallas(m, data, interpret=False)
-        expect = gf_apply_matrix(m, data[0])
-        ok = np.array_equal(np.asarray(parity)[0], expect)
-        full = np.concatenate([data[0], expect], axis=0)
-        ok = ok and all(
-            int(np.asarray(crcs)[0, s]) == crc_host.raw_update(
-                0, full[s].tobytes())
-            for s in range(full.shape[0]))
+        parity, crcs = np.asarray(parity), np.asarray(crcs)
+        ok = True
+        for bi in range(data.shape[0]):
+            expect = gf_apply_matrix(m, data[bi])
+            ok = ok and np.array_equal(parity[bi], expect)
+            full = np.concatenate([data[bi], expect], axis=0)
+            ok = ok and all(
+                int(crcs[bi, s]) == crc_host.raw_update(
+                    0, full[s].tobytes())
+                for s in range(full.shape[0]))
         if not ok:
             glog.warningf(
                 "fused pallas encode self-test MISMATCHED on this "

@@ -269,6 +269,19 @@ VolumeServerVolumeCounter = REGISTRY.gauge(
     "SeaweedFS_volumeServer_volumes", "volumes managed", ("collection", "type"))
 VolumeServerReadOnlyVolumeGauge = REGISTRY.gauge(
     "SeaweedFS_volumeServer_read_only_volumes", "read-only volumes")
+VolumeServerProxiedReadCounter = REGISTRY.counter(
+    "SeaweedFS_volumeServer_proxied_read_total",
+    "non-local reads served per readMode outcome", ("mode",))
+VolumeServerThrottleRejects = REGISTRY.counter(
+    "SeaweedFS_volumeServer_throttle_rejects_total",
+    "requests rejected (429) by the in-flight byte throttles",
+    ("direction",))
+VolumeFsyncBatchCounter = REGISTRY.counter(
+    "SeaweedFS_volumeServer_fsync_batches_total",
+    "group-commit fsync batches flushed")
+EcEncodeBytesCounter = REGISTRY.counter(
+    "SeaweedFS_volumeServer_ec_encode_bytes_total",
+    "volume bytes pushed through the batched EC encode pipeline")
 FilerRequestCounter = REGISTRY.counter(
     "SeaweedFS_filer_request_total", "filer requests", ("type",))
 FilerRequestHistogram = REGISTRY.histogram(

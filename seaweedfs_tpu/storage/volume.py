@@ -531,6 +531,9 @@ class Volume:
         with self.lock:
             self.nm.sync()
             self.data.sync()
+        from ..stats import metrics as stats
+
+        stats.VolumeFsyncBatchCounter.inc()
 
     def sync(self):
         with self.lock:

@@ -510,6 +510,7 @@ class VolumeServer:
             stats.VolumeServerRequestCounter.labels("write").inc()
             n_bytes = len(req.body)
             if not self.upload_gate.acquire(n_bytes):
+                stats.VolumeServerThrottleRejects.labels("upload").inc()
                 raise RpcError("too many requests: upload limit", 429)
             try:
                 with stats.VolumeServerRequestHistogram.labels(
@@ -546,6 +547,7 @@ class VolumeServer:
         except (CookieMismatchError,) as e:
             raise RpcError(str(e), 404)
         if not self.download_gate.acquire(len(n.data)):
+            stats.VolumeServerThrottleRejects.labels("download").inc()
             raise RpcError("too many requests: download limit", 429)
         try:
             return self._build_read_response(n, method, req)
@@ -615,6 +617,7 @@ class VolumeServer:
         if not others:
             raise RpcError(f"volume {vid} has no other locations", 404)
         target = others[0]
+        stats.VolumeServerProxiedReadCounter.labels(self.read_mode).inc()
         if self.read_mode == "redirect":
             public = target.get("publicUrl") or target["url"]
             return Response(b"", 302, headers={
