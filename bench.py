@@ -253,7 +253,17 @@ def _pick_workdir(need_bytes: int) -> str:
 
 
 def main():
+    # never hang on a wedged TPU transport: probe device init in a
+    # subprocess first; on timeout pin the CPU backend (env alone is not
+    # enough — the axon sitecustomize registers the relay regardless)
+    from seaweedfs_tpu.util.platform import jax_usable
+
     import jax
+
+    if not jax_usable(timeout=60):
+        print("note: TPU backend unreachable; benching on CPU",
+              file=sys.stderr)
+        jax.config.update("jax_platforms", "cpu")
 
     platform = jax.devices()[0].platform
     on_tpu = platform == "tpu"

@@ -440,6 +440,71 @@ def ec_balance(env: CommandEnv, plan_only: bool = False) -> list[dict]:
     return moves
 
 
+# -- ec.scrub ----------------------------------------------------------------
+
+
+def ec_scrub(env: CommandEnv, vid: Optional[int] = None,
+             repair: bool = False, plan_only: bool = False) -> list[dict]:
+    """Cluster-wide EC integrity sweep: every shard holder verifies its
+    local shards against the fused-encode CRC record (.vif); corrupt
+    shards are deleted and rebuilt from survivors with -repair.  No
+    reference analogue — the reference stores no shard checksums."""
+    topo = env.master("/dir/status")
+    vids = ([vid] if vid is not None
+            else sorted(topo.get("ec_volumes", [])))
+    reports = []
+    for v in vids:
+        try:
+            lookup = env.master(f"/ec/lookup?volumeId={v}")
+        except RpcError:
+            continue
+        collection = lookup.get("collection", "")
+        holders = {loc["url"]
+                   for e in lookup.get("shard_id_locations", [])
+                   for loc in e["locations"]}
+        corrupt: list[tuple[str, int]] = []
+        errors: list[dict] = []
+        clean_union: set[int] = set()
+        for url in sorted(holders):
+            try:
+                r = call(url, "/admin/ec/scrub",
+                         {"volume": v, "collection": collection},
+                         timeout=600)
+            except RpcError as e:
+                errors.append({"holder": url, "error": str(e)})
+                continue
+            clean_union.update(r.get("clean", []))
+            corrupt.extend((url, sid) for sid in r.get("corrupt", []))
+        # a shard corrupt on one holder but clean elsewhere is covered;
+        # missing = no intact copy anywhere AND no corrupt copy either
+        seen = clean_union | {sid for _, sid in corrupt}
+        missing = sorted(set(range(TOTAL_SHARDS_COUNT)) - seen)
+        report = {"volume": v, "clean_shards": len(clean_union),
+                  "corrupt": [{"holder": u, "shard": s}
+                              for u, s in corrupt
+                              if s not in clean_union],
+                  "missing": missing}
+        if errors:
+            report["errors"] = errors
+        degraded = report["corrupt"] or missing
+        if degraded and repair and not plan_only:
+            if len(clean_union) < 10:  # DATA_SHARDS intact copies needed
+                report["rebuild_error"] = (
+                    f"only {len(clean_union)} clean shards — corrupt "
+                    "copies left in place for manual recovery")
+            else:
+                for url, sid in corrupt:
+                    call(url, "/admin/ec/delete_shards",
+                         {"volume": v, "collection": collection,
+                          "shard_ids": [sid]})
+                try:
+                    report["rebuild"] = ec_rebuild(env, v, collection)
+                except RpcError as e:
+                    report["rebuild_error"] = str(e)
+        reports.append(report)
+    return reports
+
+
 # -- volume.* ----------------------------------------------------------------
 
 

@@ -126,6 +126,43 @@ def compact_offline(directory: str, collection: str, vid: int) -> dict:
             "reclaimed": before - after}
 
 
+def shard_file_crc32c(path: str) -> int:
+    """Whole-file CRC32C, streamed in 4 MiB chunks."""
+    from ..ops.crc32c import crc32c
+
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(4 << 20)
+            if not chunk:
+                break
+            crc = crc32c(chunk, crc)
+    return crc
+
+
+def verify_shard_files(base: str, stored) -> tuple[list, list, list]:
+    """Classify the .ecNN files at `base` against the recorded CRCs:
+    -> (clean, corrupt, absent) shard-id lists.  Shared by the offline
+    `weed scrub` and the volume server's /admin/ec/scrub handler (where
+    'absent' just means not held locally).  Raises ValueError when the
+    .vif carries no CRC record."""
+    from .erasure_coding import TOTAL_SHARDS_COUNT, to_ext
+
+    if not isinstance(stored, list) or len(stored) != TOTAL_SHARDS_COUNT:
+        raise ValueError(
+            f"{base}.vif has no shard_crc32c record to scrub against")
+    clean, corrupt, absent = [], [], []
+    for sid in range(TOTAL_SHARDS_COUNT):
+        path = base + to_ext(sid)
+        if not os.path.exists(path):
+            absent.append(sid)
+        elif shard_file_crc32c(path) == stored[sid]:
+            clean.append(sid)
+        else:
+            corrupt.append(sid)
+    return clean, corrupt, absent
+
+
 def scrub_ec_volume(directory: str, collection: str, vid: int,
                     repair: bool = False) -> dict:
     """Verify every local .ecNN against the CRC32Cs the batched encode
@@ -136,33 +173,13 @@ def scrub_ec_volume(directory: str, collection: str, vid: int,
 
     Returns {"checked": [...], "corrupt": [...], "missing": [...],
     "repaired": [...]}."""
-    from ..ops.crc32c import crc32c
-    from .erasure_coding import TOTAL_SHARDS_COUNT, to_ext
+    from .erasure_coding import to_ext
     from .erasure_coding.encoder import load_volume_info
 
     base = _base(directory, collection, vid)
     info = load_volume_info(base) or {}
     stored = info.get("shard_crc32c")
-    if not isinstance(stored, list) or len(stored) != TOTAL_SHARDS_COUNT:
-        raise ValueError(
-            f"{base}.vif has no shard_crc32c record to scrub against")
-    checked, corrupt, missing = [], [], []
-    for sid in range(TOTAL_SHARDS_COUNT):
-        path = base + to_ext(sid)
-        if not os.path.exists(path):
-            missing.append(sid)
-            continue
-        crc = 0
-        with open(path, "rb") as f:
-            while True:
-                chunk = f.read(4 << 20)
-                if not chunk:
-                    break
-                crc = crc32c(chunk, crc)
-        if crc == stored[sid]:
-            checked.append(sid)
-        else:
-            corrupt.append(sid)
+    checked, corrupt, missing = verify_shard_files(base, stored)
     repaired: list[int] = []
     if repair and (corrupt or missing):
         from .erasure_coding.encoder import rebuild_ec_files
@@ -187,13 +204,7 @@ def scrub_ec_volume(directory: str, collection: str, vid: int,
         bad = []
         for sid, crc in crcs.items():
             if crc is None:
-                crc = 0
-                with open(base + to_ext(sid), "rb") as f:
-                    while True:
-                        chunk = f.read(4 << 20)
-                        if not chunk:
-                            break
-                        crc = crc32c(chunk, crc)
+                crc = shard_file_crc32c(base + to_ext(sid))
             if crc != stored[sid]:
                 bad.append(sid)
         if bad:

@@ -553,7 +553,16 @@ class Volume:
     def destroy(self):
         with self.lock:
             self.close()
-            for ext in (".dat", ".idx", ".vif", ".cpd", ".cpx", ".note"):
+            from .erasure_coding import TOTAL_SHARDS_COUNT, to_ext
+
+            exts = [".dat", ".idx", ".vif", ".cpd", ".cpx", ".note"]
+            if any(os.path.exists(self.file_name(to_ext(i)))
+                   for i in range(TOTAL_SHARDS_COUNT)):
+                # the .vif doubles as the EC volume's sidecar (version +
+                # fused shard CRCs); deleting the original volume after
+                # ec.encode must not strip it from the surviving shards
+                exts.remove(".vif")
+            for ext in exts:
                 try:
                     os.remove(self.file_name(ext))
                 except FileNotFoundError:

@@ -344,6 +344,7 @@ class VolumeServer:
         s.add("POST", "/admin/ec/copy", g(self._h_ec_copy))
         s.add("POST", "/admin/ec/delete_shards", g(self._h_ec_delete_shards))
         s.add("POST", "/admin/ec/to_volume", g(self._h_ec_to_volume))
+        s.add("POST", "/admin/ec/scrub", g(self._h_ec_scrub))
         s.add("GET", "/admin/ec/shard_file", self._h_ec_shard_file)
         s.add("GET", "/admin/ec/shard_read", self._h_ec_shard_read)
         s.add("POST", "/admin/volume/configure_replication",
@@ -1007,6 +1008,30 @@ class VolumeServer:
             os.replace(base + ext + ".cpy", base + ext)
         return {}
 
+    def _h_ec_scrub(self, req: Request):
+        """Verify LOCAL shards of an EC volume against the .vif CRC
+        record (the fused-encode checksums).  Report-only: repairing a
+        corrupt shard needs >= 10 survivors, which one holder rarely
+        has, so the shell's ec.scrub routes repairs through ec.rebuild
+        after deleting the corrupt shard cluster-wide."""
+        from ..storage.erasure_coding.encoder import load_volume_info
+        from ..storage.tools import verify_shard_files
+
+        p = req.json()
+        vid = int(p["volume"])
+        collection = p.get("collection", "")
+        loc = self.store.location_of(vid) or self.store.locations[0]
+        base = loc._base_name(collection, vid)
+        info = load_volume_info(base) or {}
+        try:
+            clean, corrupt, _ = verify_shard_files(
+                base, info.get("shard_crc32c"))
+        except ValueError as e:
+            raise RpcError(str(e), 404)
+        # 'absent' is normal here (shards spread over holders); the shell
+        # derives cluster-wide missing from the union of holder reports
+        return {"volume": vid, "clean": clean, "corrupt": corrupt}
+
     def _h_ec_delete_shards(self, req: Request):
         p = req.json()
         vid = int(p["volume"])
@@ -1028,6 +1053,9 @@ class VolumeServer:
                         os.remove(base + ext)
                     except FileNotFoundError:
                         pass
+        # push the shrunken ShardBits to the master NOW: callers chain
+        # ec.rebuild right after a delete and plan from the master's view
+        self._try_heartbeat()
         return {}
 
     def _h_ec_to_volume(self, req: Request):

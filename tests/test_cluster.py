@@ -162,6 +162,46 @@ class TestEcOrchestration:
             serve = lookup["locations"][0]["url"]
             assert call(serve, f"/{fid}") == payload
 
+    def test_ec_scrub_detects_and_repairs(self, cluster):
+        master, servers = cluster
+        stored = self._fill_volume(master)
+        env = sh.CommandEnv(master.address)
+        vid = sorted({int(fid.split(",")[0]) for fid in stored})[0]
+        sh.ec_encode(env, vid)
+        for vs in servers:
+            vs.heartbeat_once()
+
+        clean = sh.ec_scrub(env, vid)
+        assert clean[0]["clean_shards"] == 14
+        assert clean[0]["corrupt"] == []
+
+        # flip a byte in one shard on whatever holder has it
+        import glob
+        shard_path = None
+        for vs in servers:
+            hits = glob.glob(
+                f"{vs.store.locations[0].directory}/{vid}.ec07")
+            if hits:
+                shard_path = hits[0]
+                break
+        assert shard_path
+        with open(shard_path, "r+b") as f:
+            f.seek(11)
+            b = f.read(1)
+            f.seek(11)
+            f.write(bytes([b[0] ^ 0x55]))
+
+        bad = sh.ec_scrub(env, vid)
+        assert [c["shard"] for c in bad[0]["corrupt"]] == [7]
+
+        fixed = sh.ec_scrub(env, vid, repair=True)
+        assert fixed[0]["corrupt"] and "rebuild" in fixed[0]
+        for vs in servers:
+            vs.heartbeat_once()
+        final = sh.ec_scrub(env, vid)
+        assert final[0]["clean_shards"] == 14
+        assert final[0]["corrupt"] == []
+
     def test_ec_rebuild_after_loss(self, cluster):
         master, servers = cluster
         stored = self._fill_volume(master)

@@ -376,6 +376,11 @@ def _shell_handlers(env):
             env, int(a[0]), plan_only=plan(a))),
         "ec.balance": lambda a: show(sh.ec_balance(
             env, plan_only=plan(a))),
+        "ec.scrub": lambda a: show(sh.ec_scrub(
+            env,
+            vid=(lambda v: int(v[0]) if v else None)(
+                [x for x in a if not x.startswith("-")]),
+            repair="-repair" in a, plan_only=plan(a))),
         # collection / cluster
         "collection.list": lambda a: show(vol.collection_list(env)),
         "collection.delete": lambda a: show(vol.collection_delete(
