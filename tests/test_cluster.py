@@ -366,3 +366,21 @@ class TestReadDepth:
             call(other.address, f"/{fid}")
         assert e.value.status == 404
         other.read_mode = "proxy"
+
+    def test_proxy_loop_guard(self, cluster):
+        """A request already marked as proxied must 404 on a non-holder
+        instead of proxying again (no ping-pong between two stale
+        servers)."""
+        master, servers = cluster
+        a = assign(master)
+        fid, url = a["fid"], a["url"]
+        call(url, f"/{fid}", raw=b"guarded", method="POST")
+        vid = int(fid.split(",")[0])
+        other = next(s for s in servers
+                     if s.store.find_volume(vid) is None)
+        # unmarked: proxies fine
+        assert call(other.address, f"/{fid}") == b"guarded"
+        # marked as already-proxied: fail fast
+        status, _, _ = self._raw_get(other.address, f"/{fid}",
+                                     {"X-SW-Proxied": "1"})
+        assert status == 404
