@@ -317,13 +317,31 @@ def call_stream(addr: str, path: str, payload: Optional[dict] = None,
     except (urllib.error.URLError, socket.timeout, ConnectionError) as e:
         raise RpcError(f"cannot reach {addr}: {e}", 503) from None
 
+    try:
+        expected = int(resp.headers.get("Content-Length", ""))
+    except ValueError:
+        expected = -1  # absent or malformed: length unknown, no check
+
     def gen():
+        got = 0
         try:
             while True:
-                chunk = resp.read(chunk_size)
+                try:
+                    chunk = resp.read(chunk_size)
+                except Exception as e:  # IncompleteRead, socket errors
+                    raise RpcError(
+                        f"stream from {addr} broke mid-body: {e}", 502)
                 if not chunk:
-                    return
+                    break
+                got += len(chunk)
                 yield chunk
+            # a prematurely-closed connection can look like EOF on
+            # incremental reads; enforce the advertised length so a
+            # truncated transfer NEVER passes as complete
+            if 0 <= expected != got:
+                raise RpcError(
+                    f"truncated stream from {addr}: "
+                    f"{got} of {expected} bytes", 502)
         finally:
             resp.close()
 

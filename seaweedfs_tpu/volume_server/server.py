@@ -85,6 +85,15 @@ class _InflightGate:
             self._cond.notify_all()
 
 
+def _remove_quiet(*paths: str):
+    """Best-effort unlink for rollback paths."""
+    for path in paths:
+        try:
+            os.remove(path)
+        except FileNotFoundError:
+            pass
+
+
 def _parse_range(header: str, total: int):
     """Parse a Range header against an entity of `total` bytes
     (volume_server_handlers_read.go:238 processRangeRequest).
@@ -172,6 +181,7 @@ class VolumeServer:
             ec_encoder_backend=ec_encoder_backend,
             needle_map_kind=needle_map_kind, fsync=fsync)
         self._stop = threading.Event()
+        self._copy_lock = threading.Lock()
         self._heartbeat_thread: Optional[threading.Thread] = None
         self._ec_locations: dict[int, tuple[float, dict[int, list[str]]]] = {}
         self._register_routes()
@@ -795,46 +805,55 @@ class VolumeServer:
         vid = int(p["volume"])
         collection = p.get("collection", "")
         source = p["source"]
-        if self.store.has_volume(vid):
-            raise RpcError(f"volume {vid} already exists", 409)
-        loc = self.store.locations[0]
-        base = loc._base_name(collection, vid)
-        if os.path.exists(base + ".dat"):
-            raise RpcError(f"volume {vid} files already on disk", 409)
-        # fetch to temp names; rename only once every file arrived, so a
-        # mid-copy failure leaves no stray .dat/.idx behind.  .idx first:
-        # writes that land between the two fetches then only extend the
-        # .dat, and the integrity check truncates that unreferenced tail
-        # on mount — the reverse order would leave the .idx pointing past
-        # the copied .dat's EOF
-        fetched: list[str] = []
-        try:
-            for ext in (".idx", ".dat", ".vif"):
-                try:
-                    chunks = call_stream(
-                        source,
-                        f"/admin/ec/shard_file?volume={vid}"
-                        f"&collection={collection}&ext={ext}",
-                        timeout=600)
-                except RpcError as e:
-                    if e.status == 404 and ext == ".vif":
-                        continue
-                    raise
-                with open(base + ext + ".cpy", "wb") as f:
-                    for chunk in chunks:
-                        f.write(chunk)
-                fetched.append(ext)
-        except Exception:
-            # RpcError before the first byte OR a mid-stream socket error
-            for ext in (".idx", ".dat", ".vif"):
-                try:
-                    os.remove(base + ext + ".cpy")
-                except FileNotFoundError:
-                    pass
-            raise
-        for ext in fetched:
-            os.replace(base + ext + ".cpy", base + ext)
-        loc.add_volume(vid, collection)
+        # serialize copies: two concurrent requests for the same vid must
+        # not both pass the exists-checks (TOCTOU) and then have one's
+        # rollback unlink the other's freshly-mounted files
+        with self._copy_lock:
+            if self.store.has_volume(vid):
+                raise RpcError(f"volume {vid} already exists", 409)
+            loc = self.store.locations[0]
+            base = loc._base_name(collection, vid)
+            if os.path.exists(base + ".dat"):
+                raise RpcError(f"volume {vid} files already on disk", 409)
+            # fetch to temp names; rename only once every file arrived, so
+            # a mid-copy failure leaves no stray .dat/.idx behind.  .idx
+            # first: writes that land between the two fetches then only
+            # extend the .dat, and the integrity check truncates that
+            # unreferenced tail on mount — the reverse order would leave
+            # the .idx pointing past the copied .dat's EOF
+            fetched: list[str] = []
+            try:
+                for ext in (".idx", ".dat", ".vif"):
+                    try:
+                        chunks = call_stream(
+                            source,
+                            f"/admin/ec/shard_file?volume={vid}"
+                            f"&collection={collection}&ext={ext}",
+                            timeout=600)
+                    except RpcError as e:
+                        if e.status == 404 and ext == ".vif":
+                            continue
+                        raise
+                    with open(base + ext + ".cpy", "wb") as f:
+                        for chunk in chunks:
+                            f.write(chunk)
+                    fetched.append(ext)
+            except Exception:
+                # RpcError before the first byte OR a mid-stream error
+                _remove_quiet(*(base + ext + ".cpy"
+                                for ext in (".idx", ".dat", ".vif")))
+                raise
+            for ext in fetched:
+                os.replace(base + ext + ".cpy", base + ext)
+            try:
+                loc.add_volume(vid, collection)
+            except Exception:
+                # keep all-or-nothing: an unloadable copy (corrupt
+                # source) must not squat on the volume id's file names —
+                # but never touch files backing a volume that IS mounted
+                if self.store.find_volume(vid) is None:
+                    _remove_quiet(*(base + ext for ext in fetched))
+                raise
         self._try_heartbeat()
         return {"last_append_at_ns":
                 self.store.find_volume(vid).last_append_at_ns}
@@ -998,11 +1017,7 @@ class VolumeServer:
         except Exception:
             # RpcError before the first byte OR a mid-stream socket error:
             # remove every temp, including the partial in-progress one
-            for ext in exts:
-                try:
-                    os.remove(base + ext + ".cpy")
-                except FileNotFoundError:
-                    pass
+            _remove_quiet(*(base + ext + ".cpy" for ext in exts))
             raise
         for ext in fetched:
             os.replace(base + ext + ".cpy", base + ext)
@@ -1040,19 +1055,11 @@ class VolumeServer:
         self.store.ec_unmount(vid, shard_ids)
         for loc in self.store.locations:
             base = loc._base_name(collection, vid)
-            for sid in shard_ids:
-                try:
-                    os.remove(base + to_ext(sid))
-                except FileNotFoundError:
-                    pass
+            _remove_quiet(*(base + to_ext(sid) for sid in shard_ids))
             # when no shards remain, drop the index sidecars too
             if not any(os.path.exists(base + to_ext(i))
                        for i in range(TOTAL_SHARDS_COUNT)):
-                for ext in (".ecx", ".ecj", ".vif"):
-                    try:
-                        os.remove(base + ext)
-                    except FileNotFoundError:
-                        pass
+                _remove_quiet(base + ".ecx", base + ".ecj", base + ".vif")
         # push the shrunken ShardBits to the master NOW: callers chain
         # ec.rebuild right after a delete and plan from the master's view
         self._try_heartbeat()

@@ -145,3 +145,51 @@ class TestReadAllStreams:
         ids = [rec["id"] for rec in _stream_ndjson(
             src.address, "/admin/volume/read_all?volume=9")]
         assert ids == list(range(1, 1201))
+
+
+class TestInterruptedCopy:
+    def test_mid_stream_failure_leaves_no_partial_files(self, tmp_path):
+        """A source dying mid-transfer must not leave truncated .cpy or
+        volume files on the target (the all-or-nothing contract of the
+        buffered path, kept under streaming)."""
+        from seaweedfs_tpu.rpc.http_rpc import RpcError
+
+        master = MasterServer(port=0, pulse_seconds=0.2)
+        master.start()
+        d = tmp_path / "dst"
+        d.mkdir()
+        dst = VolumeServer([str(d)], master.address, port=0,
+                           pulse_seconds=0.2)
+        dst.start()
+
+        # fake source: serves a valid .idx, then breaks the .dat stream
+        # after the first chunk (Content-Length never satisfied)
+        fake = RpcServer()
+
+        def shard_file(req):
+            ext = req.param("ext", "")
+            if ext == ".idx":
+                return b"\x00" * 16
+
+            def broken():
+                yield b"x" * 1024
+                raise ConnectionError("source died mid-stream")
+
+            return Response(broken(),
+                            headers={"Content-Length": str(1 << 20)})
+
+        fake.add("GET", "/admin/ec/shard_file", shard_file)
+        fake.start()
+        try:
+            with pytest.raises(RpcError):
+                call(dst.address, "/admin/volume/copy",
+                     {"volume": 42, "collection": "",
+                      "source": fake.address}, timeout=60)
+            leftovers = [p.name for p in d.iterdir()
+                         if p.name.startswith("42")]
+            assert leftovers == [], f"partial files left: {leftovers}"
+            assert dst.store.find_volume(42) is None
+        finally:
+            fake.stop()
+            dst.stop()
+            master.stop()
