@@ -20,6 +20,15 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 ALGORITHM = "AWS4-HMAC-SHA256"
+CHUNK_ALGORITHM = "AWS4-HMAC-SHA256-PAYLOAD"
+# payload sentinels for sigv4 streaming uploads (auth_signature_v4.go:50-53;
+# the -TRAILER forms are sent by SDKs with flexible checksums enabled)
+STREAMING_PAYLOAD = "STREAMING-AWS4-HMAC-SHA256-PAYLOAD"
+STREAMING_PAYLOAD_TRAILER = "STREAMING-AWS4-HMAC-SHA256-PAYLOAD-TRAILER"
+STREAMING_UNSIGNED_TRAILER = "STREAMING-UNSIGNED-PAYLOAD-TRAILER"
+SIGNED_STREAMING = (STREAMING_PAYLOAD, STREAMING_PAYLOAD_TRAILER)
+ALL_STREAMING = SIGNED_STREAMING + (STREAMING_UNSIGNED_TRAILER,)
+EMPTY_SHA256 = hashlib.sha256(b"").hexdigest()
 MAX_CLOCK_SKEW_SECONDS = 15 * 60  # AWS allows +/-15 minutes
 
 # sub-resources included in the V2 canonicalized resource
@@ -91,7 +100,7 @@ class IdentityAccessManagement:
         auth_header = headers.get("Authorization", "")
         if auth_header.startswith(ALGORITHM):
             return self._verify_header(method, path, query, headers, body,
-                                       auth_header)
+                                       auth_header)[0]
         if query.get("X-Amz-Algorithm") == ALGORITHM:
             return self._verify_presigned(method, path, query, headers)
         if auth_header.startswith("AWS "):
@@ -100,6 +109,132 @@ class IdentityAccessManagement:
         if "Signature" in query and "AWSAccessKeyId" in query:
             return self._verify_v2_presigned(method, path, query, headers)
         raise AuthError("AccessDenied", "no valid authentication", 403)
+
+    def verify_and_decode(self, method: str, path: str, query: dict,
+                          headers, body: bytes):
+        """verify() plus streaming-upload handling: when the request is a
+        sigv4 streaming upload (x-amz-content-sha256 ==
+        STREAMING-AWS4-HMAC-SHA256-PAYLOAD, chunked_reader_v4.go), each
+        aws-chunked frame's signature is verified against the seed
+        signature chain and the decoded payload is returned.
+
+        Returns (identity, body) where body is the decoded payload for
+        streaming requests and the original bytes otherwise."""
+        sentinel = headers.get("X-Amz-Content-Sha256", "")
+        if not self.enabled:
+            # no identities configured: SDKs still send aws-chunked framed
+            # bodies — strip the framing (unverifiable without a secret)
+            if sentinel in ALL_STREAMING:
+                body = self._check_decoded_length(
+                    headers, self._decode_streaming_body(body))
+            return None, body
+        auth_header = headers.get("Authorization", "")
+        if not auth_header.startswith(ALGORITHM):
+            # presigned-v4 / sigv2 auth: chunk signatures need the
+            # header-auth seed chain, but SDK flexible-checksum mode can
+            # still frame the body — strip the framing here too
+            identity = self.verify(method, path, query, headers, body)
+            if sentinel in ALL_STREAMING:
+                body = self._check_decoded_length(
+                    headers, self._decode_streaming_body(body))
+            return identity, body
+        identity, seed, fields = self._verify_header(
+            method, path, query, headers, body, auth_header)
+        if sentinel not in ALL_STREAMING:
+            return identity, body
+        if sentinel in SIGNED_STREAMING:
+            _, datestamp, region, service, _ = \
+                fields["Credential"].split("/")
+            scope = f"{datestamp}/{region}/{service}/aws4_request"
+            key = self._signing_key(identity.secret_key, datestamp, region,
+                                    service)
+            decoded = self._decode_streaming_body(
+                body, key, seed, headers.get("X-Amz-Date", ""), scope,
+                allow_unsigned_final=(sentinel == STREAMING_PAYLOAD_TRAILER))
+        else:  # STREAMING-UNSIGNED-PAYLOAD-TRAILER: frames carry no sigs
+            decoded = self._decode_streaming_body(body)
+        return identity, self._check_decoded_length(headers, decoded)
+
+    @staticmethod
+    def _check_decoded_length(headers, decoded: bytes) -> bytes:
+        declared = headers.get("X-Amz-Decoded-Content-Length")
+        if declared is None:
+            # AWS mandates the header for aws-chunked uploads; without it
+            # a truncation at a chunk boundary would be undetectable
+            raise AuthError("MissingContentLength",
+                            "streaming upload requires "
+                            "x-amz-decoded-content-length", 411)
+        try:
+            expect = int(declared)
+        except ValueError:
+            raise AuthError("InvalidRequest",
+                            "malformed x-amz-decoded-content-length", 400)
+        if expect != len(decoded):
+            raise AuthError("IncompleteBody",
+                            "decoded length does not match "
+                            "x-amz-decoded-content-length", 400)
+        return decoded
+
+    @staticmethod
+    def _decode_streaming_body(body: bytes, signing_key: bytes = None,
+                               seed_signature: str = "", amz_date: str = "",
+                               scope: str = "",
+                               allow_unsigned_final: bool = False) -> bytes:
+        """Decode `<hex-size>[;chunk-signature=<sig>]\\r\\n<data>\\r\\n`
+        frames.  With a signing_key, each chunk signature is verified
+        against the running chain (sigv4-streaming spec;
+        chunked_reader_v4.go getChunkSignature); without one (unsigned
+        trailer or auth disabled) only the framing is decoded.  Trailer
+        headers after the final zero-length frame are ignored."""
+        verify_sigs = signing_key is not None
+        out = bytearray()
+        prev_sig = seed_signature
+        pos = 0
+        saw_final = False
+        while pos < len(body):
+            eol = body.find(b"\r\n", pos)
+            if eol < 0:
+                raise AuthError("IncompleteBody",
+                                "malformed chunk header", 400)
+            header = body[pos:eol].decode("ascii", "replace")
+            size_hex, _, ext = header.partition(";")
+            try:
+                size = int(size_hex, 16)
+            except ValueError:
+                raise AuthError("IncompleteBody",
+                                f"bad chunk size {size_hex!r}", 400)
+            chunk_sig = ""
+            for token in ext.split(";"):
+                k, _, v = token.partition("=")
+                if k.strip() == "chunk-signature":
+                    chunk_sig = v.strip()
+            data = body[eol + 2:eol + 2 + size]
+            if len(data) != size:
+                raise AuthError("IncompleteBody", "truncated chunk", 400)
+            pos = eol + 2 + size
+            if body[pos:pos + 2] == b"\r\n":
+                pos += 2
+            elif size > 0:
+                raise AuthError("IncompleteBody",
+                                "missing chunk trailer", 400)
+            if verify_sigs and not (size == 0 and not chunk_sig
+                                    and allow_unsigned_final):
+                string_to_sign = "\n".join([
+                    CHUNK_ALGORITHM, amz_date, scope, prev_sig,
+                    EMPTY_SHA256, hashlib.sha256(data).hexdigest()])
+                expected = hmac.new(signing_key, string_to_sign.encode(),
+                                    hashlib.sha256).hexdigest()
+                if not hmac.compare_digest(expected, chunk_sig):
+                    raise AuthError("SignatureDoesNotMatch",
+                                    "chunk signature mismatch", 403)
+                prev_sig = expected
+            if size == 0:
+                saw_final = True
+                break
+            out += data
+        if not saw_final:
+            raise AuthError("IncompleteBody", "missing final chunk", 400)
+        return bytes(out)
 
     def _parse_auth_header(self, auth_header: str) -> dict:
         # AWS4-HMAC-SHA256 Credential=AK/date/region/s3/aws4_request,
@@ -116,7 +251,7 @@ class IdentityAccessManagement:
         return fields
 
     def _verify_header(self, method, path, query, headers, body,
-                       auth_header) -> Identity:
+                       auth_header) -> tuple[Identity, str, dict]:
         fields = self._parse_auth_header(auth_header)
         cred_parts = fields["Credential"].split("/")
         if len(cred_parts) != 5:
@@ -138,6 +273,14 @@ class IdentityAccessManagement:
             payload_hash = payload_hash or hashlib.sha256(body).hexdigest()
         elif payload_hash.startswith("STREAMING-"):
             pass  # chunked uploads sign the seed; body chunks carry their own
+        else:
+            # an explicit hex digest must bind the actual body, or the
+            # signature doesn't cover the payload at all
+            if not hmac.compare_digest(payload_hash,
+                                       hashlib.sha256(body).hexdigest()):
+                raise AuthError("XAmzContentSHA256Mismatch",
+                                "x-amz-content-sha256 does not match the "
+                                "request payload", 400)
         canonical = self._canonical_request(
             method, path, query, headers, signed_headers, payload_hash)
         scope = f"{datestamp}/{region}/{service}/{terminal}"
@@ -149,7 +292,7 @@ class IdentityAccessManagement:
         if not hmac.compare_digest(signature, fields["Signature"]):
             raise AuthError("SignatureDoesNotMatch",
                             "signature mismatch", 403)
-        return identity
+        return identity, signature, fields
 
     def _verify_presigned(self, method, path, query, headers) -> Identity:
         cred = query.get("X-Amz-Credential", "")
@@ -369,14 +512,18 @@ class IdentityAccessManagement:
             ";".join(signed_headers), payload_hash])
 
     @staticmethod
-    def _signature(secret, datestamp, region, service,
-                   string_to_sign) -> str:
+    def _signing_key(secret, datestamp, region, service) -> bytes:
         def h(key, msg):
             return hmac.new(key, msg.encode(), hashlib.sha256).digest()
 
         k_date = h(("AWS4" + secret).encode(), datestamp)
         k_region = h(k_date, region)
         k_service = h(k_region, service)
-        k_signing = h(k_service, "aws4_request")
+        return h(k_service, "aws4_request")
+
+    @classmethod
+    def _signature(cls, secret, datestamp, region, service,
+                   string_to_sign) -> str:
+        k_signing = cls._signing_key(secret, datestamp, region, service)
         return hmac.new(k_signing, string_to_sign.encode(),
                         hashlib.sha256).hexdigest()

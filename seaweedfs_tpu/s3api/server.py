@@ -156,8 +156,8 @@ class S3ApiServer:
         action = ACTION_READ if method in ("GET", "HEAD") else ACTION_WRITE
         if method == "GET" and not key:
             action = ACTION_LIST
-        identity = self.iam.verify(method, path, req.query, req.headers,
-                                   req.body)
+        identity, req.body = self.iam.verify_and_decode(
+            method, path, req.query, req.headers, req.body)
         if identity is not None and not identity.can(action, bucket):
             raise AuthError("AccessDenied",
                             f"{action} not allowed on {bucket}", 403)

@@ -181,7 +181,11 @@ class VolumeServer:
             ec_encoder_backend=ec_encoder_backend,
             needle_map_kind=needle_map_kind, fsync=fsync)
         self._stop = threading.Event()
-        self._copy_lock = threading.Lock()
+        # per-volume-id copy locks: concurrent copies of the SAME vid must
+        # not race each other's temp files / exists-checks, but a slow copy
+        # of one volume must not serialize copies of unrelated volumes
+        self._copy_locks: dict[int, threading.Lock] = {}
+        self._copy_locks_mu = threading.Lock()
         self._heartbeat_thread: Optional[threading.Thread] = None
         self._ec_locations: dict[int, tuple[float, dict[int, list[str]]]] = {}
         self._register_routes()
@@ -805,10 +809,10 @@ class VolumeServer:
         vid = int(p["volume"])
         collection = p.get("collection", "")
         source = p["source"]
-        # serialize copies: two concurrent requests for the same vid must
-        # not both pass the exists-checks (TOCTOU) and then have one's
-        # rollback unlink the other's freshly-mounted files
-        with self._copy_lock:
+        # serialize copies of this vid: two concurrent requests for the
+        # same vid must not both pass the exists-checks (TOCTOU) and then
+        # have one's rollback unlink the other's freshly-mounted files
+        with self._vid_copy_lock(vid):
             if self.store.has_volume(vid):
                 raise RpcError(f"volume {vid} already exists", 409)
             loc = self.store.locations[0]
@@ -854,9 +858,15 @@ class VolumeServer:
                 if self.store.find_volume(vid) is None:
                     _remove_quiet(*(base + ext for ext in fetched))
                 raise
+            # read the cursor inside the lock: a concurrent delete after
+            # release must not turn a completed copy into a 500
+            last_ns = self.store.find_volume(vid).last_append_at_ns
         self._try_heartbeat()
-        return {"last_append_at_ns":
-                self.store.find_volume(vid).last_append_at_ns}
+        return {"last_append_at_ns": last_ns}
+
+    def _vid_copy_lock(self, vid: int) -> threading.Lock:
+        with self._copy_locks_mu:
+            return self._copy_locks.setdefault(vid, threading.Lock())
 
     def _h_volume_status(self, req: Request):
         """VolumeStatus + ReadVolumeFileStatus."""
@@ -996,31 +1006,35 @@ class VolumeServer:
         exts = [to_ext(int(s)) for s in p.get("shard_ids", [])]
         if p.get("copy_ecx_file", True):
             exts += [".ecx", ".ecj", ".vif"]
-        # stream to temp names, rename when complete: a mid-transfer
-        # failure must never leave a truncated shard to be mounted later
-        fetched: list[str] = []
-        try:
-            for ext in exts:
-                try:
-                    chunks = call_stream(
-                        source,
-                        f"/admin/ec/shard_file?volume={vid}"
-                        f"&collection={collection}&ext={ext}", timeout=600)
-                except RpcError as e:
-                    if e.status == 404 and ext in (".ecj", ".vif"):
-                        continue  # optional sidecars
-                    raise
-                with open(base + ext + ".cpy", "wb") as f:
-                    for chunk in chunks:
-                        f.write(chunk)
-                fetched.append(ext)
-        except Exception:
-            # RpcError before the first byte OR a mid-stream socket error:
-            # remove every temp, including the partial in-progress one
-            _remove_quiet(*(base + ext + ".cpy" for ext in exts))
-            raise
-        for ext in fetched:
-            os.replace(base + ext + ".cpy", base + ext)
+        # same per-vid serialization as volume copy: a failing request's
+        # rollback must not unlink a concurrent request's temp files
+        with self._vid_copy_lock(vid):
+            # stream to temp names, rename when complete: a mid-transfer
+            # failure must never leave a truncated shard mounted later
+            fetched: list[str] = []
+            try:
+                for ext in exts:
+                    try:
+                        chunks = call_stream(
+                            source,
+                            f"/admin/ec/shard_file?volume={vid}"
+                            f"&collection={collection}&ext={ext}",
+                            timeout=600)
+                    except RpcError as e:
+                        if e.status == 404 and ext in (".ecj", ".vif"):
+                            continue  # optional sidecars
+                        raise
+                    with open(base + ext + ".cpy", "wb") as f:
+                        for chunk in chunks:
+                            f.write(chunk)
+                    fetched.append(ext)
+            except Exception:
+                # RpcError before the first byte OR a mid-stream socket
+                # error: remove every temp incl. the partial in-progress
+                _remove_quiet(*(base + ext + ".cpy" for ext in exts))
+                raise
+            for ext in fetched:
+                os.replace(base + ext + ".cpy", base + ext)
         return {}
 
     def _h_ec_scrub(self, req: Request):

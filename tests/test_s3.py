@@ -437,3 +437,236 @@ class TestClientSigV4QueryEncoding:
         got = client.list_objects("docs", prefix="my folder/")
         assert sorted(o["key"] for o in got) == [
             "my folder/a.txt", "my folder/b.txt"]
+
+
+# --------------------------------------------------------------------------
+# sigv4 streaming (aws-chunked) uploads — chunked_reader_v4.go behaviour
+# --------------------------------------------------------------------------
+
+
+def _streaming_frames(payload: bytes, chunk_size: int, secret_key: str,
+                      seed_sig: str, amz_date: str, scope: str) -> bytes:
+    """Encode payload as signed aws-chunked frames (including the final
+    zero-length frame), per the sigv4 streaming spec."""
+    datestamp, region, service, _ = scope.split("/")
+    key = _sign(_sign(_sign(_sign(("AWS4" + secret_key).encode(),
+                                  datestamp), region), service),
+                "aws4_request")
+    frames = bytearray()
+    prev = seed_sig
+    chunks = [payload[i:i + chunk_size]
+              for i in range(0, len(payload), chunk_size)] + [b""]
+    for data in chunks:
+        sts = "\n".join([
+            "AWS4-HMAC-SHA256-PAYLOAD", amz_date, scope, prev,
+            hashlib.sha256(b"").hexdigest(),
+            hashlib.sha256(data).hexdigest()])
+        sig = hmac.new(key, sts.encode(), hashlib.sha256).hexdigest()
+        frames += f"{len(data):x};chunk-signature={sig}\r\n".encode()
+        frames += data + b"\r\n"
+        prev = sig
+    return bytes(frames)
+
+
+def streaming_sigv4_put(address, path, payload, access_key, secret_key,
+                        chunk_size=1024, tamper=None,
+                        region="us-east-1"):
+    """Issue a streaming-signed PUT; `tamper` mutates the encoded frames
+    before sending."""
+    now = time.gmtime()
+    amz_date = time.strftime("%Y%m%dT%H%M%SZ", now)
+    datestamp = time.strftime("%Y%m%d", now)
+    scope = f"{datestamp}/{region}/s3/aws4_request"
+    payload_hash = "STREAMING-AWS4-HMAC-SHA256-PAYLOAD"
+    headers = {
+        "Host": address,
+        "X-Amz-Date": amz_date,
+        "X-Amz-Content-Sha256": payload_hash,
+        "Content-Encoding": "aws-chunked",
+        "X-Amz-Decoded-Content-Length": str(len(payload)),
+    }
+    signed = sorted(["host", "x-amz-date", "x-amz-content-sha256",
+                     "content-encoding", "x-amz-decoded-content-length"])
+    lower = {k.lower(): v for k, v in headers.items()}
+    canonical = "\n".join([
+        "PUT", urllib.parse.quote(path, safe="/~"), "",
+        "".join(f"{h}:{' '.join(lower[h].split())}\n" for h in signed),
+        ";".join(signed), payload_hash])
+    sts = "\n".join(["AWS4-HMAC-SHA256", amz_date, scope,
+                     hashlib.sha256(canonical.encode()).hexdigest()])
+    k = _sign(_sign(_sign(_sign(("AWS4" + secret_key).encode(),
+                                datestamp), region), "s3"), "aws4_request")
+    seed_sig = hmac.new(k, sts.encode(), hashlib.sha256).hexdigest()
+    headers["Authorization"] = (
+        f"AWS4-HMAC-SHA256 Credential={access_key}/{scope}, "
+        f"SignedHeaders={';'.join(signed)}, Signature={seed_sig}")
+    body = _streaming_frames(payload, chunk_size, secret_key, seed_sig,
+                             amz_date, scope)
+    if tamper:
+        body = tamper(body)
+    req_ = urllib.request.Request(f"http://{address}{path}", data=body,
+                                  method="PUT", headers=headers)
+    try:
+        with urllib.request.urlopen(req_, timeout=30) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+class TestStreamingSigV4:
+    @pytest.fixture
+    def auth_stack(self, tmp_path):
+        master = MasterServer(port=0, pulse_seconds=0.2)
+        master.start()
+        d = tmp_path / "v"
+        d.mkdir()
+        vs = VolumeServer([str(d)], master.address, port=0,
+                          pulse_seconds=0.2)
+        vs.start()
+        vs.heartbeat_once()
+        filer = FilerServer(master.address, port=0)
+        filer.start()
+        s3 = S3ApiServer(filer, port=0, identities=[
+            Identity(name="admin", access_key="AKID", secret_key="SK"),
+        ])
+        s3.start()
+        yield s3
+        s3.stop()
+        filer.stop()
+        vs.stop()
+        master.stop()
+
+    def test_streaming_put_roundtrip(self, auth_stack):
+        s3 = auth_stack
+        sigv4_request(s3.address, "PUT", "/sb", access_key="AKID",
+                      secret_key="SK")
+        payload = bytes(range(256)) * 37  # multiple chunks at 1 KiB
+        status, body = streaming_sigv4_put(
+            s3.address, "/sb/streamed", payload, "AKID", "SK")
+        assert status == 200, body
+        status, _, got = sigv4_request(s3.address, "GET", "/sb/streamed",
+                                       access_key="AKID", secret_key="SK")
+        assert status == 200 and got == payload
+
+    def test_tampered_chunk_rejected(self, auth_stack):
+        s3 = auth_stack
+        sigv4_request(s3.address, "PUT", "/sb", access_key="AKID",
+                      secret_key="SK")
+
+        def flip_payload_byte(frames: bytes) -> bytes:
+            # flip one byte of chunk data (after the first header line)
+            idx = frames.find(b"\r\n") + 2
+            return frames[:idx] + bytes([frames[idx] ^ 0xFF]) \
+                + frames[idx + 1:]
+
+        status, body = streaming_sigv4_put(
+            s3.address, "/sb/tampered", b"A" * 4096, "AKID", "SK",
+            tamper=flip_payload_byte)
+        assert status == 403
+        assert b"SignatureDoesNotMatch" in body
+
+    def test_truncated_stream_rejected(self, auth_stack):
+        s3 = auth_stack
+        sigv4_request(s3.address, "PUT", "/sb", access_key="AKID",
+                      secret_key="SK")
+
+        def drop_final_frame(frames: bytes) -> bytes:
+            # remove the 0-length terminator frame
+            idx = frames.rfind(b"0;chunk-signature=")
+            return frames[:idx]
+
+        status, body = streaming_sigv4_put(
+            s3.address, "/sb/truncated", b"B" * 4096, "AKID", "SK",
+            tamper=drop_final_frame)
+        assert status == 400
+        assert b"IncompleteBody" in body
+
+    def test_decoded_length_mismatch_rejected(self):
+        """Unit-level: declared x-amz-decoded-content-length must match."""
+        iam = IdentityAccessManagement([
+            Identity(name="a", access_key="AK", secret_key="SK")])
+        now = time.gmtime()
+        amz_date = time.strftime("%Y%m%dT%H%M%SZ", now)
+        datestamp = time.strftime("%Y%m%d", now)
+        scope = f"{datestamp}/us-east-1/s3/aws4_request"
+        payload_hash = "STREAMING-AWS4-HMAC-SHA256-PAYLOAD"
+        headers = {
+            "Host": "h", "X-Amz-Date": amz_date,
+            "X-Amz-Content-Sha256": payload_hash,
+            "X-Amz-Decoded-Content-Length": "9999",
+        }
+        signed = sorted(["host", "x-amz-date", "x-amz-content-sha256",
+                         "x-amz-decoded-content-length"])
+        lower = {k.lower(): v for k, v in headers.items()}
+        canonical = "\n".join([
+            "PUT", "/b/k", "",
+            "".join(f"{h}:{lower[h]}\n" for h in signed),
+            ";".join(signed), payload_hash])
+        sts = "\n".join(["AWS4-HMAC-SHA256", amz_date, scope,
+                         hashlib.sha256(canonical.encode()).hexdigest()])
+        k = _sign(_sign(_sign(_sign(b"AWS4SK", datestamp), "us-east-1"),
+                        "s3"), "aws4_request")
+        seed = hmac.new(k, sts.encode(), hashlib.sha256).hexdigest()
+        headers["Authorization"] = (
+            f"AWS4-HMAC-SHA256 Credential=AK/{scope}, "
+            f"SignedHeaders={';'.join(signed)}, Signature={seed}")
+        frames = _streaming_frames(b"hello world", 1024, "SK", seed,
+                                   amz_date, scope)
+        # plain dicts are case-sensitive (unlike the HTTP Message the
+        # server passes); provide both cases for the canonical lookup
+        send = {**{k.lower(): v for k, v in headers.items()}, **headers}
+        from seaweedfs_tpu.s3api.auth import AuthError as AErr
+        with pytest.raises(AErr) as ei:
+            iam.verify_and_decode("PUT", "/b/k", {}, send, frames)
+        assert ei.value.code == "IncompleteBody"
+
+    def test_unsigned_trailer_decoded_without_auth(self):
+        """STREAMING-UNSIGNED-PAYLOAD-TRAILER frames (and auth-disabled
+        gateways) must still have the aws-chunked framing stripped."""
+        iam = IdentityAccessManagement()  # auth disabled
+        payload = b"0123456789" * 100
+        frames = (f"{len(payload):x}\r\n".encode() + payload + b"\r\n"
+                  + b"0\r\n"
+                  + b"x-amz-checksum-crc32:AAAAAA==\r\n\r\n")
+        headers = {"X-Amz-Content-Sha256":
+                   "STREAMING-UNSIGNED-PAYLOAD-TRAILER",
+                   "X-Amz-Decoded-Content-Length": str(len(payload))}
+        ident, body = iam.verify_and_decode("PUT", "/b/k", {}, headers,
+                                            frames)
+        assert ident is None and body == payload
+
+    def test_unsigned_trailer_decoded_with_auth(self):
+        """An authenticated PUT with the unsigned-trailer sentinel:
+        seed signature verified, frames decoded without chunk sigs."""
+        iam = IdentityAccessManagement([
+            Identity(name="a", access_key="AK", secret_key="SK")])
+        payload = b"hello trailer world"
+        frames = (f"{len(payload):x}\r\n".encode() + payload + b"\r\n"
+                  + b"0\r\n\r\n")
+        now = time.gmtime()
+        amz_date = time.strftime("%Y%m%dT%H%M%SZ", now)
+        datestamp = time.strftime("%Y%m%d", now)
+        scope = f"{datestamp}/us-east-1/s3/aws4_request"
+        ph = "STREAMING-UNSIGNED-PAYLOAD-TRAILER"
+        headers = {"host": "h", "x-amz-date": amz_date,
+                   "x-amz-content-sha256": ph,
+                   "x-amz-decoded-content-length": str(len(payload))}
+        signed = sorted(headers)
+        canonical = "\n".join([
+            "PUT", "/b/k", "",
+            "".join(f"{h}:{headers[h]}\n" for h in signed),
+            ";".join(signed), ph])
+        sts = "\n".join(["AWS4-HMAC-SHA256", amz_date, scope,
+                         hashlib.sha256(canonical.encode()).hexdigest()])
+        k = _sign(_sign(_sign(_sign(b"AWS4SK", datestamp), "us-east-1"),
+                        "s3"), "aws4_request")
+        seed = hmac.new(k, sts.encode(), hashlib.sha256).hexdigest()
+        send = dict(headers)
+        send["X-Amz-Date"] = amz_date
+        send["X-Amz-Content-Sha256"] = ph
+        send["X-Amz-Decoded-Content-Length"] = str(len(payload))
+        send["Authorization"] = (
+            f"AWS4-HMAC-SHA256 Credential=AK/{scope}, "
+            f"SignedHeaders={';'.join(signed)}, Signature={seed}")
+        ident, body = iam.verify_and_decode("PUT", "/b/k", {}, send, frames)
+        assert ident.name == "a" and body == payload
