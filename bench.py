@@ -136,7 +136,7 @@ def bench_tpu_kernel(method: str, length: int, block: int | None = None,
 
 
 def bench_hbm_fused(batch: int, length: int,
-                    chains: tuple[int, int] = (2, 6), reps: int = 2,
+                    chains: tuple[int, int] = (8, 24), reps: int = 3,
                     variant: str = "xla") -> float:
     """Slope throughput of the production batched step (parity + fused
     CRC32C) on an HBM-resident (B, 10, L) batch.  variant: "xla" (the

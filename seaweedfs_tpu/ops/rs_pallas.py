@@ -102,7 +102,8 @@ def apply_matrix_pallas(matrix: np.ndarray, data, block: int = DEFAULT_BLOCK,
 # ---------------------------------------------------------------------------
 
 
-def _fused_kernel(bm_ref, w_ref, x_ref, par_ref, crc_ref, *, d: int, p: int):
+def _fused_kernel(bm_ref, w3_ref, x_ref, par_ref, crc_ref, *, d: int,
+                  p: int):
     x = x_ref[0].astype(jnp.int32)  # (d, BLOCK)
     block = x.shape[-1]
     shifts = jax.lax.broadcasted_iota(jnp.int32, (1, 8, 1), 1)
@@ -114,24 +115,40 @@ def _fused_kernel(bm_ref, w_ref, x_ref, par_ref, crc_ref, *, d: int, p: int):
     weights = jnp.left_shift(1, shifts)  # (1, 8, 1)
     par_ref[0] = (out_bits.reshape(p, 8, block) * weights).sum(
         axis=1).astype(jnp.uint8)
-    # CRC of every shard's BLOCK-byte segment: rows (shard, bit-plane,
-    # byte) flatten to plane-major (shard, 8*BLOCK) for free (row-major
-    # layout), matching w_ref's plane-major row order
+    # CRC via plane-partial images: one matmul of the SAME bit rows the
+    # parity used (rows (shard, plane), no re-extraction or relayout)
+    # against a widened (BLOCK, 8*32) matrix whose column group p8' holds
+    # the segment matrix restricted to plane p8'.  Row (s, p8) x group
+    # p8' is only meaningful on the diagonal p8 == p8'; the off-diagonal
+    # 7/8 of the MXU work is the price of skipping a second 14-row bit
+    # extraction, and measures ~1.6x faster end to end
     full_bits = jnp.concatenate(
         [bits, out_bits.astype(jnp.int8)], axis=0)  # ((d+p)*8, BLOCK)
-    seg_in = full_bits.reshape(d + p, 8 * block)
-    crc_bits = (jax.lax.dot(
-        seg_in, w_ref[:], preferred_element_type=jnp.int32) & 1
-    ).astype(jnp.uint32)  # (d+p, 32)
+    y2 = jax.lax.dot(
+        full_bits, w3_ref[:],
+        preferred_element_type=jnp.int32)  # ((d+p)*8, 256)
+    # sublane-dim reshape only (Mosaic cannot split the 256 lane dim),
+    # then 8 static diagonal slices accumulate the per-plane partials
+    y3 = y2.reshape(d + p, 8, 256)
+    acc = y3[:, 0, 0:32]
+    for p8 in range(1, 8):
+        acc = acc + y3[:, p8, p8 * 32:(p8 + 1) * 32]
+    crc_bits = acc & 1  # (d+p, 32)
+    # pack bits into words in int32 (Mosaic has no unsigned reductions;
+    # bit 31 rides the sign bit with the right pattern) and bitcast out
     w32 = jnp.left_shift(
-        jnp.uint32(1),
-        jax.lax.broadcasted_iota(jnp.uint32, (1, 32), 1))
-    crc_ref[0, 0] = (crc_bits * w32).sum(axis=-1, dtype=jnp.uint32)
+        jnp.int32(1), jax.lax.broadcasted_iota(jnp.int32, (1, 32), 1))
+    packed = (crc_bits * w32).sum(axis=-1)  # (d+p,) int32
+    # the CRC words ride an (8, 128) tile: TPU block shapes must be
+    # (8, 128)-aligned in their last two dims, and d+p=14 is neither —
+    # row 0 holds the real words, the rest is padding the host slices off
+    tile = jnp.pad(packed[None, :], ((0, 7), (0, 128 - (d + p))))
+    crc_ref[0, 0] = jax.lax.bitcast_convert_type(tile, jnp.uint32)
 
 
 @functools.partial(
     jax.jit, static_argnames=("d", "p", "block", "interpret"))
-def _fused_encode_pallas(bit_matrix, w, data, d: int, p: int, block: int,
+def _fused_encode_pallas(bit_matrix, w3, data, d: int, p: int, block: int,
                          interpret: bool):
     b, _, length = data.shape
     nseg = length // block
@@ -140,13 +157,13 @@ def _fused_encode_pallas(bit_matrix, w, data, d: int, p: int, block: int,
         kernel,
         out_shape=(
             jax.ShapeDtypeStruct((b, p, length), jnp.uint8),
-            jax.ShapeDtypeStruct((b, nseg, d + p), jnp.uint32),
+            jax.ShapeDtypeStruct((b, nseg, 8, 128), jnp.uint32),
         ),
         grid=(b, nseg),
         in_specs=[
             pl.BlockSpec((p * 8, d * 8), lambda bi, i: (0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((8 * block, 32), lambda bi, i: (0, 0),
+            pl.BlockSpec((block, 256), lambda bi, i: (0, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, d, block), lambda bi, i: (bi, 0, i),
                          memory_space=pltpu.VMEM),
@@ -154,16 +171,28 @@ def _fused_encode_pallas(bit_matrix, w, data, d: int, p: int, block: int,
         out_specs=(
             pl.BlockSpec((1, p, block), lambda bi, i: (bi, 0, i),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, d + p), lambda bi, i: (bi, i, 0),
+            pl.BlockSpec((1, 1, 8, 128), lambda bi, i: (bi, i, 0, 0),
                          memory_space=pltpu.VMEM),
         ),
         interpret=interpret,
         cost_estimate=pl.CostEstimate(
-            flops=2 * (p * 8 * d * 8 + (d + p) * 32 * 8) * length * b,
+            flops=2 * (p * 8 * d * 8 + (d + p) * 8 * 256) * length * b,
             bytes_accessed=(d + p) * length * b,
             transcendentals=0,
         ),
-    )(bit_matrix, w, data)
+    )(bit_matrix, w3, data)
+
+
+@functools.lru_cache(maxsize=8)
+def _plane_partial_matrix(block: int) -> np.ndarray:
+    """W3 (block, 256) int8: column group p8 (cols 32*p8..32*p8+31) is the
+    segment CRC matrix restricted to bit-plane p8, so a (shard, plane) bit
+    row contracted with group p8 yields that plane's partial CRC image."""
+    from .crc_device import _segment_matrix
+
+    w = _segment_matrix(block)  # (8*block, 32), rows (plane, byte)
+    return np.ascontiguousarray(
+        w.reshape(8, block, 32).transpose(1, 0, 2).reshape(block, 256))
 
 
 def fused_encode_block(length: int, block: int = DEFAULT_BLOCK) -> int:
@@ -200,10 +229,12 @@ def fused_encode_pallas(matrix: np.ndarray, data,
         raise ValueError(f"length {length} unsupported by fused kernel")
     nseg = length // block
     bm = jnp.asarray(_bit_matrix_cached(*_matrix_key(matrix)))
-    w = jnp.asarray(_segment_matrix(block))
+    w3 = jnp.asarray(_plane_partial_matrix(block))
     if interpret is None:
         interpret = not on_tpu()
-    parity, seg = _fused_encode_pallas(bm, w, data, d, p, block, interpret)
+    parity, seg_tiles = _fused_encode_pallas(bm, w3, data, d, p, block,
+                                             interpret)
+    seg = seg_tiles[:, :, 0, :d + p]  # strip the (8, 128) tile padding
     # combine segment images left-to-right with the advance-matrix tree
     # (the shared fold from crc_device)
     shifts = jnp.arange(32, dtype=jnp.uint32)
