@@ -19,16 +19,21 @@ from typing import Optional
 class FileChunk:
     fid: str  # "vid,keyhexcookiehex"
     offset: int  # position in the logical file
-    size: int
+    size: int  # PLAINTEXT size (ciphered blobs are larger on the volume)
     etag: str = ""
     modified_ts_ns: int = 0
     is_chunk_manifest: bool = False  # chunk holds a serialized chunk list
+    # per-chunk AES key for encrypt-at-rest (filer.proto FileChunk
+    # cipher_key); lives only in filer metadata, never on volume servers
+    cipher_key: bytes = b""
 
     def to_dict(self) -> dict:
         d = {"fid": self.fid, "offset": self.offset, "size": self.size,
              "etag": self.etag, "modified_ts_ns": self.modified_ts_ns}
         if self.is_chunk_manifest:
             d["is_chunk_manifest"] = True
+        if self.cipher_key:
+            d["cipher_key"] = self.cipher_key.hex()
         return d
 
     @classmethod
@@ -36,7 +41,9 @@ class FileChunk:
         return cls(fid=d["fid"], offset=d["offset"], size=d["size"],
                    etag=d.get("etag", ""),
                    modified_ts_ns=d.get("modified_ts_ns", 0),
-                   is_chunk_manifest=d.get("is_chunk_manifest", False))
+                   is_chunk_manifest=d.get("is_chunk_manifest", False),
+                   cipher_key=bytes.fromhex(d["cipher_key"])
+                   if d.get("cipher_key") else b"")
 
 
 @dataclass

@@ -51,7 +51,8 @@ def maybe_manifestize(save: SaveFn, chunks: list[FileChunk],
             size=total_size(group) - start,
             etag=saved.etag,
             modified_ts_ns=max(c.modified_ts_ns for c in group),
-            is_chunk_manifest=True))
+            is_chunk_manifest=True,
+            cipher_key=saved.cipher_key))
     out.extend(plain[len(plain) - len(plain) % batch:])
     return maybe_manifestize(save, out, batch)
 
@@ -70,7 +71,11 @@ def resolve_chunk_manifest(fetch: FetchFn, chunks: list[FileChunk],
             continue
         if keep_manifests:
             out.append(c)
+        blob = fetch(c.fid)
+        if c.cipher_key:  # manifest blobs encrypt like data chunks
+            from ..util.cipher import decrypt
+            blob = decrypt(blob, c.cipher_key)
         nested = [FileChunk.from_dict(d)
-                  for d in json.loads(fetch(c.fid).decode())]
+                  for d in json.loads(blob.decode())]
         out.extend(resolve_chunk_manifest(fetch, nested, keep_manifests))
     return out

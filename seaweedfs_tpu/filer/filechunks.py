@@ -23,6 +23,7 @@ class VisibleInterval:
     modified_ts_ns: int
     chunk_offset: int  # where `start` falls inside the chunk
     chunk_size: int
+    cipher_key: bytes = b""
 
 
 def non_overlapping_visible_intervals(chunks: list[FileChunk]
@@ -34,7 +35,8 @@ def non_overlapping_visible_intervals(chunks: list[FileChunk]
         new_v = VisibleInterval(
             start=chunk.offset, stop=chunk.offset + chunk.size,
             fid=chunk.fid, modified_ts_ns=chunk.modified_ts_ns,
-            chunk_offset=0, chunk_size=chunk.size)
+            chunk_offset=0, chunk_size=chunk.size,
+            cipher_key=chunk.cipher_key)
         out: list[VisibleInterval] = []
         for v in visibles:
             if v.stop <= new_v.start or v.start >= new_v.stop:
@@ -45,13 +47,15 @@ def non_overlapping_visible_intervals(chunks: list[FileChunk]
                     start=v.start, stop=new_v.start, fid=v.fid,
                     modified_ts_ns=v.modified_ts_ns,
                     chunk_offset=v.chunk_offset,
-                    chunk_size=v.chunk_size))
+                    chunk_size=v.chunk_size,
+                    cipher_key=v.cipher_key))
             if v.stop > new_v.stop:
                 out.append(VisibleInterval(
                     start=new_v.stop, stop=v.stop, fid=v.fid,
                     modified_ts_ns=v.modified_ts_ns,
                     chunk_offset=v.chunk_offset + (new_v.stop - v.start),
-                    chunk_size=v.chunk_size))
+                    chunk_size=v.chunk_size,
+                    cipher_key=v.cipher_key))
         out.append(new_v)
         visibles = sorted(out, key=lambda v: v.start)
     return visibles
@@ -63,6 +67,7 @@ class ChunkView:
     offset_in_chunk: int
     size: int
     logic_offset: int
+    cipher_key: bytes = b""
 
 
 def read_chunk_views(chunks: list[FileChunk], offset: int,
@@ -80,7 +85,8 @@ def read_chunk_views(chunks: list[FileChunk], offset: int,
             fid=v.fid,
             offset_in_chunk=v.chunk_offset + (start - v.start),
             size=end - start,
-            logic_offset=start))
+            logic_offset=start,
+            cipher_key=v.cipher_key))
     return views
 
 

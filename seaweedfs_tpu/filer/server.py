@@ -46,11 +46,16 @@ class FilerServer:
                  peers: Optional[list[str]] = None,
                  persist_meta_log: bool = False,
                  chunk_cache_bytes: int = 64 << 20,
-                 manifest_batch: int = MANIFEST_BATCH):
+                 manifest_batch: int = MANIFEST_BATCH,
+                 cipher: bool = False):
         self.master_address = master_address
         self.chunk_size = chunk_size
         self.replication = replication
         self.collection = collection
+        # encrypt-at-rest: every uploaded chunk gets a fresh AES-256-GCM
+        # key stored on its chunk record (-encryptVolumeData,
+        # filer_server_handlers_write_cipher.go)
+        self.cipher = cipher
         self.guard = guard or Guard()
         self.filer = Filer(store)
         self.filer.on_delete_chunks = self._delete_chunks
@@ -225,18 +230,31 @@ class FilerServer:
 
     def _upload_blob(self, piece: bytes, replication: str = "",
                      collection: str = "") -> FileChunk:
-        """Assign a fid and upload one blob to the volume cluster."""
+        """Assign a fid and upload one blob to the volume cluster; with
+        -encryptVolumeData the volume only ever sees AES-GCM ciphertext
+        and the per-chunk key rides the chunk record (fs.encrypt,
+        filer_server_handlers_write_cipher.go)."""
+        key = b""
+        payload = piece
+        if self.cipher:
+            from ..util.cipher import encrypt, gen_cipher_key
+
+            key = gen_cipher_key()
+            payload = encrypt(piece, key)
         assign = self._assign(replication=replication, collection=collection)
         fid, url = assign["fid"], assign["url"]
         headers = {"Content-Type": "application/octet-stream"}
         if assign.get("auth"):
             # forward the assign-minted write JWT (jwt-enabled cluster)
             headers["Authorization"] = "BEARER " + assign["auth"]
-        up = call(url, f"/{fid}", raw=piece, method="POST",
+        up = call(url, f"/{fid}", raw=payload, method="POST",
                   headers=headers, timeout=60)
+        # size is the PLAINTEXT length: interval math over the logical
+        # file must not see the nonce/tag overhead
         return FileChunk(fid=fid, offset=0, size=len(piece),
                          etag=up.get("eTag", ""),
-                         modified_ts_ns=time.time_ns())
+                         modified_ts_ns=time.time_ns(),
+                         cipher_key=key)
 
     def save_bytes(self, path: str, body: bytes, mime: str = "",
                    extended: Optional[dict] = None) -> Entry:
@@ -315,6 +333,12 @@ class FilerServer:
         parts = []
         for view in read_chunk_views(chunks, start, length):
             data = self._fetch_chunk(view.fid)
+            if view.cipher_key:
+                # cache holds what the volume stores (ciphertext);
+                # plaintext exists only in flight
+                from ..util.cipher import decrypt
+
+                data = decrypt(data, view.cipher_key)
             parts.append(data[view.offset_in_chunk:
                               view.offset_in_chunk + view.size])
         return b"".join(parts)

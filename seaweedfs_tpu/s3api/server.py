@@ -569,7 +569,9 @@ class S3ApiServer:
             for c in sorted(source_chunks, key=lambda c: c.offset):
                 final.chunks.append(FileChunk(
                     fid=c.fid, offset=offset + c.offset, size=c.size,
-                    etag=c.etag, modified_ts_ns=time.time_ns()))
+                    etag=c.etag, modified_ts_ns=time.time_ns(),
+                    is_chunk_manifest=c.is_chunk_manifest,
+                    cipher_key=c.cipher_key))
             offset += p.size()
         final.attr.file_size = offset
         etag = f"{hashlib.md5(md5s).hexdigest()}-{len(parts)}"
@@ -606,13 +608,9 @@ class S3ApiServer:
         return numbers or None
 
     def _force_chunk(self, content: bytes) -> list[FileChunk]:
-        from ..rpc.http_rpc import call
-
-        assign = self.filer_server._assign()
-        up = call(assign["url"], f"/{assign['fid']}", raw=content,
-                  method="POST", timeout=60)
-        return [FileChunk(fid=assign["fid"], offset=0, size=len(content),
-                          etag=up.get("eTag", ""))]
+        # the filer's uploader so encrypt-at-rest and JWT forwarding apply
+        # to inlined small parts too
+        return [self.filer_server._upload_blob(content)]
 
     def _abort_multipart(self, bucket: str, key: str, req: Request):
         upload_id = req.param("uploadId")

@@ -751,7 +751,11 @@ class VolumeServer:
         return {}
 
     def _h_delete_volume(self, req: Request):
-        self.store.delete_volume(int(req.json()["volume"]))
+        vid = int(req.json()["volume"])
+        # share the copy lock: a delete landing between a copy's mount and
+        # its status read must not turn the completed copy into a 500
+        with self._vid_copy_lock(vid):
+            self.store.delete_volume(vid)
         self._try_heartbeat()
         return {}
 
@@ -795,10 +799,11 @@ class VolumeServer:
     def _h_volume_unmount(self, req: Request):
         """VolumeUnmount: close + forget the volume, leave files on disk."""
         vid = int(req.json()["volume"])
-        loc = self.store.location_of(vid)
-        if loc is None:
-            raise RpcError(f"volume {vid} not found", 404)
-        loc.unload_volume(vid)
+        with self._vid_copy_lock(vid):
+            loc = self.store.location_of(vid)
+            if loc is None:
+                raise RpcError(f"volume {vid} not found", 404)
+            loc.unload_volume(vid)
         self._try_heartbeat()
         return {}
 

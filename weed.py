@@ -153,7 +153,8 @@ def cmd_filer(args):
                     replication=args.replication,
                     collection=args.collection, guard=_load_guard(),
                     peers=args.peers.split(",") if args.peers else None,
-                    persist_meta_log=args.metaLog)
+                    persist_meta_log=args.metaLog,
+                    cipher=args.encryptVolumeData)
     _wire_notification(f)
     f.start()
     stoppables = [f]
@@ -266,7 +267,8 @@ def cmd_server(args):
     if args.filer or args.s3 or args.iam:
         store = _make_filer_store(args.store, args.db)
         filer = FilerServer(master.address, host=args.ip,
-                            port=args.filerPort, store=store, guard=guard)
+                            port=args.filerPort, store=store, guard=guard,
+                            cipher=args.encryptVolumeData)
         _wire_notification(filer)
         filer.start()
         stoppables.append(filer)
@@ -978,6 +980,9 @@ def main(argv=None):
                    help="comma-separated peer filers to aggregate")
     p.add_argument("-metaLog", action="store_true",
                    help="persist the metadata change log")
+    p.add_argument("-encryptVolumeData", action="store_true",
+                   help="encrypt chunk data at rest (per-chunk AES keys "
+                        "in filer metadata)")
     p.set_defaults(fn=cmd_filer)
 
     p = sub.add_parser("s3", help="start an s3 gateway (+embedded filer)")
@@ -1018,6 +1023,9 @@ def main(argv=None):
                    help="filer store kind: sqlite | sharded | perbucket")
     p.add_argument("-config", default="")
     p.add_argument("-rack", default="")
+    p.add_argument("-encryptVolumeData", action="store_true",
+                   help="encrypt chunk data at rest (per-chunk AES keys "
+                        "in filer metadata)")
     p.set_defaults(fn=cmd_server)
 
     p = sub.add_parser("shell", help="interactive admin shell")
