@@ -50,3 +50,6 @@ class ChunkCache:
     @property
     def size_bytes(self) -> int:
         return self._bytes
+
+    def close(self):
+        """No resources to release; shares the tiered cache's interface."""

@@ -47,7 +47,9 @@ class FilerServer:
                  persist_meta_log: bool = False,
                  chunk_cache_bytes: int = 64 << 20,
                  manifest_batch: int = MANIFEST_BATCH,
-                 cipher: bool = False):
+                 cipher: bool = False,
+                 cache_dir: str = "",
+                 cache_disk_bytes: int = 1 << 30):
         self.master_address = master_address
         self.chunk_size = chunk_size
         self.replication = replication
@@ -55,13 +57,30 @@ class FilerServer:
         # encrypt-at-rest: every uploaded chunk gets a fresh AES-256-GCM
         # key stored on its chunk record (-encryptVolumeData,
         # filer_server_handlers_write_cipher.go)
+        if cipher:
+            from ..util.cipher import cipher_available
+
+            if not cipher_available():
+                raise RuntimeError(
+                    "-encryptVolumeData needs the cryptography library; "
+                    "refusing to start a filer that would fail every "
+                    "write")
         self.cipher = cipher
         self.guard = guard or Guard()
         self.filer = Filer(store)
         self.filer.on_delete_chunks = self._delete_chunks
         if persist_meta_log:
             self.filer.enable_meta_log()
-        self.chunk_cache = ChunkCache(chunk_cache_bytes)
+        if cache_dir:
+            # tiered cache: RAM LRU + size-classed on-disk FIFO layers
+            # (util/chunk_cache, -cacheDir)
+            from ..util.chunk_cache import TieredChunkCache
+
+            self.chunk_cache = TieredChunkCache(
+                cache_dir, mem_bytes=chunk_cache_bytes,
+                disk_bytes=cache_disk_bytes)
+        else:
+            self.chunk_cache = ChunkCache(chunk_cache_bytes)
         self.manifest_batch = manifest_batch
         self.meta_aggregator: Optional[MetaAggregator] = None
         if peers:
@@ -101,6 +120,7 @@ class FilerServer:
         self.server.stop()
         self.filer.close()  # flush buffered change-log events
         self.filer.store.close()
+        self.chunk_cache.close()  # tiered cache drops its disk segments
 
     # -- per-path configuration (filer_conf.go, 1s refresh) ------------------
     def filer_conf(self) -> FilerConf:
@@ -142,16 +162,21 @@ class FilerServer:
                      timeout=10)
         return found["locations"][0]["url"]
 
-    def _delete_chunks(self, chunks: list[FileChunk]):
+    def _delete_chunks(self, chunks: list[FileChunk],
+                       exclude_fids: Optional[set] = None):
         # expand manifest chunks so the data chunks they list are deleted
         # too (manifest blobs themselves, at every level, are also chunks
-        # to reclaim)
+        # to reclaim); exclude_fids applies AFTER expansion so chunks a
+        # manifest lists but another entry now owns survive (multipart
+        # complete hands part data chunks to the final entry)
         if has_chunk_manifest(chunks):
             try:
                 chunks = resolve_chunk_manifest(
                     self._fetch_chunk, chunks, keep_manifests=True)
             except (RpcError, ValueError):
                 pass  # a manifest blob is already gone; delete what we have
+        if exclude_fids:
+            chunks = [c for c in chunks if c.fid not in exclude_fids]
         for chunk in chunks:
             headers = {}
             if self.guard.signing:

@@ -19,6 +19,8 @@ import xml.etree.ElementTree as ET
 from typing import Optional
 
 from ..filer.entry import Entry, FileChunk, new_directory_entry
+from ..filer.filechunk_manifest import (has_chunk_manifest,
+                                        resolve_chunk_manifest)
 from ..filer.filer_store import NotFoundError
 from ..filer.server import FilerServer
 from ..rpc.http_rpc import Request, Response, RpcError, RpcServer
@@ -566,6 +568,12 @@ class S3ApiServer:
                 source_chunks = self._force_chunk(p.content)
             else:
                 source_chunks = p.chunks
+            if has_chunk_manifest(source_chunks):
+                # manifest blobs serialize part-RELATIVE offsets; shifting
+                # the outer chunk would leave the nested ones unshifted,
+                # so compose from the flattened plain chunks instead
+                source_chunks = resolve_chunk_manifest(
+                    self.filer_server._fetch_chunk, source_chunks)
             for c in sorted(source_chunks, key=lambda c: c.offset):
                 final.chunks.append(FileChunk(
                     fid=c.fid, offset=offset + c.offset, size=c.size,
@@ -578,11 +586,14 @@ class S3ApiServer:
         final.attr.md5 = etag
         self.filer.create_entry(final)
         # drop the staging dir without reclaiming chunks now owned by the
-        # final entry
+        # final entry; exclusion happens inside _delete_chunks AFTER
+        # manifest expansion, so a part's manifest blob is reclaimed while
+        # the data chunks it lists (now the final entry's) survive
         saved_hook = self.filer.on_delete_chunks
         final_fids = {c.fid for c in final.chunks}
-        self.filer.on_delete_chunks = lambda chunks: saved_hook(
-            [c for c in chunks if c.fid not in final_fids])
+        self.filer.on_delete_chunks = lambda chunks: \
+            self.filer_server._delete_chunks(chunks,
+                                             exclude_fids=final_fids)
         try:
             self.filer.delete_entry(upload_dir, recursive=True)
         finally:

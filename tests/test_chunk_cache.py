@@ -1,0 +1,117 @@
+"""Tiered chunk cache: RAM LRU + size-classed on-disk FIFO layers
+(util/chunk_cache.go TieredChunkCache semantics)."""
+
+import os
+
+from seaweedfs_tpu.util.chunk_cache import (CacheVolume, OnDiskCacheLayer,
+                                            TieredChunkCache)
+
+
+class TestCacheVolume:
+    def test_put_get_reset(self, tmp_path):
+        v = CacheVolume(str(tmp_path / "v.dat"), 1024)
+        v.put("1,a", b"alpha")
+        v.put("1,b", b"beta")
+        assert v.get("1,a") == b"alpha"
+        assert v.get("1,b") == b"beta"
+        assert v.get("1,c") is None
+        v.reset()
+        assert v.get("1,a") is None
+        assert v.file_size == 0
+        v.close()
+
+
+class TestOnDiskCacheLayer:
+    def test_rotation_evicts_oldest(self, tmp_path):
+        # 2 segments x 100 bytes
+        layer = OnDiskCacheLayer(str(tmp_path), "t", 200, 2)
+        layer.put("1,a", b"A" * 90)   # seg0
+        layer.put("1,b", b"B" * 90)   # seg0 full -> rotate, b to fresh seg
+        layer.put("1,c", b"C" * 90)   # rotate again: a's segment reset
+        assert layer.get("1,a") is None  # FIFO-evicted
+        assert layer.get("1,b") == b"B" * 90
+        assert layer.get("1,c") == b"C" * 90
+        layer.close()
+
+    def test_oversized_entry_skipped(self, tmp_path):
+        layer = OnDiskCacheLayer(str(tmp_path), "t", 100, 2)
+        layer.put("1,x", b"X" * 500)  # larger than a whole segment
+        assert layer.get("1,x") is None
+        layer.close()
+
+
+class TestTieredChunkCache:
+    def test_size_classes_route_to_layers(self, tmp_path):
+        c = TieredChunkCache(str(tmp_path), mem_bytes=1 << 20,
+                             disk_bytes=64 << 20, unit_size=1024)
+        small = b"s" * 512        # <= unit -> mem + layer0
+        medium = b"m" * 3000      # <= 4*unit -> layer1
+        large = b"L" * 9000       # else -> layer2
+        c.put("1,s", small)
+        c.put("1,m", medium)
+        c.put("1,l", large)
+        assert c.get("1,s") == small
+        assert c.get("1,m") == medium
+        assert c.get("1,l") == large
+        assert c.mem.get("1,s") == small      # RAM tier holds small
+        assert c.mem.get("1,m") is None       # medium skips RAM
+        assert c.layers[1].get("1,m") == medium
+        assert c.layers[2].get("1,l") == large
+        c.close()
+
+    def test_small_survives_memory_eviction_via_disk(self, tmp_path):
+        c = TieredChunkCache(str(tmp_path), mem_bytes=1024,
+                             disk_bytes=64 << 20, unit_size=1024)
+        c.put("1,a", b"a" * 600)
+        c.put("1,b", b"b" * 600)  # evicts 1,a from the tiny RAM tier
+        assert c.mem.get("1,a") is None
+        assert c.get("1,a") == b"a" * 600  # served by disk layer 0
+        c.close()
+
+    def test_hit_miss_counters(self, tmp_path):
+        c = TieredChunkCache(str(tmp_path), disk_bytes=1 << 20,
+                             unit_size=1024)
+        assert c.get("1,none") is None
+        c.put("1,x", b"x")
+        c.get("1,x")
+        assert c.misses == 1
+        c.close()
+
+    def test_close_removes_segment_files(self, tmp_path):
+        c = TieredChunkCache(str(tmp_path), disk_bytes=1 << 20)
+        c.put("1,x", b"x" * 10)
+        assert any(f.endswith(".dat") for f in os.listdir(tmp_path))
+        c.close()
+        assert not any(f.endswith(".dat") for f in os.listdir(tmp_path))
+
+
+class TestFilerWithTieredCache:
+    def test_reads_hit_disk_cache(self, tmp_path):
+        from seaweedfs_tpu.filer.server import FilerServer
+        from seaweedfs_tpu.master.server import MasterServer
+        from seaweedfs_tpu.volume_server.server import VolumeServer
+
+        master = MasterServer(port=0, pulse_seconds=0.2)
+        master.start()
+        d = tmp_path / "v"
+        d.mkdir()
+        vs = VolumeServer([str(d)], master.address, port=0,
+                          pulse_seconds=0.2)
+        vs.start()
+        vs.heartbeat_once()
+        filer = FilerServer(master.address, port=0, chunk_size=1024,
+                            cache_dir=str(tmp_path / "cache"),
+                            chunk_cache_bytes=2048)
+        filer.start()
+        try:
+            payload = bytes(range(256)) * 16  # 4 chunks
+            filer.save_bytes("/c/f.bin", payload)
+            entry = filer.filer.find_entry("/c/f.bin")
+            assert filer.read_bytes(entry) == payload
+            before = filer.chunk_cache.hits
+            assert filer.read_bytes(entry) == payload  # warm read
+            assert filer.chunk_cache.hits > before
+        finally:
+            filer.stop()
+            vs.stop()
+            master.stop()

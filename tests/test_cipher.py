@@ -180,3 +180,47 @@ class TestS3MultipartOverCipher:
             filer.stop()
             vs.stop()
             master.stop()
+
+    def test_multipart_with_manifested_part(self, tmp_path):
+        """A part big enough to roll into a chunk manifest must reassemble
+        at the right offsets after CompleteMultipartUpload (nested
+        manifest offsets are part-relative)."""
+        from seaweedfs_tpu.s3api.server import S3ApiServer
+        from tests.test_s3 import req as s3req
+
+        master = MasterServer(port=0, pulse_seconds=0.2)
+        master.start()
+        d = tmp_path / "v"
+        d.mkdir()
+        vs = VolumeServer([str(d)], master.address, port=0,
+                          pulse_seconds=0.2)
+        vs.start()
+        vs.heartbeat_once()
+        filer = FilerServer(master.address, port=0, chunk_size=512,
+                            manifest_batch=4)
+        filer.start()
+        s3 = S3ApiServer(filer, port=0)
+        s3.start()
+        try:
+            s3req(s3, "PUT", "/mfb")
+            _, _, body = s3req(s3, "POST", "/mfb/obj", query="uploads=")
+            upload_id = body.decode().split("<UploadId>")[1] \
+                .split("</UploadId>")[0]
+            part1 = b"\x01" * 700               # plain, 2 chunks
+            part2 = bytes(range(256)) * 16      # 4 KiB -> 8 chunks -> manifest
+            for n, data in ((1, part1), (2, part2)):
+                status, _, _ = s3req(
+                    s3, "PUT", "/mfb/obj",
+                    query=f"partNumber={n}&uploadId={upload_id}",
+                    body=data)
+                assert status == 200
+            status, _, _ = s3req(s3, "POST", "/mfb/obj",
+                                 query=f"uploadId={upload_id}")
+            assert status == 200
+            status, _, got = s3req(s3, "GET", "/mfb/obj")
+            assert status == 200 and got == part1 + part2
+        finally:
+            s3.stop()
+            filer.stop()
+            vs.stop()
+            master.stop()

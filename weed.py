@@ -154,7 +154,9 @@ def cmd_filer(args):
                     collection=args.collection, guard=_load_guard(),
                     peers=args.peers.split(",") if args.peers else None,
                     persist_meta_log=args.metaLog,
-                    cipher=args.encryptVolumeData)
+                    cipher=args.encryptVolumeData,
+                    cache_dir=args.cacheDir,
+                    cache_disk_bytes=args.cacheCapacityMB << 20)
     _wire_notification(f)
     f.start()
     stoppables = [f]
@@ -203,7 +205,8 @@ def cmd_s3(args):
 
     store = SqliteStore(args.db) if args.db else None
     filer = FilerServer(args.master, port=0, store=store,
-                        guard=_load_guard())
+                        guard=_load_guard(),
+                        cipher=args.encryptVolumeData)
     filer.start()
     s3 = S3ApiServer(filer, host=args.ip, port=args.port,
                      identities=_load_identities(args.config))
@@ -227,7 +230,8 @@ def cmd_iam(args):
 
     store = SqliteStore(args.db) if args.db else None
     filer = FilerServer(args.master, port=0, store=store,
-                        guard=_load_guard())
+                        guard=_load_guard(),
+                        cipher=args.encryptVolumeData)
     filer.start()
     s3 = S3ApiServer(filer, port=args.s3Port,
                      identities=_load_identities(args.config))
@@ -983,6 +987,10 @@ def main(argv=None):
     p.add_argument("-encryptVolumeData", action="store_true",
                    help="encrypt chunk data at rest (per-chunk AES keys "
                         "in filer metadata)")
+    p.add_argument("-cacheDir", default="",
+                   help="directory for the tiered on-disk chunk cache")
+    p.add_argument("-cacheCapacityMB", type=int, default=1024,
+                   help="on-disk chunk cache budget (with -cacheDir)")
     p.set_defaults(fn=cmd_filer)
 
     p = sub.add_parser("s3", help="start an s3 gateway (+embedded filer)")
@@ -993,6 +1001,8 @@ def main(argv=None):
     p.add_argument("-port", type=int, default=8333)
     p.add_argument("-db", default="")
     p.add_argument("-config", default="", help="identities json")
+    p.add_argument("-encryptVolumeData", action="store_true",
+                   help="encrypt chunk data at rest")
     p.set_defaults(fn=cmd_s3)
 
     p = sub.add_parser("iam", help="start an IAM management API (+s3+filer)")
@@ -1002,6 +1012,8 @@ def main(argv=None):
     p.add_argument("-s3Port", type=int, default=8333)
     p.add_argument("-db", default="", help="sqlite path (default: memory)")
     p.add_argument("-config", default="", help="s3 identities json")
+    p.add_argument("-encryptVolumeData", action="store_true",
+                   help="encrypt chunk data at rest")
     p.set_defaults(fn=cmd_iam)
 
     p = sub.add_parser("server", help="combined master+volume(+filer)(+s3)")
