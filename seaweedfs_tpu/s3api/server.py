@@ -196,8 +196,128 @@ class S3ApiServer:
             ]},
         }), 200, "application/xml")
 
+    @staticmethod
+    def _ttl_days(ttl: str) -> int:
+        from ..storage.ttl import TTL
+
+        try:
+            minutes = TTL.parse(ttl).minutes()
+        except ValueError:
+            return 0
+        # round sub-day TTLs UP: reporting "no lifecycle" for a 12h TTL
+        # would claim nothing expires while the store deletes data
+        return -(-minutes // (60 * 24)) if minutes else 0
+
+    # -- bucket subresources with canned/conf-backed answers -----------------
+    # (s3api_bucket_skip_handlers.go + the acl/location/lifecycle/
+    # request-payment handlers in s3api_bucket_handlers.go): SDKs probe
+    # these on startup, so graceful answers matter even where the feature
+    # doesn't exist
+    SUBRESOURCES = ("acl", "cors", "policy", "lifecycle", "location",
+                    "versioning", "requestPayment", "object-lock")
+
+    def _bucket_subresource(self, method: str, bucket: str, req: Request):
+        q = req.query
+        if any(k in q for k in self.SUBRESOURCES):
+            self.filer.find_entry(self._bucket_path(bucket))  # NoSuchBucket
+        if "object-lock" in q and method == "GET":
+            return _error_xml("ObjectLockConfigurationNotFoundError",
+                              "no object lock configuration", 404)
+        if "acl" in q:
+            if method == "GET":
+                return self._get_bucket_acl(bucket)
+            return _error_xml("NotImplemented", "acl is read-only", 501)
+        if "cors" in q:
+            if method == "GET":
+                return _error_xml("NoSuchCORSConfiguration",
+                                  "no CORS configuration", 404)
+            if method == "DELETE":
+                return Response(b"", 204)
+            return _error_xml("NotImplemented", "cors", 501)
+        if "policy" in q:
+            if method == "GET":
+                return _error_xml("NoSuchBucketPolicy",
+                                  "no bucket policy", 404)
+            if method == "DELETE":
+                return Response(b"", 204)
+            return _error_xml("NotImplemented", "policy", 501)
+        if "lifecycle" in q:
+            if method == "GET":
+                return self._get_bucket_lifecycle(bucket)
+            if method == "DELETE":
+                return Response(b"", 204)
+            return _error_xml("NotImplemented", "lifecycle", 501)
+        if "location" in q and method == "GET":
+            return Response(_xml("LocationConstraint", ""), 200,
+                            "application/xml")
+        if "versioning" in q and method == "GET":
+            return Response(_xml("VersioningConfiguration", ""), 200,
+                            "application/xml")
+        if "requestPayment" in q and method == "GET":
+            return Response(_xml("RequestPaymentConfiguration",
+                                 {"Payer": "BucketOwner"}), 200,
+                            "application/xml")
+        return None
+
+    def _get_bucket_acl(self, bucket: str):
+        """Canned ACL from the identity table (GetBucketAclHandler)."""
+        owner = {"ID": "seaweedfs_tpu", "DisplayName": "seaweedfs_tpu"}
+        grants = []
+        for ident in self.iam.identities.values():
+            if ident.can(ACTION_ADMIN, bucket):
+                perms = ["FULL_CONTROL"]
+                if owner["ID"] == "seaweedfs_tpu":  # first admin is owner
+                    owner = {"ID": ident.access_key,
+                             "DisplayName": ident.name}
+            else:
+                perms = []
+                if ident.can(ACTION_READ, bucket):
+                    perms.append("READ")
+                if ident.can(ACTION_WRITE, bucket):
+                    perms.append("WRITE")
+            for perm in perms:
+                grants.append({
+                    "Grantee": {"ID": ident.access_key,
+                                "DisplayName": ident.name},
+                    "Permission": perm})
+        return Response(_xml("AccessControlPolicy", {
+            "Owner": owner,
+            "AccessControlList": {"Grant": grants},
+        }), 200, "application/xml")
+
+    def _get_bucket_lifecycle(self, bucket: str):
+        """Expiration rules derived from filer-conf TTLs for the bucket
+        (GetBucketLifecycleConfigurationHandler)."""
+        conf = self.filer_server.filer_conf()
+        bucket_root = f"{BUCKETS_ROOT}/{bucket}"
+        rules = []
+        for rule in conf.rules:
+            # exact bucket path or below it — "/buckets/sr" must not
+            # match bucket "s"; and report the BUCKET-RELATIVE key prefix
+            if rule.location_prefix != bucket_root and \
+                    not rule.location_prefix.startswith(bucket_root + "/"):
+                continue
+            if not rule.ttl:
+                continue
+            days = self._ttl_days(rule.ttl)
+            if days:
+                key_prefix = rule.location_prefix[len(bucket_root):] \
+                    .lstrip("/")
+                rules.append({
+                    "Status": "Enabled",
+                    "Filter": {"Prefix": key_prefix},
+                    "Expiration": {"Days": days}})
+        if not rules:
+            return _error_xml("NoSuchLifecycleConfiguration",
+                              "no lifecycle configuration", 404)
+        return Response(_xml("LifecycleConfiguration", {"Rule": rules}),
+                        200, "application/xml")
+
     def _bucket_op(self, method: str, bucket: str, req: Request):
         path = self._bucket_path(bucket)
+        sub = self._bucket_subresource(method, bucket, req)
+        if sub is not None:
+            return sub
         if method == "PUT":
             self.filer.create_entry(new_directory_entry(path))
             return Response(b"", 200)
@@ -354,6 +474,17 @@ class S3ApiServer:
 
     def _object_op(self, method: str, bucket: str, key: str, req: Request):
         self.filer.find_entry(self._bucket_path(bucket))  # NoSuchBucket
+        # object ACL / retention / legal-hold probes
+        # (s3api_object_skip_handlers.go) — but only for keys that exist
+        if method in ("GET", "PUT") and any(
+                k in req.query for k in ("acl", "retention",
+                                         "legal-hold")):
+            entry = self.filer.find_entry(self._object_path(bucket, key))
+            if entry.is_directory:
+                raise NotFoundError(key)
+            if method == "GET" and "acl" in req.query:
+                return self._get_bucket_acl(bucket)  # same canned policy
+            return Response(b"", 204)
         if method == "PUT":
             if "partNumber" in req.query and "uploadId" in req.query:
                 return self._upload_part(bucket, key, req)

@@ -670,3 +670,82 @@ class TestStreamingSigV4:
             f"SignedHeaders={';'.join(signed)}, Signature={seed}")
         ident, body = iam.verify_and_decode("PUT", "/b/k", {}, send, frames)
         assert ident.name == "a" and body == payload
+
+
+class TestBucketSubresources:
+    """Canned/conf-backed answers for SDK startup probes
+    (s3api_bucket_skip_handlers.go + acl/location/lifecycle handlers)."""
+
+    def test_location_versioning_payment(self, stack):
+        s3 = stack
+        req(s3, "PUT", "/sr")
+        status, _, body = req(s3, "GET", "/sr", query="location=")
+        assert status == 200 and b"LocationConstraint" in body
+        status, _, body = req(s3, "GET", "/sr", query="versioning=")
+        assert status == 200 and b"VersioningConfiguration" in body
+        status, _, body = req(s3, "GET", "/sr", query="requestPayment=")
+        assert status == 200 and b"BucketOwner" in body
+
+    def test_cors_policy_lifecycle_absent(self, stack):
+        s3 = stack
+        req(s3, "PUT", "/sr")
+        for sub, code in (("cors", b"NoSuchCORSConfiguration"),
+                          ("policy", b"NoSuchBucketPolicy"),
+                          ("lifecycle", b"NoSuchLifecycleConfiguration")):
+            status, _, body = req(s3, "GET", "/sr", query=f"{sub}=")
+            assert status == 404 and code in body, (sub, body)
+            status, _, _ = req(s3, "DELETE", "/sr", query=f"{sub}=")
+            assert status == 204
+            status, _, _ = req(s3, "PUT", "/sr", query=f"{sub}=",
+                               body=b"<x/>")
+            assert status == 501
+
+    def test_bucket_acl(self, stack):
+        s3 = stack
+        req(s3, "PUT", "/sr")
+        status, _, body = req(s3, "GET", "/sr", query="acl=")
+        assert status == 200 and b"AccessControlPolicy" in body
+        status, _, _ = req(s3, "PUT", "/sr", query="acl=", body=b"<x/>")
+        assert status == 501
+
+    def test_object_probes(self, stack):
+        s3 = stack
+        req(s3, "PUT", "/sr")
+        req(s3, "PUT", "/sr/k", body=b"x")
+        for sub in ("retention", "legal-hold"):
+            status, _, _ = req(s3, "GET", "/sr/k", query=f"{sub}=")
+            assert status == 204, sub
+            status, _, _ = req(s3, "PUT", "/sr/k", query=f"{sub}=",
+                               body=b"<x/>")
+            assert status == 204, sub
+        status, _, body = req(s3, "GET", "/sr/k", query="acl=")
+        assert status == 200 and b"AccessControlPolicy" in body
+        # probes on a missing key 404 instead of claiming success
+        status, _, _ = req(s3, "GET", "/sr/ghost", query="retention=")
+        assert status == 404
+        # object-lock configuration is a BUCKET-level probe
+        status, _, body = req(s3, "GET", "/sr", query="object-lock=")
+        assert status == 404
+        assert b"ObjectLockConfigurationNotFoundError" in body
+
+    def test_probes_on_missing_bucket_404(self, stack):
+        s3 = stack
+        for sub in ("location", "versioning", "cors", "policy",
+                    "lifecycle", "acl"):
+            status, _, _ = req(s3, "GET", "/ghostbucket",
+                               query=f"{sub}=")
+            assert status == 404, sub
+
+    def test_lifecycle_from_filer_conf_ttl(self, stack):
+        from seaweedfs_tpu.filer.filer_conf import PathConf
+
+        s3 = stack
+        req(s3, "PUT", "/sr")
+        conf = s3.filer_server.filer_conf()
+        conf.add(PathConf(location_prefix="/buckets/sr/logs", ttl="3d"))
+        conf.save(s3.filer_server.filer)
+        s3.filer_server._conf_cache = (0.0, conf)  # bust the 1s cache
+        status, _, body = req(s3, "GET", "/sr", query="lifecycle=")
+        assert status == 200
+        assert b"<Days>3</Days>" in body and b"Enabled" in body
+        assert b"<Prefix>logs</Prefix>" in body
