@@ -29,6 +29,11 @@ class PathConf:
     fsync: bool = False
     read_only: bool = False
     max_file_name_length: int = 0
+    # s3.bucket.quota: MiB budget for the bucket this rule covers
+    # (negative = configured but disabled); quota_read_only records that
+    # read_only was set BY quota enforcement so it can be auto-cleared
+    quota_mb: int = 0
+    quota_read_only: bool = False
 
 
 @dataclass
@@ -61,7 +66,10 @@ class FilerConf:
     @classmethod
     def from_bytes(cls, data: bytes) -> "FilerConf":
         doc = json.loads(data.decode()) if data else {}
-        return cls(rules=[PathConf(**r) for r in doc.get("locations", [])])
+        known = PathConf.__dataclass_fields__
+        return cls(rules=[
+            PathConf(**{k: v for k, v in r.items() if k in known})
+            for r in doc.get("locations", [])])
 
     # -- persistence in the filer tree --------------------------------------
     def save(self, filer):

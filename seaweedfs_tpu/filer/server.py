@@ -87,6 +87,8 @@ class FilerServer:
             self.meta_aggregator = MetaAggregator(
                 [p for p in peers if p])
         self._conf_cache: tuple[float, FilerConf] = (0.0, FilerConf())
+        self._prefetch_lock = threading.Lock()
+        self._prefetching: set[str] = set()
         self.server = RpcServer(host, port)
         self.server.add("GET", "/metadata/subscribe", self._h_subscribe)
         self.server.add("GET", "/metadata/aggregate", self._h_aggregate)
@@ -366,7 +368,34 @@ class FilerServer:
                 data = decrypt(data, view.cipher_key)
             parts.append(data[view.offset_in_chunk:
                               view.offset_in_chunk + view.size])
+        self._maybe_prefetch(chunks, start + length)
         return b"".join(parts)
+
+    def _maybe_prefetch(self, chunks, next_offset: int):
+        """Sequential read-ahead (reader_cache.go MaybeCache +
+        reader_pattern.go): warm the chunk that starts where this read
+        ended, in the background, so streaming readers never stall on
+        the next fetch."""
+        nxt = next((c for c in chunks if c.offset == next_offset), None)
+        if nxt is None or self.chunk_cache.get(nxt.fid) is not None:
+            return
+        with self._prefetch_lock:
+            if nxt.fid in self._prefetching or \
+                    len(self._prefetching) >= 4:  # bounded look-ahead
+                return
+            self._prefetching.add(nxt.fid)
+
+        def fetch():
+            try:
+                self._fetch_chunk(nxt.fid)
+            except RpcError:
+                pass  # a miss here is only a lost optimisation
+            finally:
+                with self._prefetch_lock:
+                    self._prefetching.discard(nxt.fid)
+
+        threading.Thread(target=fetch, daemon=True,
+                         name=f"prefetch-{nxt.fid}").start()
 
     # -- read ----------------------------------------------------------------
     def _h_read(self, path: str, req: Request, method: str):

@@ -60,14 +60,8 @@ class CircuitBreaker:
                     k: int(v) for k, v in conf.get("actions", {}).items()}
 
     @classmethod
-    def load_from_filer(cls, filer) -> "CircuitBreaker":
-        from ..filer.filer_store import NotFoundError
-
-        try:
-            entry = filer.find_entry(CONFIG_PATH)
-            return cls(json.loads(entry.content.decode()))
-        except (NotFoundError, ValueError):
-            return cls()
+    def load_from_filer(cls, filer_server) -> "CircuitBreaker":
+        return cls(read_config(filer_server))
 
     # -- admission ----------------------------------------------------------
     def _check(self, limits: dict[str, int], gauge: _Gauge, action: str,
@@ -114,3 +108,17 @@ class CircuitBreaker:
                     bucket_gauge.bytes -= nbytes
 
         return release
+
+
+def read_config(filer_server) -> dict:
+    """Fetch /etc/s3/circuit_breaker.json through the filer's full read
+    path — configs past the inline limit live in chunks, so
+    entry.content alone would silently read as empty."""
+    from ..filer.filer_store import NotFoundError
+    from ..rpc.http_rpc import RpcError
+
+    try:
+        entry = filer_server.filer.find_entry(CONFIG_PATH)
+        return json.loads(filer_server.read_bytes(entry).decode())
+    except (NotFoundError, RpcError, ValueError):
+        return {}

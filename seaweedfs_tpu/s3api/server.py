@@ -12,6 +12,7 @@ s3api_objects_*.go); multipart parts are staged under
 from __future__ import annotations
 
 import hashlib
+import json
 import time
 import urllib.parse
 import uuid
@@ -27,6 +28,7 @@ from ..rpc.http_rpc import Request, Response, RpcError, RpcServer
 from .auth import (ACTION_ADMIN, ACTION_LIST, ACTION_READ, ACTION_WRITE,
                    AuthError, Identity, IdentityAccessManagement)
 from .circuit_breaker import CircuitBreaker, SlowDown
+from .circuit_breaker import read_config as cb_read_config
 
 BUCKETS_ROOT = "/buckets"
 UPLOADS_DIR = ".uploads"
@@ -111,8 +113,13 @@ class S3ApiServer:
         self.filer_server = filer
         self.filer = filer.filer
         self.iam = IdentityAccessManagement(identities)
+        # filer-backed circuit breaker hot-reloads (the reference
+        # subscribes to /etc/s3/circuit_breaker.json metadata changes;
+        # here a 1 s TTL re-read, like the filer-conf cache)
+        self._cb_from_filer = circuit_breaker is None
         self.circuit_breaker = circuit_breaker \
-            or CircuitBreaker.load_from_filer(self.filer)
+            or CircuitBreaker.load_from_filer(self.filer_server)
+        self._cb_checked = time.time()
         self.server = RpcServer(host, port)
         self.server.default_route = self._handle
 
@@ -126,9 +133,18 @@ class S3ApiServer:
     def stop(self):
         self.server.stop()
 
+    def _maybe_reload_circuit_breaker(self):
+        if not self._cb_from_filer or \
+                time.time() - self._cb_checked < 1.0:
+            return
+        self._cb_checked = time.time()
+        # load() swaps limits in place; in-flight gauges survive
+        self.circuit_breaker.load(cb_read_config(self.filer_server))
+
     # -- routing -------------------------------------------------------------
     def _handle(self, method: str, req: Request):
         try:
+            self._maybe_reload_circuit_breaker()
             return self._route(method, req)
         except AuthError as e:
             return _error_xml(e.code, str(e), e.status)

@@ -23,6 +23,7 @@ class CommandEnv:
     master_address: str
     filer_address: str = ""  # discovered lazily via the cluster registry
     admin_token: int = 0  # LeaseAdminToken lease for lock/unlock
+    cwd: str = "/"  # fs.cd working directory for relative fs.* paths
 
     def master(self, path: str, payload=None, **kw):
         return call(self.master_address, path, payload, **kw)

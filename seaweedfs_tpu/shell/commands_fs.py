@@ -20,6 +20,18 @@ BUCKETS_ROOT = "/buckets"
 IDENTITY_CONFIG_PATH = "/etc/iam/identity.json"
 
 
+def _get_json_config(filer: str, path: str) -> dict:
+    """Fetch a JSON config entry through the filer HTTP read path; {} on
+    absence or parse failure.  Shared by fs.configure / s3.configure /
+    quota / circuitbreaker so the fetch-and-parse logic lives once."""
+    try:
+        raw = call(filer, urllib.parse.quote(path))
+        return raw if isinstance(raw, dict) else json.loads(
+            raw if isinstance(raw, str) else raw.decode())
+    except (RpcError, ValueError):
+        return {}
+
+
 def find_filer(env: CommandEnv) -> str:
     """Resolve a filer address: explicit on the env, else the master's
     cluster registry (shell.go filer discovery)."""
@@ -44,6 +56,9 @@ def _list(filer: str, path: str, metadata: bool = False) -> list[dict]:
         if metadata:
             q += "&metadata=true"
         resp = call(filer, urllib.parse.quote(dir_path) + q)
+        if not isinstance(resp, dict):
+            # the filer answered with file CONTENT: path names a file
+            raise RpcError(f"{path} is not a directory", 400)
         entries = resp.get("Entries", []) or []
         out.extend(entries)
         if not resp.get("ShouldDisplayLoadMore"):
@@ -251,12 +266,7 @@ def s3_configure(env: CommandEnv, user: str, access_key: str,
     """command_s3_configure.go: upsert an identity in the shared
     identity config (the same file the IAM API manages)."""
     filer = find_filer(env)
-    try:
-        raw = call(filer, IDENTITY_CONFIG_PATH)
-        config = raw if isinstance(raw, dict) else json.loads(
-            raw if isinstance(raw, str) else raw.decode())
-    except (RpcError, ValueError):
-        config = {"identities": []}
+    config = _get_json_config(filer, IDENTITY_CONFIG_PATH)
     identities = [i for i in config.get("identities", [])
                   if i.get("name") != user]
     identities.append({
@@ -282,12 +292,7 @@ def fs_configure(env: CommandEnv, location_prefix: str,
     from ..filer.filer_conf import FILER_CONF_PATH
 
     filer = find_filer(env)
-    try:
-        raw = call(filer, FILER_CONF_PATH)
-        conf = raw if isinstance(raw, dict) else json.loads(
-            raw if isinstance(raw, str) else raw.decode())
-    except (RpcError, ValueError):
-        conf = {"locations": []}
+    conf = _get_json_config(filer, FILER_CONF_PATH)
     locations = [loc for loc in conf.get("locations", [])
                  if loc.get("location_prefix") != location_prefix]
     if not delete:
@@ -307,3 +312,228 @@ def fs_configure(env: CommandEnv, location_prefix: str,
     call(filer, FILER_CONF_PATH, raw=json.dumps(conf, indent=2).encode(),
          method="POST", headers={"Content-Type": "application/json"})
     return conf
+
+
+# -- fs.cd / fs.pwd (command_fs_cd.go, command_fs_pwd.go) --------------------
+
+def resolve_path(env: CommandEnv, path: str) -> str:
+    """Resolve `path` against the shell's working directory, handling
+    "." / ".." segments (util.ResolvePath semantics)."""
+    cwd = getattr(env, "cwd", "/") or "/"
+    if not path:
+        return cwd
+    if not path.startswith("/"):
+        path = cwd.rstrip("/") + "/" + path
+    parts: list[str] = []
+    for seg in path.split("/"):
+        if seg in ("", "."):
+            continue
+        if seg == "..":
+            if parts:
+                parts.pop()
+            continue
+        parts.append(seg)
+    return "/" + "/".join(parts)
+
+
+def fs_cd(env: CommandEnv, path: str = "/") -> dict:
+    """Change the shell's working directory; the target must be a
+    listable directory."""
+    target = resolve_path(env, path)
+    if target != "/":
+        _list(find_filer(env), target)  # 404s when absent
+    env.cwd = target
+    return {"cwd": target}
+
+
+def fs_pwd(env: CommandEnv) -> dict:
+    return {"cwd": getattr(env, "cwd", "/") or "/"}
+
+
+# -- fs.meta.notify (command_fs_meta_notify.go) ------------------------------
+
+def fs_meta_notify(env: CommandEnv, path: str = "/") -> dict:
+    """Re-send a create EventNotification for every entry under `path`
+    to the notification.toml sink — used to prime a fresh downstream
+    consumer with the existing tree."""
+    from ..notification import load_notification_queue
+    from ..util.config import load_configuration
+
+    queue = load_notification_queue(load_configuration("notification"))
+    if queue is None:
+        raise RpcError("no notification sink configured "
+                       "(weed scaffold -config=notification)", 400)
+    filer = find_filer(env)
+    sent = 0
+
+    def walk(p: str):
+        nonlocal sent
+        for e in _list(filer, p, metadata=True):
+            full = p.rstrip("/") + "/" + _name(e)
+            # flat MetaEvent shape (filer.MetaEvent.to_dict) — the same
+            # records the filer's own queue emits, so replicator /
+            # aggregator consumers see a normal create event
+            queue.send(full, {
+                "ts_ns": time.time_ns(),
+                "directory": p.rstrip("/") or "/",
+                "old_entry": None,
+                "new_entry": e,
+            })
+            sent += 1
+            if _is_dir(e):
+                walk(full)
+
+    walk(resolve_path(env, path))
+    queue.close()
+    return {"notified": sent}
+
+
+# -- s3.bucket.quota / s3.bucket.quota.enforce -------------------------------
+# (command_s3_bucket_quota.go, command_s3_bucket_quota_check.go) — quota
+# rides the bucket's filer-conf rule; enforce compares the bucket
+# collection's physical size from the master topology and toggles the
+# rule's read_only flag
+
+def _load_conf_locations(filer: str) -> list[dict]:
+    from ..filer.filer_conf import FILER_CONF_PATH
+
+    return _get_json_config(filer, FILER_CONF_PATH) \
+        .get("locations", []) or []
+
+
+def _save_conf_locations(filer: str, locations: list[dict]) -> None:
+    from ..filer.filer_conf import FILER_CONF_PATH
+
+    call(filer, urllib.parse.quote(FILER_CONF_PATH),
+         raw=json.dumps({"locations": locations}, indent=2).encode(),
+         method="POST")
+
+
+def s3_bucket_quota(env: CommandEnv, name: str, op: str = "set",
+                    size_mb: int = 0) -> dict:
+    """set/get/remove/enable/disable a bucket's quota (stored as
+    quota_mb on the bucket's path rule; negative means disabled)."""
+    if not name:
+        raise RpcError("empty bucket name", 400)
+    filer = find_filer(env)
+    prefix = f"{BUCKETS_ROOT}/{name}/"
+    locations = _load_conf_locations(filer)
+    rule = next((r for r in locations
+                 if r.get("location_prefix") == prefix), None)
+    current = int(rule.get("quota_mb", 0)) if rule else 0
+    if op == "get":
+        return {"bucket": name, "quota_mb": current}
+    if op == "set":
+        new = size_mb
+    elif op == "remove":
+        new = 0
+    elif op == "enable":
+        new = abs(current)
+    elif op == "disable":
+        new = -abs(current)
+    else:
+        raise RpcError(f"unknown op {op!r} "
+                       "(set|get|remove|enable|disable)", 400)
+    locations = [r for r in locations
+                 if r.get("location_prefix") != prefix]
+    if rule is None:
+        rule = {"location_prefix": prefix}
+    if new:
+        rule["quota_mb"] = new
+    else:
+        rule.pop("quota_mb", None)
+    if new <= 0 and rule.get("quota_read_only"):
+        # removing/disabling the quota lifts an enforcement-set
+        # read_only — enforce won't revisit a rule with no quota
+        rule.pop("quota_read_only", None)
+        rule.pop("read_only", None)
+    # keep the rule if it still says anything
+    if len(rule) > 1:
+        locations.append(rule)
+    _save_conf_locations(filer, locations)
+    return {"bucket": name, "quota_mb": new}
+
+
+def s3_bucket_quota_enforce(env: CommandEnv, apply: bool = False) -> dict:
+    """Compare each bucket collection's physical size to its quota; over
+    quota -> mark the bucket rule read_only (with -apply), under quota ->
+    clear a read_only this command set."""
+    filer = find_filer(env)
+    status = env.master("/dir/status")
+    sizes: dict[str, int] = {}
+    for dc in status.get("datacenters", []):
+        for rack in dc.get("racks", []):
+            for node in rack.get("nodes", []):
+                for v in node.get("volume_list", []):
+                    col = v.get("collection", "")
+                    sizes[col] = sizes.get(col, 0) + int(v.get("size", 0))
+    locations = _load_conf_locations(filer)
+    report, changed = [], False
+    for rule in locations:
+        prefix = rule.get("location_prefix", "")
+        quota_mb = int(rule.get("quota_mb", 0))
+        # rules with an enforcement-set read_only stay in scope even
+        # after the quota is removed, so the flag can be cleared
+        if not prefix.startswith(f"{BUCKETS_ROOT}/") or \
+                (quota_mb <= 0 and not rule.get("quota_read_only")):
+            continue
+        bucket = prefix[len(BUCKETS_ROOT) + 1:].strip("/")
+        used = sizes.get(bucket, 0)
+        over = quota_mb > 0 and used > quota_mb << 20
+        report.append({"bucket": bucket, "quota_mb": quota_mb,
+                       "used_bytes": used, "over": over,
+                       "read_only": rule.get("read_only", False)})
+        if over and not rule.get("read_only"):
+            rule["read_only"] = True
+            rule["quota_read_only"] = True  # we set it; we may clear it
+            changed = True
+        elif not over and rule.get("quota_read_only"):
+            rule["read_only"] = False
+            rule.pop("quota_read_only", None)
+            changed = True
+    if changed and apply:
+        _save_conf_locations(filer, locations)
+    return {"buckets": report, "applied": bool(changed and apply)}
+
+
+# -- s3.circuitbreaker (command_s3_circuitbreaker.go) ------------------------
+
+def s3_circuitbreaker(env: CommandEnv, actions: str = "",
+                      values: str = "", buckets: str = "",
+                      enable: Optional[bool] = None,
+                      delete: bool = False) -> dict:
+    """Read or edit /etc/s3/circuit_breaker.json through the filer.
+
+    actions: comma list like "Read:Count,Write:MB"; values: matching
+    comma list of limits; buckets: comma list to scope the edit (global
+    when empty)."""
+    from ..s3api.circuit_breaker import CONFIG_PATH
+
+    filer = find_filer(env)
+    config = _get_json_config(filer, CONFIG_PATH)
+    if actions or enable is not None or delete:
+        targets = ([("buckets", b) for b in buckets.split(",") if b]
+                   or [("global", None)])
+        acts = [a for a in actions.split(",") if a]
+        vals = [int(v) for v in values.split(",") if v] if values else []
+        if acts and not delete and len(acts) != len(vals):
+            raise RpcError("actions and values must pair up", 400)
+        for scope, bucket in targets:
+            if scope == "global":
+                node = config.setdefault("global", {})
+            else:
+                node = config.setdefault("buckets", {}) \
+                    .setdefault(bucket, {})
+            if delete:
+                for a in acts or list(node.get("actions", {})):
+                    node.get("actions", {}).pop(a, None)
+            else:
+                for a, v in zip(acts, vals):
+                    node.setdefault("actions", {})[a] = v
+            if enable is not None:
+                node["enabled"] = enable
+            elif "enabled" not in node:
+                node["enabled"] = True
+        call(filer, urllib.parse.quote(CONFIG_PATH),
+             raw=json.dumps(config, indent=2).encode(), method="POST")
+    return config

@@ -115,3 +115,43 @@ class TestFilerWithTieredCache:
             filer.stop()
             vs.stop()
             master.stop()
+
+
+class TestSequentialPrefetch:
+    def test_read_warms_next_chunk(self, tmp_path):
+        import time
+
+        from seaweedfs_tpu.filer.server import FilerServer
+        from seaweedfs_tpu.master.server import MasterServer
+        from seaweedfs_tpu.volume_server.server import VolumeServer
+
+        master = MasterServer(port=0, pulse_seconds=0.2)
+        master.start()
+        d = tmp_path / "v"
+        d.mkdir()
+        vs = VolumeServer([str(d)], master.address, port=0,
+                          pulse_seconds=0.2)
+        vs.start()
+        vs.heartbeat_once()
+        filer = FilerServer(master.address, port=0, chunk_size=1024)
+        filer.start()
+        try:
+            payload = bytes(range(256)) * 16  # 4 chunks of 1 KiB
+            entry = filer.save_bytes("/p/seq.bin", payload)
+            chunks = sorted(entry.chunks, key=lambda c: c.offset)
+            # cold cache: read chunk 0 only
+            filer.chunk_cache = type(filer.chunk_cache)(64 << 20)
+            assert filer.read_bytes(entry, 0, 1024) == payload[:1024]
+            # the NEXT chunk should get warmed in the background
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                if filer.chunk_cache.get(chunks[1].fid) is not None:
+                    break
+                time.sleep(0.05)
+            assert filer.chunk_cache.get(chunks[1].fid) is not None
+            # chunk 3 was never next: stays cold
+            assert filer.chunk_cache.get(chunks[3].fid) is None
+        finally:
+            filer.stop()
+            vs.stop()
+            master.stop()

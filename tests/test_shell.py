@@ -346,3 +346,144 @@ class TestReviewFixes:
             assert fs.fs_cat(env, "/d/big.bin") == b"z" * 3000
         finally:
             filer.stop()
+
+
+class TestShellCwd:
+    @pytest.fixture
+    def with_filer(self, cluster):
+        master, servers, env = cluster
+        filer = FilerServer(master.address, port=0, chunk_size=512)
+        filer.start()
+        env.filer_address = filer.address
+        yield env, filer
+        filer.stop()
+
+    def test_cd_pwd_relative_resolution(self, with_filer):
+        env, filer = with_filer
+        call(filer.address, "/docs/sub/a.txt", raw=b"aaa", method="POST")
+        assert fs.fs_pwd(env) == {"cwd": "/"}
+        fs.fs_cd(env, "/docs")
+        assert fs.fs_pwd(env) == {"cwd": "/docs"}
+        assert fs.resolve_path(env, "sub/a.txt") == "/docs/sub/a.txt"
+        assert fs.resolve_path(env, "..") == "/"
+        assert fs.resolve_path(env, "../docs/./sub") == "/docs/sub"
+        fs.fs_cd(env, "sub")
+        assert env.cwd == "/docs/sub"
+        with pytest.raises(RpcError):
+            fs.fs_cd(env, "/nope")
+
+    def test_meta_notify_counts_subtree(self, with_filer, monkeypatch):
+        env, filer = with_filer
+        call(filer.address, "/n/a.txt", raw=b"a", method="POST")
+        call(filer.address, "/n/d/b.txt", raw=b"b", method="POST")
+        sent = []
+
+        class FakeQueue:
+            name = "fake"
+
+            def send(self, key, event):
+                sent.append(key)
+
+            def close(self):
+                pass
+
+        import seaweedfs_tpu.notification as notif
+        monkeypatch.setattr(notif, "load_notification_queue",
+                            lambda conf: FakeQueue())
+        out = fs.fs_meta_notify(env, "/n")
+        assert out["notified"] == 3  # a.txt, d, d/b.txt
+        assert "/n/d/b.txt" in sent
+
+
+class TestBucketQuota:
+    @pytest.fixture
+    def with_filer(self, cluster):
+        master, servers, env = cluster
+        self._servers = servers
+        filer = FilerServer(master.address, port=0)
+        filer.start()
+        env.filer_address = filer.address
+        yield master, env, filer
+        filer.stop()
+
+    def test_quota_set_get_disable_remove(self, with_filer):
+        master, env, filer = with_filer
+        fs.s3_bucket_create(env, "q")
+        assert fs.s3_bucket_quota(env, "q", "set", 100) \
+            == {"bucket": "q", "quota_mb": 100}
+        assert fs.s3_bucket_quota(env, "q", "get")["quota_mb"] == 100
+        assert fs.s3_bucket_quota(env, "q", "disable")["quota_mb"] == -100
+        assert fs.s3_bucket_quota(env, "q", "enable")["quota_mb"] == 100
+        assert fs.s3_bucket_quota(env, "q", "remove")["quota_mb"] == 0
+
+    def test_quota_enforce_marks_read_only(self, with_filer):
+        master, env, filer = with_filer
+        fs.s3_bucket_create(env, "big")
+        # 2 MiB of data in collection "big" against a 1 MiB quota
+        a = call(master.address, "/dir/assign?collection=big")
+        call(a["url"], f"/{a['fid']}", raw=b"x" * (2 << 20),
+             method="POST")
+        # re-heartbeat so /dir/status sees the volume size
+        for vs in self._servers:
+            vs.heartbeat_once()
+        fs.s3_bucket_quota(env, "big", "set", 1)  # 1 MiB
+        out = fs.s3_bucket_quota_enforce(env, apply=True)
+        [row] = [r for r in out["buckets"] if r["bucket"] == "big"]
+        assert row["quota_mb"] == 1 and row["over"]
+        locations = fs._load_conf_locations(filer.address)
+        rule = next(r for r in locations
+                    if r["location_prefix"] == "/buckets/big/")
+        assert rule["read_only"] is True and rule["quota_read_only"]
+        # under-quota again -> enforcement clears ITS read_only
+        fs.s3_bucket_quota(env, "big", "set", 10000)
+        out2 = fs.s3_bucket_quota_enforce(env, apply=True)
+        locations = fs._load_conf_locations(filer.address)
+        rule = next(r for r in locations
+                    if r["location_prefix"] == "/buckets/big/")
+        assert not rule.get("read_only")
+        # quota removal also lifts an enforcement-set read_only
+        fs.s3_bucket_quota(env, "big", "set", 1)
+        fs.s3_bucket_quota_enforce(env, apply=True)
+        fs.s3_bucket_quota(env, "big", "remove")
+        locations = fs._load_conf_locations(filer.address)
+        rule = next((r for r in locations
+                     if r["location_prefix"] == "/buckets/big/"), {})
+        assert not rule.get("read_only")
+
+
+class TestCircuitBreakerCommand:
+    @pytest.fixture
+    def with_s3(self, cluster):
+        from seaweedfs_tpu.s3api.server import S3ApiServer
+
+        master, servers, env = cluster
+        filer = FilerServer(master.address, port=0)
+        filer.start()
+        env.filer_address = filer.address
+        s3 = S3ApiServer(filer, port=0)
+        s3.start()
+        yield env, filer, s3
+        s3.stop()
+        filer.stop()
+
+    def test_configure_and_hot_reload(self, with_s3):
+        env, filer, s3 = with_s3
+        conf = fs.s3_circuitbreaker(env, actions="Write:Count",
+                                    values="0", enable=True)
+        assert conf["global"]["actions"]["Write:Count"] == 0
+        time.sleep(1.1)  # gateway reload window
+        import urllib.error
+        import urllib.request
+        req = urllib.request.Request(
+            f"http://{s3.address}/cbb", data=b"", method="PUT")
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=10)
+        assert e.value.code == 503  # SlowDown: zero concurrent writes
+        # read-back and delete
+        got = fs.s3_circuitbreaker(env)
+        assert got["global"]["actions"]["Write:Count"] == 0
+        fs.s3_circuitbreaker(env, actions="Write:Count", enable=False,
+                             delete=True)
+        time.sleep(1.1)
+        status = urllib.request.urlopen(req, timeout=10).status
+        assert status == 200

@@ -311,6 +311,7 @@ def _shell_handlers(env):
         return default
 
     plan = lambda a: "-plan" in a or "-n" in a
+    ap = lambda p: fs.resolve_path(env, p)  # fs.* paths obey fs.cd
     return {
         # volume family
         "volume.list": lambda a: show(sh.volume_list(env)),
@@ -402,23 +403,27 @@ def _shell_handlers(env):
         "unlock": lambda a: show(vol.shell_unlock(env)),
         # fs family
         "fs.ls": lambda a: show(fs.fs_ls(
-            env, a[-1] if a and not a[-1].startswith("-") else "/",
+            env, ap(a[-1] if a and not a[-1].startswith("-") else ""),
             long_format="-l" in a)),
         "fs.cat": lambda a: sys.stdout.buffer.write(
-            fs.fs_cat(env, a[0])),
-        "fs.mkdir": lambda a: show(fs.fs_mkdir(env, a[0])),
+            fs.fs_cat(env, ap(a[0]))),
+        "fs.mkdir": lambda a: show(fs.fs_mkdir(env, ap(a[0]))),
         "fs.rm": lambda a: fs.fs_rm(
-            env, a[-1], recursive="-r" in a),
-        "fs.mv": lambda a: show(fs.fs_mv(env, a[0], a[1])),
-        "fs.du": lambda a: show(fs.fs_du(env, a[0] if a else "/")),
+            env, ap(a[-1]), recursive="-r" in a),
+        "fs.mv": lambda a: show(fs.fs_mv(env, ap(a[0]), ap(a[1]))),
+        "fs.du": lambda a: show(fs.fs_du(env, ap(a[0] if a else ""))),
         "fs.tree": lambda a: print("\n".join(fs.fs_tree(
-            env, a[0] if a else "/"))),
-        "fs.meta.cat": lambda a: show(fs.fs_meta_cat(env, a[0])),
+            env, ap(a[0] if a else "")))),
+        "fs.cd": lambda a: show(fs.fs_cd(env, a[0] if a else "/")),
+        "fs.pwd": lambda a: show(fs.fs_pwd(env)),
+        "fs.meta.cat": lambda a: show(fs.fs_meta_cat(env, ap(a[0]))),
         "fs.meta.save": lambda a: show({"saved": len(fs.fs_meta_save(
-            env, a[-1] if a and not a[-1].startswith("-") else "/",
+            env, ap(a[-1] if a and not a[-1].startswith("-") else ""),
             output=flag(a, "o", "")))}),
         "fs.meta.load": lambda a: show(
             {"loaded": fs.fs_meta_load(env, a[0])}),
+        "fs.meta.notify": lambda a: show(fs.fs_meta_notify(
+            env, ap(a[0] if a else ""))),
         "fs.configure": lambda a: show(fs.fs_configure(
             env, flag(a, "locationPrefix", a[0] if a else "/"),
             collection=flag(a, "collection", ""),
@@ -458,6 +463,18 @@ def _shell_handlers(env):
             env, flag(a, "user", "admin"),
             flag(a, "access_key", ""), flag(a, "secret_key", ""),
             actions=(flag(a, "actions", "Admin") or "").split(","))),
+        "s3.bucket.quota": lambda a: show(fs.s3_bucket_quota(
+            env, flag(a, "name", ""), op=flag(a, "op", "set"),
+            size_mb=int(flag(a, "sizeMB", "0")))),
+        "s3.bucket.quota.enforce": lambda a: show(
+            fs.s3_bucket_quota_enforce(env, apply="-apply" in a)),
+        "s3.circuitbreaker": lambda a: show(fs.s3_circuitbreaker(
+            env, actions=flag(a, "actions", ""),
+            values=flag(a, "values", ""),
+            buckets=flag(a, "buckets", ""),
+            enable=(True if "-enable" in a
+                    else False if "-disable" in a else None),
+            delete="-delete" in a)),
     }
 
 
