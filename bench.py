@@ -182,6 +182,46 @@ def bench_hbm_fused(batch: int, length: int,
     return (batch * 10 * length) / GIB / per_step
 
 
+def bench_rebuild_kernel(length: int, chains: tuple[int, int] = (8, 24),
+                         reps: int = 3) -> float:
+    """BASELINE config 3: device reconstruction throughput.  Hard
+    direction: 4 DATA shards lost, rebuilt from 6 data + 4 parity
+    survivors through the same bit-matmul kernel the encode uses, with
+    the reconstruction matrix from rebuild_matrix (inverted survivor
+    submatrix — the one-matmul form of klauspost Reconstruct)."""
+    import jax
+    import jax.numpy as jnp
+
+    from seaweedfs_tpu.ops import rs_pallas
+    from seaweedfs_tpu.parallel.batched_encode import rebuild_matrix
+
+    present = [4, 5, 6, 7, 8, 9, 10, 11, 12, 13]  # data 0-3 lost
+    _, matrix = rebuild_matrix(present, [0, 1, 2, 3])
+
+    @jax.jit
+    def gen(key):
+        return jax.random.randint(key, (10, length), 0, 256,
+                                  dtype=jnp.uint8)
+
+    data = gen(jax.random.PRNGKey(2))
+    np.asarray(data[0, :8])
+
+    def chain(k):
+        @jax.jit
+        def f(x):
+            acc, out = x, None
+            for _ in range(k):
+                out = rs_pallas.apply_matrix_pallas(matrix, acc)
+                acc = acc.at[0, 0].set(out[0, 0])
+            return out[0, :8]
+        return f
+
+    per_step = _slope_time(chain, data, chains, reps)
+    if per_step <= 0:
+        return 0.0
+    return (10 * length) / GIB / per_step
+
+
 def _write_volume(base: str, n_bytes: int, seed: int = 0,
                   block: int = 16 << 20):
     rng = np.random.default_rng(seed)
@@ -320,8 +360,22 @@ def main():
     except Exception as e:
         print(f"note: link probe failed: {e}", file=sys.stderr)
 
+    # -- device reconstruct (BASELINE config 3) ------------------------------
+    rebuild_kernel = 0.0
+    try:
+        rebuild_kernel = bench_rebuild_kernel(
+            (64 << 20) if on_tpu else (4 << 20))
+    except Exception as e:
+        print(f"note: rebuild kernel failed: {e}", file=sys.stderr)
+
     # -- end-to-end disk -> shards -------------------------------------------
-    vol_bytes = (512 << 20) if on_tpu else (64 << 20)
+    # size the volumes to the measured link: a tunneled ~65 MB/s relay
+    # would otherwise spend tens of minutes proving it is slow
+    link_mbps = min(h2d_mbps, d2h_mbps) or 0.0
+    if on_tpu and link_mbps and link_mbps < 500:
+        vol_bytes = 128 << 20
+    else:
+        vol_bytes = (512 << 20) if on_tpu else (64 << 20)
     n_batch = 3 if on_tpu else 2
     e2e_single = e2e_batched = cpu_e2e = 0.0
     workdir = _pick_workdir((n_batch + 1) * vol_bytes * 3)
@@ -343,6 +397,7 @@ def main():
         "platform": platform,
         "kernel_gibps": round(kernel, 3),
         "kernel": best_name,
+        "rebuild_kernel_gibps": round(rebuild_kernel, 3),
         "cpu_avx2_kernel_gibps": round(cpu_kernel, 3),
         "kernel_vs_avx2": round(kernel / cpu_kernel, 3) if cpu_kernel else 0,
         "e2e_single_gibps": round(e2e_single, 3),

@@ -325,9 +325,13 @@ class FilerServer:
     def _fetch_chunk(self, fid: str) -> bytes:
         """Whole-chunk fetch through the LRU chunk cache
         (reader_cache.go)."""
+        from ..stats.metrics import FilerChunkCacheCounter
+
         cached = self.chunk_cache.get(fid)
         if cached is not None:
+            FilerChunkCacheCounter.inc(labels=("hit",))
             return cached
+        FilerChunkCacheCounter.inc(labels=("miss",))
         url = self._lookup_url(fid)
         headers = {}
         if self.guard.read_signing:

@@ -48,20 +48,27 @@ class CircuitBreaker:
         """Config shape (circuit_breaker.json):
         {"global": {"enabled": true, "actions": {"Read:Count": 100,
          "Write:MB": 512, ...}},
-         "buckets": {"b1": {"enabled": true, "actions": {...}}}}"""
+         "buckets": {"b1": {"enabled": true, "actions": {...}}}}
+
+        Built off to the side and swapped under the lock: hot-reload runs
+        on a request thread while other requests are admitting."""
         glob = config.get("global", {})
-        self.enabled = bool(glob.get("enabled"))
-        self.global_limits = {k: int(v)
-                              for k, v in glob.get("actions", {}).items()}
-        self.bucket_limits = {}
+        enabled = bool(glob.get("enabled"))
+        global_limits = {k: int(v)
+                         for k, v in glob.get("actions", {}).items()}
+        bucket_limits: dict[str, dict[str, int]] = {}
         for bucket, conf in config.get("buckets", {}).items():
             if conf.get("enabled"):
-                self.bucket_limits[bucket] = {
+                bucket_limits[bucket] = {
                     k: int(v) for k, v in conf.get("actions", {}).items()}
+        with self._lock:
+            self.enabled = enabled
+            self.global_limits = global_limits
+            self.bucket_limits = bucket_limits
 
     @classmethod
     def load_from_filer(cls, filer_server) -> "CircuitBreaker":
-        return cls(read_config(filer_server))
+        return cls(read_config(filer_server) or {})
 
     # -- admission ----------------------------------------------------------
     def _check(self, limits: dict[str, int], gauge: _Gauge, action: str,
@@ -76,19 +83,21 @@ class CircuitBreaker:
 
     def acquire(self, bucket: str, action: str, nbytes: int = 0):
         """Admit a request or raise SlowDown.  Returns a release handle."""
-        if not self.enabled and bucket not in self.bucket_limits:
+        # snapshot the limit maps once: a concurrent hot-reload swaps
+        # them, and admission must see ONE consistent configuration
+        enabled = self.enabled
+        bucket_rules = self.bucket_limits.get(bucket)
+        if not enabled and bucket_rules is None:
             return lambda: None
-        # only limited buckets need a gauge; unknown bucket names must not
-        # grow the map unboundedly
-        limited = bucket in self.bucket_limits
         with self._lock:
+            # only limited buckets need a gauge; unknown bucket names
+            # must not grow the map unboundedly
             bucket_gauge = self._buckets.setdefault(bucket, _Gauge()) \
-                if limited else None
-            if self.enabled:
+                if bucket_rules is not None else None
+            if enabled:
                 self._check(self.global_limits, self._global, action, nbytes)
-            if limited:
-                self._check(self.bucket_limits[bucket], bucket_gauge,
-                            action, nbytes)
+            if bucket_rules is not None:
+                self._check(bucket_rules, bucket_gauge, action, nbytes)
                 bucket_gauge.count += 1
                 bucket_gauge.bytes += nbytes
             self._global.count += 1
@@ -110,15 +119,21 @@ class CircuitBreaker:
         return release
 
 
-def read_config(filer_server) -> dict:
+def read_config(filer_server) -> Optional[dict]:
     """Fetch /etc/s3/circuit_breaker.json through the filer's full read
     path — configs past the inline limit live in chunks, so
-    entry.content alone would silently read as empty."""
+    entry.content alone would silently read as empty.
+
+    Returns {} when no config exists, and None on a TRANSIENT read
+    failure: a hot-reloading caller must keep its current limits rather
+    than silently dropping all throttles."""
     from ..filer.filer_store import NotFoundError
     from ..rpc.http_rpc import RpcError
 
     try:
         entry = filer_server.filer.find_entry(CONFIG_PATH)
         return json.loads(filer_server.read_bytes(entry).decode())
-    except (NotFoundError, RpcError, ValueError):
+    except (NotFoundError, ValueError):
         return {}
+    except RpcError:
+        return None

@@ -138,8 +138,11 @@ class S3ApiServer:
                 time.time() - self._cb_checked < 1.0:
             return
         self._cb_checked = time.time()
-        # load() swaps limits in place; in-flight gauges survive
-        self.circuit_breaker.load(cb_read_config(self.filer_server))
+        config = cb_read_config(self.filer_server)
+        if config is None:
+            return  # transient read failure: keep the current limits
+        # load() swaps limits atomically; in-flight gauges survive
+        self.circuit_breaker.load(config)
 
     # -- routing -------------------------------------------------------------
     def _handle(self, method: str, req: Request):

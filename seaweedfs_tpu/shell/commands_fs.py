@@ -341,7 +341,13 @@ def fs_cd(env: CommandEnv, path: str = "/") -> dict:
     listable directory."""
     target = resolve_path(env, path)
     if target != "/":
-        _list(find_filer(env), target)  # 404s when absent
+        # ONE limit=1 request proves existence + directory-ness; a full
+        # _list() would page through every entry of a huge directory
+        resp = call(find_filer(env),
+                    urllib.parse.quote(target.rstrip("/") + "/")
+                    + "?limit=1")
+        if not isinstance(resp, dict):
+            raise RpcError(f"{target} is not a directory", 400)
     env.cwd = target
     return {"cwd": target}
 
@@ -383,8 +389,10 @@ def fs_meta_notify(env: CommandEnv, path: str = "/") -> dict:
             if _is_dir(e):
                 walk(full)
 
-    walk(resolve_path(env, path))
-    queue.close()
+    try:
+        walk(resolve_path(env, path))
+    finally:
+        queue.close()  # flush buffered events even on a mid-walk error
     return {"notified": sent}
 
 

@@ -282,6 +282,9 @@ VolumeFsyncBatchCounter = REGISTRY.counter(
 EcEncodeBytesCounter = REGISTRY.counter(
     "SeaweedFS_volumeServer_ec_encode_bytes_total",
     "volume bytes pushed through the batched EC encode pipeline")
+FilerChunkCacheCounter = REGISTRY.counter(
+    "SeaweedFS_filer_chunk_cache_total",
+    "filer chunk cache lookups", ("result",))
 FilerRequestCounter = REGISTRY.counter(
     "SeaweedFS_filer_request_total", "filer requests", ("type",))
 FilerRequestHistogram = REGISTRY.histogram(

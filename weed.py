@@ -21,6 +21,21 @@ from seaweedfs_tpu.rpc.http_rpc import RpcError, call  # noqa: E402
 VERSION = "seaweedfs_tpu 0.1 (RS(10,4) EC on TPU via JAX/Pallas)"
 
 
+def _completion_script(subcommands) -> str:
+    """Bash completion for the weed CLI (command/autocomplete.go)."""
+    words = " ".join(subcommands)
+    return f"""# bash completion for weed — `source <(weed autocomplete)`
+_weed_complete() {{
+    local cur="${{COMP_WORDS[COMP_CWORD]}}"
+    if [ "$COMP_CWORD" -eq 1 ]; then
+        COMPREPLY=( $(compgen -W "{words}" -- "$cur") )
+    else
+        COMPREPLY=( $(compgen -f -- "$cur") )
+    fi
+}}
+complete -F _weed_complete weed weed.py"""
+
+
 def _wait_forever(stoppables):
     from seaweedfs_tpu.util import grace
 
@@ -1216,6 +1231,13 @@ def main(argv=None):
 
     p = sub.add_parser("version", help="print version")
     p.set_defaults(fn=lambda a: print(VERSION))
+
+    p = sub.add_parser("autocomplete",
+                       help="print a bash completion script "
+                            "(source it or install under "
+                            "/etc/bash_completion.d)")
+    p.set_defaults(fn=lambda a: print(_completion_script(
+        sorted(sub.choices))))
 
     args = parser.parse_args(argv)
     if args.v:
