@@ -11,6 +11,7 @@ a threading server.  TPU-side collectives stay inside JAX (parallel/mesh.py)
 
 from __future__ import annotations
 
+import http.client
 import json
 import socket
 import threading
@@ -104,6 +105,13 @@ class RpcServer:
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+            # keep-alive + Nagle + delayed ACK = 40 ms quanta per
+            # response; the handler's wfile is unbuffered so every
+            # header line would otherwise be its own delayed segment
+            disable_nagle_algorithm = True
+            # reap idle keep-alive connections: each one pins a handler
+            # thread + fd; clients transparently retry a reaped socket
+            timeout = 60
 
             def log_message(self, fmt, *args):
                 pass
@@ -195,7 +203,12 @@ class RpcServer:
             def do_DELETE(self):
                 self._dispatch("DELETE")
 
-        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        class Server(ThreadingHTTPServer):
+            # the stdlib default backlog of 5 causes 1s+ SYN-retransmit
+            # stalls under modest concurrency (16 clients saturate it)
+            request_queue_size = 128
+
+        self.httpd = Server((host, port), Handler)
         self.httpd.daemon_threads = True
         self.host = host
         self.port = self.httpd.server_address[1]
@@ -250,6 +263,53 @@ class RpcServer:
 # -- client helpers ----------------------------------------------------------
 
 
+class _NoDelayConnection(http.client.HTTPConnection):
+    """HTTPConnection with TCP_NODELAY: headers and body go out as
+    separate send()s, and Nagle would hold the second for the peer's
+    delayed ACK (~40 ms) on every pooled reuse."""
+
+    def connect(self):
+        super().connect()
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+
+
+class _ConnPool:
+    """Keep-alive HTTP connection pool, shared process-wide — the
+    analogue of the reference's cached gRPC client connections
+    (rpc/grpc_client_server.go:27-41).  Bounded idle list per address;
+    borrowed connections that error are closed, not returned."""
+
+    def __init__(self, max_idle_per_addr: int = 16):
+        self._lock = threading.Lock()
+        self._idle: dict[str, list] = {}
+        self.max_idle = max_idle_per_addr
+
+    def get(self, addr: str, timeout: float):
+        with self._lock:
+            idle = self._idle.get(addr)
+            conn = idle.pop() if idle else None
+        if conn is None:
+            host, _, port = addr.partition(":")
+            conn = _NoDelayConnection(
+                host, int(port) if port else 80, timeout=timeout)
+        else:
+            conn.timeout = timeout
+            if conn.sock is not None:
+                conn.sock.settimeout(timeout)
+        return conn
+
+    def put(self, addr: str, conn):
+        with self._lock:
+            idle = self._idle.setdefault(addr, [])
+            if len(idle) < self.max_idle:
+                idle.append(conn)
+                return
+        conn.close()
+
+
+_POOL = _ConnPool()
+
+
 def call(addr: str, path: str, payload: Optional[dict] = None,
          method: Optional[str] = None, timeout: float = 30.0,
          raw: Optional[bytes] = None, headers: Optional[dict] = None,
@@ -257,7 +317,6 @@ def call(addr: str, path: str, payload: Optional[dict] = None,
     """JSON RPC call; returns parsed JSON (or raw bytes for non-JSON).
     parse=False always returns the raw body — required when fetching
     stored object content whose mime may itself be application/json."""
-    url = f"http://{addr}{path}"
     data = None
     req_headers = dict(headers or {})
     if raw is not None:
@@ -267,24 +326,51 @@ def call(addr: str, path: str, payload: Optional[dict] = None,
         req_headers["Content-Type"] = "application/json"
     if method is None:
         method = "POST" if data is not None else "GET"
-    req = urllib.request.Request(url, data=data, method=method,
-                                 headers=req_headers)
-    try:
-        with urllib.request.urlopen(req, timeout=timeout) as resp:
-            body = resp.read()
-            ctype = resp.headers.get("Content-Type", "")
-    except urllib.error.HTTPError as e:
-        body = e.read()
+    # one retry, ONLY for a pooled connection the server closed while it
+    # sat idle (keep-alive reap, restart): those fail with a reset /
+    # disconnect before any response.  Timeouts and errors on fresh
+    # connections never retry — re-sending a non-idempotent RPC that may
+    # already be executing would double-apply the mutation
+    stale_errors = (http.client.RemoteDisconnected,
+                    http.client.BadStatusLine,
+                    ConnectionResetError, BrokenPipeError)
+    for attempt in (0, 1):
+        if attempt == 0:
+            conn = _POOL.get(addr, timeout)
+        else:  # bypass the pool: it may hold MORE stale sockets
+            host, _, port = addr.partition(":")
+            conn = _NoDelayConnection(host, int(port) if port else 80,
+                                      timeout=timeout)
+        fresh = conn.sock is None
         try:
-            message = json.loads(body).get("error", body.decode())
-        except Exception:
-            message = body.decode(errors="replace")
-        raise RpcError(message, e.code) from None
-    except (urllib.error.URLError, socket.timeout, ConnectionError) as e:
-        raise RpcError(f"cannot reach {addr}: {e}", 503) from None
-    if parse and "application/json" in ctype:
-        return json.loads(body) if body else {}
-    return body
+            conn.request(method, path, body=data, headers=req_headers)
+            resp = conn.getresponse()
+            body = resp.read()
+            status = resp.status
+            ctype = resp.headers.get("Content-Type", "")
+            keep = not resp.will_close
+        except stale_errors as e:
+            conn.close()
+            if attempt == 0 and not fresh:
+                continue
+            raise RpcError(f"cannot reach {addr}: {e}", 503) from None
+        except (http.client.HTTPException, ConnectionError,
+                socket.timeout, TimeoutError, OSError) as e:
+            conn.close()
+            raise RpcError(f"cannot reach {addr}: {e}", 503) from None
+        if keep:
+            _POOL.put(addr, conn)
+        else:
+            conn.close()
+        if status >= 400:
+            try:
+                message = json.loads(body).get("error", body.decode())
+            except Exception:
+                message = body.decode(errors="replace")
+            raise RpcError(message, status)
+        if parse and "application/json" in ctype:
+            return json.loads(body) if body else {}
+        return body
 
 
 def call_stream(addr: str, path: str, payload: Optional[dict] = None,

@@ -83,13 +83,13 @@ class CircuitBreaker:
 
     def acquire(self, bucket: str, action: str, nbytes: int = 0):
         """Admit a request or raise SlowDown.  Returns a release handle."""
-        # snapshot the limit maps once: a concurrent hot-reload swaps
-        # them, and admission must see ONE consistent configuration
-        enabled = self.enabled
-        bucket_rules = self.bucket_limits.get(bucket)
-        if not enabled and bucket_rules is None:
-            return lambda: None
         with self._lock:
+            # read the whole configuration under the same lock load()
+            # swaps it under: one admission, ONE consistent config
+            enabled = self.enabled
+            bucket_rules = self.bucket_limits.get(bucket)
+            if not enabled and bucket_rules is None:
+                return lambda: None
             # only limited buckets need a gauge; unknown bucket names
             # must not grow the map unboundedly
             bucket_gauge = self._buckets.setdefault(bucket, _Gauge()) \
