@@ -277,7 +277,8 @@ def cmd_server(args):
     dirs = args.dir.split(",")
     vs = VolumeServer(dirs, master.address, host=args.ip,
                       port=args.volumePort, rack=args.rack,
-                      pulse_seconds=args.pulseSeconds, guard=guard)
+                      pulse_seconds=args.pulseSeconds, guard=guard,
+                      enable_tcp=args.tcp)
     vs.start()
     vs.heartbeat_once()
     stoppables.append(vs)
@@ -1067,6 +1068,8 @@ def main(argv=None):
                    help="filer store kind: sqlite | sharded | perbucket")
     p.add_argument("-config", default="")
     p.add_argument("-rack", default="")
+    p.add_argument("-tcp", action="store_true",
+                   help="enable the volume TCP read fast path")
     p.add_argument("-encryptVolumeData", action="store_true",
                    help="encrypt chunk data at rest (per-chunk AES keys "
                         "in filer metadata)")
