@@ -15,6 +15,7 @@ import http.client
 import json
 import socket
 import threading
+import time
 import urllib.error
 import urllib.parse
 import urllib.request
@@ -208,6 +209,47 @@ class RpcServer:
             # stalls under modest concurrency (16 clients saturate it)
             request_queue_size = 128
 
+            def __init__(s, *a, **kw):
+                s._conns = set()
+                s._conns_lock = threading.Lock()
+                super().__init__(*a, **kw)
+
+            # track established connections: shutdown() only stops the
+            # accept loop, and a keep-alive handler thread would keep
+            # serving a STOPPED daemon's state (zombie server) — stop()
+            # must be able to sever them
+            def process_request(s, request, client_address):
+                with s._conns_lock:
+                    s._conns.add(request)
+                super().process_request(request, client_address)
+
+            def shutdown_request(s, request):
+                with s._conns_lock:
+                    s._conns.discard(request)
+                super().shutdown_request(request)
+
+            def close_all_connections(s):
+                with s._conns_lock:
+                    conns = list(s._conns)
+                for sock in conns:
+                    try:
+                        sock.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+
+            def wait_connections_closed(s, timeout: float = 5.0) -> bool:
+                """Wait for in-flight handler threads to finish their
+                current request and exit (they deregister the socket in
+                shutdown_request) — callers tear down shared state next,
+                and a handler mid-mutation must not race that."""
+                deadline = time.monotonic() + timeout
+                while time.monotonic() < deadline:
+                    with s._conns_lock:
+                        if not s._conns:
+                            return True
+                    time.sleep(0.01)
+                return False
+
         self.httpd = Server((host, port), Handler)
         self.httpd.daemon_threads = True
         self.host = host
@@ -255,6 +297,12 @@ class RpcServer:
 
     def stop(self):
         self.httpd.shutdown()
+        # sever live keep-alive connections: their handler threads would
+        # otherwise keep answering from this daemon's torn-down state
+        # (clients transparently retry on a fresh connection) — then
+        # drain in-flight requests before the caller tears down stores
+        self.httpd.close_all_connections()
+        self.httpd.wait_connections_closed()
         self.httpd.server_close()
         if self._thread:
             self._thread.join(timeout=5)
@@ -343,7 +391,23 @@ def call(addr: str, path: str, payload: Optional[dict] = None,
                                       timeout=timeout)
         fresh = conn.sock is None
         try:
+            # SEND phase: a reuse failure here means the server closed
+            # the idle socket before receiving the request — safe to
+            # retry any method, it was never fully delivered
             conn.request(method, path, body=data, headers=req_headers)
+        except stale_errors as e:
+            conn.close()
+            if attempt == 0 and not fresh:
+                continue
+            raise RpcError(f"cannot reach {addr}: {e}", 503) from None
+        except (http.client.HTTPException, ConnectionError,
+                socket.timeout, TimeoutError, OSError) as e:
+            conn.close()
+            raise RpcError(f"cannot reach {addr}: {e}", 503) from None
+        try:
+            # RECEIVE phase: the request reached the server and may have
+            # EXECUTED even though the response was lost — only
+            # idempotent methods may retry here
             resp = conn.getresponse()
             body = resp.read()
             status = resp.status
@@ -351,7 +415,7 @@ def call(addr: str, path: str, payload: Optional[dict] = None,
             keep = not resp.will_close
         except stale_errors as e:
             conn.close()
-            if attempt == 0 and not fresh:
+            if attempt == 0 and not fresh and method in ("GET", "HEAD"):
                 continue
             raise RpcError(f"cannot reach {addr}: {e}", 503) from None
         except (http.client.HTTPException, ConnectionError,

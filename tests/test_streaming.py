@@ -193,3 +193,42 @@ class TestInterruptedCopy:
             fake.stop()
             dst.stop()
             master.stop()
+
+
+class TestServerStopSeversKeepAlive:
+    """stop() must tear down established keep-alive connections: a
+    pooled client socket must not keep talking to a handler thread of a
+    stopped daemon (zombie server serving torn-down state)."""
+
+    def test_same_port_restart_reads_fresh_server(self, tmp_path):
+        from seaweedfs_tpu.master.server import MasterServer
+        from seaweedfs_tpu.rpc.http_rpc import call
+        from seaweedfs_tpu.volume_server.server import VolumeServer
+
+        master = MasterServer(port=0, pulse_seconds=0.2)
+        master.start()
+        d = tmp_path / "v"
+        d.mkdir()
+        vs = VolumeServer([str(d)], master.address, port=0,
+                          pulse_seconds=0.2)
+        vs.start()
+        vs.heartbeat_once()
+        fids = []
+        for i in range(10):
+            a = call(master.address, "/dir/assign")
+            call(a["url"], f"/{a['fid']}", raw=b"z%d" % i, method="POST")
+            fids.append((a["url"], a["fid"]))
+        port = vs.server.port
+        vs.stop()
+        vs2 = VolumeServer([str(d)], master.address, port=port,
+                           pulse_seconds=0.2)
+        vs2.start()
+        vs2.heartbeat_once()
+        try:
+            # pooled connections were severed on stop; every read must
+            # reach the RESTARTED server, which has the volumes loaded
+            for i, (url, fid) in enumerate(fids):
+                assert call(url, f"/{fid}", timeout=10) == b"z%d" % i
+        finally:
+            vs2.stop()
+            master.stop()
