@@ -54,10 +54,10 @@ def new_encoder(data_shards: int = 10, parity_shards: int = 4,
             backend = "cpu"
         else:
             backend = "numpy"
-    if backend == "tpu":
+    if backend in ("tpu", "jax"):
         from .rs_jax import JaxEncoder
 
-        method = "pallas" if on_tpu() else "swar"
+        method = "pallas" if backend == "tpu" and on_tpu() else "swar"
         return JaxEncoder(data_shards, parity_shards, method=method)
     if backend == "cpu":
         return NativeEncoder(data_shards, parity_shards)

@@ -134,7 +134,24 @@ class Store:
         raise NotFoundError(f"volume {vid} not found")
 
     # -- EC admin (volume_grpc_erasure_coding.go handlers) --------------------
-    def ec_generate(self, vid: int):
+    def _resolve_ec_encoder(self):
+        """-ec.backend semantics: None or "tpu" select the batched
+        device pipeline (encoder=None downstream); a codec NAME
+        ("cpu" | "jax" | "numpy") resolves to that host/per-row codec;
+        an explicit encoder object passes through."""
+        backend = self.ec_encoder_backend
+        if backend is None or backend == "tpu":
+            return None
+        if isinstance(backend, str):
+            from ..ops import codec
+            from .erasure_coding import (DATA_SHARDS_COUNT,
+                                         PARITY_SHARDS_COUNT)
+
+            return codec.new_encoder(DATA_SHARDS_COUNT,
+                                     PARITY_SHARDS_COUNT, backend=backend)
+        return backend
+
+    def ec_generate(self, vid: int, encoder=None):
         """VolumeEcShardsGenerate: encode a local volume into shard files.
 
         Default backend is the streaming batched TPU pipeline; the fused
@@ -146,8 +163,8 @@ class Store:
             raise NotFoundError(f"volume {vid} not found")
         base = v.file_name()
         v.sync()
-        crcs = ec_encoder.write_ec_files(base,
-                                         encoder=self.ec_encoder_backend)
+        crcs = ec_encoder.write_ec_files(
+            base, encoder=encoder or self._resolve_ec_encoder())
         ec_encoder.write_sorted_file_from_idx(base)
         extra = {"shard_crc32c": crcs} if crcs else None
         ec_encoder.save_volume_info(base, version=v.version, extra=extra)
@@ -160,9 +177,11 @@ class Store:
         is configured."""
         from ..util.platform import jax_usable
 
-        if self.ec_encoder_backend is not None or not jax_usable():
+        if self.ec_encoder_backend not in (None, "tpu") or \
+                not jax_usable():
+            enc = self._resolve_ec_encoder()  # resolve the codec ONCE
             for vid in vids:
-                self.ec_generate(vid)
+                self.ec_generate(vid, encoder=enc)
             return
         from ..parallel.batched_encode import encode_volumes
 
@@ -195,7 +214,7 @@ class Store:
         base = (loc._base_name(collection, vid) if loc
                 else self.locations[0]._base_name(collection, vid))
         crcs = ec_encoder.rebuild_ec_files(base,
-                                           encoder=self.ec_encoder_backend)
+                                           encoder=self._resolve_ec_encoder())
         info = ec_encoder.load_volume_info(base) or {}
         stored = info.get("shard_crc32c")
         if isinstance(stored, list) and len(stored) == TOTAL_SHARDS_COUNT:

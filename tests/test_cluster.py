@@ -384,3 +384,47 @@ class TestReadDepth:
         status, _, _ = self._raw_get(other.address, f"/{fid}",
                                      {"X-SW-Proxied": "1"})
         assert status == 404
+
+
+class TestEcBackendSelection:
+    """-ecBackend accepts codec NAMES: a string backend must resolve to
+    the named codec (regression: the raw string used to reach the encode
+    loop as if it were an encoder object and crash)."""
+
+    @pytest.mark.parametrize("backend", ["cpu", "numpy", "tpu", "jax"])
+    def test_ec_generate_with_named_backend(self, tmp_path, backend):
+        import numpy as np
+
+        from seaweedfs_tpu.ops import native
+        from seaweedfs_tpu.rpc.http_rpc import call
+
+        if backend == "cpu" and native.lib() is None:
+            pytest.skip("native AVX2 library unavailable")
+
+        master = MasterServer(port=0, pulse_seconds=0.2)
+        master.start()
+        d = tmp_path / "v"
+        d.mkdir()
+        vs = VolumeServer([str(d)], master.address, port=0,
+                          pulse_seconds=0.2, ec_encoder_backend=backend)
+        vs.start()
+        vs.heartbeat_once()
+        try:
+            rng = np.random.default_rng(3)
+            payloads = {}
+            for i in range(6):
+                body = rng.integers(0, 256, 64 << 10,
+                                    dtype=np.uint8).tobytes()
+                a = call(master.address, "/dir/assign")
+                call(a["url"], f"/{a['fid']}", raw=body, method="POST")
+                payloads[(a["url"], a["fid"])] = body
+            vid = sorted(vs.store.locations[0].volumes)[0]
+            call(vs.address, "/admin/ec/generate",
+                 {"volume": vid, "collection": ""}, timeout=300)
+            import os
+            shards = [f for f in os.listdir(d)
+                      if f.startswith(f"{vid}.ec")]
+            assert len(shards) >= 14  # .ec00-.ec13 (+ .ecx)
+        finally:
+            vs.stop()
+            master.stop()

@@ -63,12 +63,15 @@ class TestConcurrencySoak:
                 if len(candidates) > 20:
                     key = rng.choice(candidates)
                     url, fid = key.rsplit("/", 1)
+                    # mark intent BEFORE the RPC: a reader can observe
+                    # the server-side delete before the client returns,
+                    # and must not count that 404 as a lost needle
+                    with lock:
+                        deleted.add(key)
                     try:
                         call(url, f"/{fid}", method="DELETE")
-                        with lock:
-                            deleted.add(key)
                     except RpcError:
-                        pass
+                        pass  # stays marked: readers accept either way
                 stop.wait(0.01)
 
         def reader(seed: int):

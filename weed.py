@@ -132,6 +132,7 @@ def cmd_volume(args):
                       tier_backends=_parse_tier_backends(args.tier),
                       enable_tcp=args.tcp, read_mode=args.readMode,
                       fsync=args.fsync, needle_map_kind=args.index,
+                      ec_encoder_backend=args.ecBackend or None,
                       upload_limit_mb=args.concurrentUploadLimitMB,
                       download_limit_mb=args.concurrentDownloadLimitMB)
     vs.start()
@@ -991,6 +992,10 @@ def main(argv=None):
                    help="how to serve reads of non-local volumes")
     p.add_argument("-fsync", action="store_true",
                    help="group-commit fsync before acknowledging writes")
+    p.add_argument("-ecBackend", default="",
+                   choices=["", "tpu", "cpu", "jax", "numpy"],
+                   help="EC codec: tpu (batched device pipeline, default) "
+                        "| cpu (AVX2) | jax (portable XLA) | numpy")
     p.add_argument("-index", default="memory",
                    choices=["memory", "compact", "sqlite"],
                    help="needle index kind (compact: 16 B/needle numpy "
