@@ -30,23 +30,30 @@ class S3Client:
 
     # -- signing -------------------------------------------------------------
     def _sign(self, method: str, path: str, query: dict,
-              body: bytes) -> dict:
+              body: bytes, payload_hash: str = "",
+              extra_headers: Optional[dict] = None) -> dict:
+        """SigV4 header auth.  payload_hash overrides the body digest
+        (for the streaming sentinel); extra_headers join the SIGNED
+        header set.  Also returns the computed signature and scope under
+        private "_sig"/"_scope"/"_datestamp" keys (popped before
+        sending) so the streaming path can chain chunk signatures."""
         if not self.access_key:
             return {}
         now = time.gmtime()
         amz_date = time.strftime("%Y%m%dT%H%M%SZ", now)
         datestamp = time.strftime("%Y%m%d", now)
-        payload_hash = hashlib.sha256(body).hexdigest()
+        payload_hash = payload_hash or hashlib.sha256(body).hexdigest()
         headers = {
             "Host": self.endpoint,
             "X-Amz-Date": amz_date,
             "X-Amz-Content-Sha256": payload_hash,
+            **(extra_headers or {}),
         }
-        signed = ["host", "x-amz-content-sha256", "x-amz-date"]
+        signed = sorted(k.lower() for k in headers)
         canonical_uri = urllib.parse.quote(path, safe="/~")
         canonical_query = self._canonical_query(query)
         lower = {k.lower(): v for k, v in headers.items()}
-        header_lines = [f"{name}:{' '.join(lower[name].split())}"
+        header_lines = [f"{name}:{' '.join(str(lower[name]).split())}"
                         for name in signed]
         canonical = "\n".join([
             method, canonical_uri, canonical_query,
@@ -56,18 +63,26 @@ class S3Client:
             ALGORITHM, amz_date, scope,
             hashlib.sha256(canonical.encode()).hexdigest()])
 
+        signature = hmac.new(self._signing_key(datestamp),
+                             string_to_sign.encode(),
+                             hashlib.sha256).hexdigest()
+        headers["Authorization"] = (
+            f"{ALGORITHM} Credential={self.access_key}/{scope}, "
+            f"SignedHeaders={';'.join(signed)}, Signature={signature}")
+        headers["_sig"] = signature
+        headers["_scope"] = scope
+        headers["_amz_date"] = amz_date
+        headers["_datestamp"] = datestamp
+        return headers
+
+    def _signing_key(self, datestamp: str) -> bytes:
         def h(key, msg):
             return hmac.new(key, msg.encode(), hashlib.sha256).digest()
 
         k = h(("AWS4" + self.secret_key).encode(), datestamp)
         for part in (self.region, "s3", "aws4_request"):
             k = h(k, part)
-        signature = hmac.new(k, string_to_sign.encode(),
-                             hashlib.sha256).hexdigest()
-        headers["Authorization"] = (
-            f"{ALGORITHM} Credential={self.access_key}/{scope}, "
-            f"SignedHeaders={';'.join(signed)}, Signature={signature}")
-        return headers
+        return k
 
     @staticmethod
     def _canonical_query(query: dict) -> str:
@@ -80,11 +95,16 @@ class S3Client:
                  urllib.parse.quote(str(v), safe="~"))
                 for k, v in query.items()))
 
+    @staticmethod
+    def _strip_private(headers: dict) -> dict:
+        return {k: v for k, v in headers.items()
+                if not k.startswith("_")}
+
     def _request(self, method: str, path: str,
                  query: Optional[dict] = None, body: bytes = b"",
                  content_type: str = "", parse: bool = True):
         query = query or {}
-        headers = self._sign(method, path, query, body)
+        headers = self._strip_private(self._sign(method, path, query, body))
         if content_type:
             headers["Content-Type"] = content_type
         qs = self._canonical_query(query)
@@ -108,6 +128,55 @@ class S3Client:
                    content_type: str = "application/octet-stream"):
         self._request("PUT", f"/{bucket}/{key.lstrip('/')}", body=data,
                       content_type=content_type)
+
+    def put_object_streaming(self, bucket: str, key: str, data,
+                             chunk_size: int = 64 << 10,
+                             content_type: str =
+                             "application/octet-stream"):
+        """Upload with sigv4 streaming chunk signatures (aws-chunked,
+        STREAMING-AWS4-HMAC-SHA256-PAYLOAD): each frame is individually
+        signed against the seed chain.  `data` is bytes-like or an
+        iterable of byte chunks (empty chunks are skipped — a zero
+        frame terminates the stream)."""
+        if isinstance(data, (bytes, bytearray, memoryview)):
+            data = bytes(data)
+            pieces = [data[i:i + chunk_size]
+                      for i in range(0, len(data), chunk_size)]
+        else:
+            pieces = [bytes(p) for p in data if len(p)]
+        if not self.access_key:
+            # unsigned gateways take a plain PUT
+            return self.put_object(bucket, key, b"".join(pieces),
+                                   content_type)
+        total = sum(len(p) for p in pieces)
+        path = f"/{bucket}/{key.lstrip('/')}"
+        headers = self._sign(
+            "PUT", path, {}, b"",
+            payload_hash="STREAMING-AWS4-HMAC-SHA256-PAYLOAD",
+            extra_headers={
+                "Content-Encoding": "aws-chunked",
+                "X-Amz-Decoded-Content-Length": str(total),
+                "Content-Type": content_type,
+            })
+        k = self._signing_key(headers["_datestamp"])
+        amz_date, scope = headers["_amz_date"], headers["_scope"]
+        prev = headers["_sig"]
+        headers = self._strip_private(headers)
+        empty = hashlib.sha256(b"").hexdigest()
+        frames = bytearray()
+        pieces.append(b"")  # terminator frame
+        while pieces:  # consume as we frame: one resident copy, not two
+            piece = pieces.pop(0)
+            sts = "\n".join([
+                "AWS4-HMAC-SHA256-PAYLOAD", amz_date, scope, prev, empty,
+                hashlib.sha256(piece).hexdigest()])
+            sig = hmac.new(k, sts.encode(), hashlib.sha256).hexdigest()
+            frames += f"{len(piece):x};chunk-signature={sig}\r\n".encode()
+            frames += piece + b"\r\n"
+            prev = sig
+        call(self.endpoint, urllib.parse.quote(path, safe="/~"),
+             raw=bytes(frames), method="PUT", headers=headers,
+             timeout=300)
 
     def get_object(self, bucket: str, key: str) -> bytes:
         body = self._request("GET", f"/{bucket}/{key.lstrip('/')}",
