@@ -307,14 +307,37 @@ class FilerServer:
         if len(body) <= INLINE_LIMIT:
             entry.content = body
         else:
-            offset = 0
-            while offset < len(body):
-                piece = body[offset:offset + self.chunk_size]
-                chunk = self._upload_blob(piece, rule.replication,
-                                          rule.collection)
-                chunk.offset = offset
-                entry.chunks.append(chunk)
-                offset += len(piece)
+            offsets = list(range(0, len(body), self.chunk_size))
+            failed = threading.Event()
+
+            def upload(off: int) -> FileChunk:
+                if failed.is_set():
+                    # a sibling chunk already failed: do not keep
+                    # uploading thousands of soon-to-be-orphaned blobs
+                    raise RpcError("aborted: sibling chunk failed", 500)
+                try:
+                    piece = body[off:off + self.chunk_size]
+                    chunk = self._upload_blob(piece, rule.replication,
+                                              rule.collection)
+                except Exception:
+                    failed.set()
+                    raise
+                chunk.offset = off
+                return chunk
+
+            if len(offsets) == 1:
+                entry.chunks = [upload(0)]
+            else:
+                # upload chunks concurrently (the reference fans chunk
+                # uploads out per goroutine, _write_upload.go): a large
+                # body otherwise pays one serial assign+POST round trip
+                # per chunk.  The first failure aborts the fan-out; the
+                # few in-flight orphans are reclaimed by vacuum
+                from concurrent.futures import ThreadPoolExecutor
+
+                workers = min(8, len(offsets))
+                with ThreadPoolExecutor(max_workers=workers) as pool:
+                    entry.chunks = list(pool.map(upload, offsets))
             entry.chunks = maybe_manifestize(
                 lambda blob: self._upload_blob(blob, rule.replication,
                                                rule.collection),
