@@ -384,17 +384,42 @@ class FilerServer:
         chunks = entry.chunks
         if has_chunk_manifest(chunks):
             chunks = resolve_chunk_manifest(self._fetch_chunk, chunks)
-        parts = []
-        for view in read_chunk_views(chunks, start, length):
-            data = self._fetch_chunk(view.fid)
-            if view.cipher_key:
-                # cache holds what the volume stores (ciphertext);
-                # plaintext exists only in flight
-                from ..util.cipher import decrypt
+        views = read_chunk_views(chunks, start, length)
+        # fetch+decrypt once per UNIQUE chunk (overwrites can split one
+        # chunk into several views), concurrently like the write fan-out
+        # (stream.go reads chunk views in parallel goroutines); the
+        # first failure short-circuits the queued fetches
+        keys = {v.fid: v.cipher_key for v in views}
+        fids = list(keys)
+        failed = threading.Event()
 
-                data = decrypt(data, view.cipher_key)
-            parts.append(data[view.offset_in_chunk:
-                              view.offset_in_chunk + view.size])
+        def fetch(fid: str) -> bytes:
+            if failed.is_set():
+                raise RpcError("aborted: sibling chunk fetch failed", 500)
+            try:
+                data = self._fetch_chunk(fid)
+                if keys[fid]:
+                    # cache holds what the volume stores (ciphertext);
+                    # plaintext exists only in flight
+                    from ..util.cipher import decrypt
+
+                    data = decrypt(data, keys[fid])
+            except Exception:
+                failed.set()
+                raise
+            return data
+
+        if len(fids) <= 1:
+            blobs = {fid: fetch(fid) for fid in fids}
+        else:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(
+                    max_workers=min(8, len(fids))) as pool:
+                blobs = dict(zip(fids, pool.map(fetch, fids)))
+        parts = [blobs[v.fid][v.offset_in_chunk:
+                              v.offset_in_chunk + v.size]
+                 for v in views]
         self._maybe_prefetch(chunks, start + length)
         return b"".join(parts)
 
