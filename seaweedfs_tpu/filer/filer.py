@@ -300,9 +300,36 @@ class Filer:
         self.store.insert_entry(d)
         self._notify(d.parent, None, d)
 
+    @staticmethod
+    def _expired(entry: Entry) -> bool:
+        """TTL'd file entries expire ttl_sec after creation
+        (entry.go Entry.IsExpired semantics); directories never do."""
+        return (entry.attr.ttl_sec > 0 and not entry.is_directory
+                and entry.attr.crtime + entry.attr.ttl_sec < time.time())
+
     def find_entry(self, path: str) -> Entry:
-        return self._resolve_hardlink(
+        entry = self._resolve_hardlink(
             self.store.find_entry(self._norm(path)))
+        if self._expired(entry):
+            # lazily reap the metadata; the TTL volume holding the
+            # chunks expires wholesale on the cluster side, so no
+            # per-chunk delete RPCs on the read path — and re-verify
+            # under the lock so a concurrent re-create of the same path
+            # is never deleted
+            with self.lock:
+                current = self._find_or_none(entry.full_path)
+                if current is not None and self._expired(current):
+                    try:
+                        # hardlinked entries must still release their
+                        # refcount; plain files skip per-chunk delete
+                        # RPCs (the TTL volume expires them wholesale)
+                        self.delete_entry(
+                            entry.full_path,
+                            delete_chunks=bool(current.hard_link_id))
+                    except (NotFoundError, ValueError):
+                        pass
+            raise NotFoundError(path)
+        return entry
 
     def _find_or_none(self, path: str) -> Optional[Entry]:
         try:
@@ -386,11 +413,27 @@ class Filer:
     def list_directory(self, path: str, start_file: str = "",
                        limit: int = 1024, prefix: str = "",
                        include_start: bool = False) -> list[Entry]:
-        entries = self.store.list_directory(
-            self._norm(path), start_file=start_file, limit=limit,
-            prefix=prefix, include_start=include_start)
-        return [self._resolve_hardlink(e) if e.hard_link_id else e
-                for e in entries]
+        # filter expired entries BEFORE the limit counts them, or a page
+        # of expired entries would truncate pagination and hide live
+        # entries sorted after it
+        path = self._norm(path)
+        out: list[Entry] = []
+        cursor, inc = start_file, include_start
+        while len(out) < limit:
+            want = limit - len(out)
+            batch = self.store.list_directory(
+                path, start_file=cursor, limit=want, prefix=prefix,
+                include_start=inc)
+            if not batch:
+                break
+            for e in batch:
+                if not self._expired(e):
+                    out.append(self._resolve_hardlink(e)
+                               if e.hard_link_id else e)
+            cursor, inc = batch[-1].name, False
+            if len(batch) < want:
+                break
+        return out
 
     def rename(self, old_path: str, new_path: str):
         """Atomic single-entry rename + recursive subtree move

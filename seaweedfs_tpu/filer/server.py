@@ -150,12 +150,16 @@ class FilerServer:
 
     # -- volume cluster plumbing ---------------------------------------------
     def _assign(self, count: int = 1, replication: str = "",
-                collection: str = "") -> dict:
+                collection: str = "", ttl: str = "") -> dict:
         query = f"count={count}"
         if replication or self.replication:
             query += f"&replication={replication or self.replication}"
         if collection or self.collection:
             query += f"&collection={collection or self.collection}"
+        if ttl:
+            # per-path TTL rules land chunks on TTL volume layouts the
+            # master expires wholesale (filer_conf.go -> assign ttl)
+            query += f"&ttl={ttl}"
         return call(self.master_address, f"/dir/assign?{query}", timeout=30)
 
     def _lookup_url(self, fid: str) -> str:
@@ -256,7 +260,7 @@ class FilerServer:
                 "md5": entry.attr.md5}
 
     def _upload_blob(self, piece: bytes, replication: str = "",
-                     collection: str = "") -> FileChunk:
+                     collection: str = "", ttl: str = "") -> FileChunk:
         """Assign a fid and upload one blob to the volume cluster; with
         -encryptVolumeData the volume only ever sees AES-GCM ciphertext
         and the per-chunk key rides the chunk record (fs.encrypt,
@@ -268,7 +272,8 @@ class FilerServer:
 
             key = gen_cipher_key()
             payload = encrypt(piece, key)
-        assign = self._assign(replication=replication, collection=collection)
+        assign = self._assign(replication=replication,
+                              collection=collection, ttl=ttl)
         fid, url = assign["fid"], assign["url"]
         headers = {"Content-Type": "application/octet-stream"}
         if assign.get("auth"):
@@ -299,10 +304,27 @@ class FilerServer:
             raise RpcError("file name too long", 400)
         now = time.time()
         md5 = hashlib.md5(body).hexdigest()
+        ttl_sec = 0
+        rule_ttl = rule.ttl
+        if rule_ttl:
+            from ..storage.ttl import TTL
+
+            try:
+                ttl_sec = TTL.parse(rule_ttl).minutes() * 60
+            except ValueError:
+                # a malformed rule must fail the SAME way for inline and
+                # chunked writes: drop it everywhere, don't ship the raw
+                # string to /dir/assign where parsing would 500 — but
+                # say so, or 'temporary' data quietly becomes permanent
+                from ..util import glog
+
+                glog.warningf("ignoring malformed ttl %r on rule %s",
+                              rule_ttl, rule.location_prefix)
+                ttl_sec, rule_ttl = 0, ""
         entry = Entry(
             full_path=path,
             attr=Attr(mtime=now, crtime=now, mime=mime, md5=md5,
-                      file_size=len(body)),
+                      file_size=len(body), ttl_sec=ttl_sec),
             extended=extended or {})
         if len(body) <= INLINE_LIMIT:
             entry.content = body
@@ -318,7 +340,7 @@ class FilerServer:
                 try:
                     piece = body[off:off + self.chunk_size]
                     chunk = self._upload_blob(piece, rule.replication,
-                                              rule.collection)
+                                              rule.collection, rule_ttl)
                 except Exception:
                     failed.set()
                     raise
@@ -340,7 +362,7 @@ class FilerServer:
                     entry.chunks = list(pool.map(upload, offsets))
             entry.chunks = maybe_manifestize(
                 lambda blob: self._upload_blob(blob, rule.replication,
-                                               rule.collection),
+                                               rule.collection, rule_ttl),
                 entry.chunks, self.manifest_batch)
         self.filer.create_entry(entry)
         return entry

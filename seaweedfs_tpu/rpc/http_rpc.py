@@ -327,30 +327,61 @@ class _ConnPool:
     (rpc/grpc_client_server.go:27-41).  Bounded idle list per address;
     borrowed connections that error are closed, not returned."""
 
-    def __init__(self, max_idle_per_addr: int = 16):
+    def __init__(self, max_idle_per_addr: int = 16,
+                 idle_ttl: float = 30.0):
         self._lock = threading.Lock()
-        self._idle: dict[str, list] = {}
+        self._idle: dict[str, list] = {}  # addr -> [(conn, stored_at)]
         self.max_idle = max_idle_per_addr
+        self.idle_ttl = idle_ttl
+
+    @staticmethod
+    def _dropped(conn) -> bool:
+        """A healthy idle keep-alive socket has nothing to read; pending
+        readability means the server closed it (FIN queued) or sent
+        stray bytes — reusing it would fail mid-request, which for a
+        non-idempotent RPC cannot be retried.  This also protects
+        against the address being REBOUND by a different server."""
+        sock = conn.sock
+        if sock is None:
+            return True
+        try:
+            # non-blocking MSG_PEEK instead of select(): select raises
+            # ValueError past FD_SETSIZE (1024 fds).  The socket must be
+            # put in true non-blocking mode — in timeout mode CPython
+            # waits for readability BEFORE recv, so MSG_DONTWAIT alone
+            # would still block for the full socket timeout
+            sock.setblocking(False)
+            sock.recv(1, socket.MSG_PEEK)
+        except (BlockingIOError, InterruptedError):
+            return False  # nothing queued: healthy idle keep-alive
+        except OSError:
+            return True
+        return True  # EOF (b"") or stray queued bytes
 
     def get(self, addr: str, timeout: float):
-        with self._lock:
-            idle = self._idle.get(addr)
-            conn = idle.pop() if idle else None
-        if conn is None:
-            host, _, port = addr.partition(":")
-            conn = _NoDelayConnection(
-                host, int(port) if port else 80, timeout=timeout)
-        else:
+        now = time.monotonic()
+        while True:
+            with self._lock:
+                idle = self._idle.get(addr)
+                item = idle.pop() if idle else None
+            if item is None:
+                host, _, port = addr.partition(":")
+                return _NoDelayConnection(
+                    host, int(port) if port else 80, timeout=timeout)
+            conn, stored_at = item
+            if now - stored_at > self.idle_ttl or self._dropped(conn):
+                conn.close()
+                continue
             conn.timeout = timeout
             if conn.sock is not None:
                 conn.sock.settimeout(timeout)
-        return conn
+            return conn
 
     def put(self, addr: str, conn):
         with self._lock:
             idle = self._idle.setdefault(addr, [])
             if len(idle) < self.max_idle:
-                idle.append(conn)
+                idle.append((conn, time.monotonic()))
                 return
         conn.close()
 

@@ -291,3 +291,69 @@ class TestFilerServerE2E:
         assert any(e["new_entry"]
                    and e["new_entry"]["full_path"] == "/sub/f.txt"
                    for e in events)
+
+
+class TestPathTtlRules:
+    """Per-path TTL rules (fs.configure -ttl): chunks land on TTL volume
+    layouts, the entry records ttl_sec, and expired entries vanish from
+    reads and listings (entry.go IsExpired semantics)."""
+
+    def test_ttl_rule_flows_to_assign_and_entry(self, tmp_path):
+        from seaweedfs_tpu.filer.filer_conf import FilerConf, PathConf
+        from seaweedfs_tpu.filer.server import FilerServer
+        from seaweedfs_tpu.master.server import MasterServer
+        from seaweedfs_tpu.rpc.http_rpc import call
+        from seaweedfs_tpu.volume_server.server import VolumeServer
+
+        master = MasterServer(port=0, pulse_seconds=0.2)
+        master.start()
+        d = tmp_path / "v"
+        d.mkdir()
+        vs = VolumeServer([str(d)], master.address, port=0,
+                          pulse_seconds=0.2)
+        vs.start()
+        vs.heartbeat_once()
+        filer = FilerServer(master.address, port=0, chunk_size=1024)
+        filer.start()
+        try:
+            conf = FilerConf()
+            conf.add(PathConf(location_prefix="/cache/", ttl="3m"))
+            conf.save(filer.filer)
+            filer._conf_cache = (0.0, conf)
+            entry = filer.save_bytes("/cache/x.bin", b"z" * 4000)
+            assert entry.attr.ttl_sec == 180
+            # the chunk volumes are TTL layouts on the master
+            vid = int(entry.chunks[0].fid.split(",")[0])
+            status = call(master.address, "/dir/status")
+            vol = next(v for dc in status["datacenters"]
+                       for r in dc["racks"] for n in r["nodes"]
+                       for v in n["volume_list"] if v["id"] == vid)
+            assert vol.get("ttl") not in (0, "", None)
+        finally:
+            filer.stop()
+            vs.stop()
+            master.stop()
+
+    def test_expired_entry_vanishes(self, tmp_path):
+        import time as _t
+
+        from seaweedfs_tpu.filer.filer import Filer
+        from seaweedfs_tpu.filer.entry import Attr, Entry
+        from seaweedfs_tpu.filer.filer_store import NotFoundError
+
+        f = Filer()
+        now = _t.time()
+        f.create_entry(Entry(
+            full_path="/t/old.bin",
+            attr=Attr(mtime=now - 100, crtime=now - 100, ttl_sec=10,
+                      file_size=1),
+            content=b"x"))
+        f.create_entry(Entry(
+            full_path="/t/fresh.bin",
+            attr=Attr(mtime=now, crtime=now, ttl_sec=3600, file_size=1),
+            content=b"y"))
+        names = [e.name for e in f.list_directory("/t")]
+        assert names == ["fresh.bin"]
+        with pytest.raises(NotFoundError):
+            f.find_entry("/t/old.bin")
+        assert f.find_entry("/t/fresh.bin").content == b"y"
