@@ -17,6 +17,11 @@ from .sink import ReplicationSink
 from .source import FilerSource
 
 
+def _event_path(event: dict) -> str:
+    return ((event.get("new_entry") or event.get("old_entry") or {})
+            .get("full_path", ""))
+
+
 def _is_dir(entry: Optional[dict]) -> bool:
     if not entry:
         return False
@@ -86,22 +91,96 @@ class Replicator:
             return True
         return False
 
-    def run_once(self, since_ns: int = 0) -> tuple[int, int]:
+    def run_once(self, since_ns: int = 0,
+                 concurrency: int = 1) -> tuple[int, int]:
         """Poll the source feed once, apply everything; returns
         (events applied, new cursor).  On a sink failure the cursor stops
         *before* the failed event so the next poll retries it — a
         persisted cursor must never skip unreplicated data (the reference
-        retries failed events instead of advancing)."""
-        applied, cursor = 0, since_ns
-        for event in self.source.subscribe(since_ns):
+        retries failed events instead of advancing).
+
+        With concurrency > 1, events partition into lanes by path hash
+        (filer_sync_jobs.go): per-path ordering is preserved inside a
+        lane while lanes apply in parallel.  After a partial failure the
+        cursor rolls back to just before the earliest failed event;
+        later events that already succeeded re-apply idempotently."""
+        if concurrency <= 1:
+            applied, cursor = 0, since_ns
+            for event in self.source.subscribe(since_ns):
+                try:
+                    if self.replicate(event):
+                        applied += 1
+                except Exception as e:
+                    glog.errorf("replicate %s: %s (will retry)",
+                                _event_path(event), e)
+                    return applied, cursor
+                cursor = max(cursor, event["ts_ns"])
+            return applied, cursor
+
+        from concurrent.futures import ThreadPoolExecutor
+
+        events = list(self.source.subscribe(since_ns))
+        if not events:
+            return 0, since_ns
+        applied = 0
+
+        def run_lane(lane_events: list[dict]) -> tuple[int, int]:
+            """(applied, ts of first failure or 0); lane stays serial."""
+            n = 0
+            for event in lane_events:
+                try:
+                    if self.replicate(event):
+                        n += 1
+                except Exception as e:
+                    glog.errorf("replicate %s: %s (will retry)",
+                                _event_path(event), e)
+                    return n, event["ts_ns"]
+            return n, 0
+
+        def flush(batch: list[dict]) -> int:
+            """Apply a batch of plain-FILE events in parallel lanes;
+            returns ts of the earliest failure or 0."""
+            nonlocal applied
+            if not batch:
+                return 0
+            lanes: dict[int, list[dict]] = {}
+            for event in batch:
+                lanes.setdefault(
+                    hash(_event_path(event)) % concurrency,
+                    []).append(event)
+            with ThreadPoolExecutor(max_workers=concurrency) as pool:
+                results = list(pool.map(run_lane, lanes.values()))
+            applied += sum(n for n, _ in results)
+            fails = [ts for _, ts in results if ts]
+            return min(fails) if fails else 0
+
+        def is_barrier(event: dict) -> bool:
+            """Renames span TWO paths and directory events order against
+            their whole subtree (recursive deletes) — neither can fan
+            out by single-path hash; they serialize at batch edges."""
+            old_e, new_e = event.get("old_entry"), event.get("new_entry")
+            if old_e and new_e and \
+                    old_e.get("full_path") != new_e.get("full_path"):
+                return True
+            return _is_dir(new_e or old_e)
+
+        batch: list[dict] = []
+        for event in events:
+            if not is_barrier(event):
+                batch.append(event)
+                continue
+            fail_ts = flush(batch)
+            batch = []
+            if fail_ts:
+                return applied, fail_ts - 1
             try:
                 if self.replicate(event):
                     applied += 1
             except Exception as e:
                 glog.errorf("replicate %s: %s (will retry)",
-                            (event.get("new_entry")
-                             or event.get("old_entry")
-                             or {}).get("full_path"), e)
-                return applied, cursor
-            cursor = max(cursor, event["ts_ns"])
-        return applied, cursor
+                            _event_path(event), e)
+                return applied, event["ts_ns"] - 1
+        fail_ts = flush(batch)
+        if fail_ts:
+            return applied, fail_ts - 1
+        return applied, max(e["ts_ns"] for e in events)

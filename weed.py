@@ -622,7 +622,8 @@ def cmd_filer_sync(args):
     while True:
         moved = 0
         for name, rep in reps:
-            applied, cursor = rep.run_once(offsets.get(name, 0))
+            applied, cursor = rep.run_once(offsets.get(name, 0),
+                                           concurrency=args.concurrency)
             if cursor != offsets.get(name, 0):
                 offsets[name] = cursor
                 _save_offsets(state, offsets)
@@ -1169,6 +1170,8 @@ def main(argv=None):
     p.add_argument("-isActivePassive", action="store_true",
                    help="one-way a->b only")
     p.add_argument("-state", default="", help="offset state file")
+    p.add_argument("-concurrency", type=int, default=1,
+                   help="parallel sync lanes partitioned by path hash")
     p.add_argument("-interval", type=float, default=2.0)
     p.add_argument("-once", action="store_true",
                    help="exit when caught up (for scripting/tests)")
