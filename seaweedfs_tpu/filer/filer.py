@@ -263,6 +263,7 @@ class Filer:
 
     # -- CRUD ----------------------------------------------------------------
     def create_entry(self, entry: Entry):
+        pending: list[FileChunk] = []
         with self.lock:
             self._ensure_parents(entry.parent)
             old = self._find_or_none(entry.full_path)
@@ -277,13 +278,12 @@ class Filer:
                 # overwrote a hardlink pointer: drop its reference (even
                 # when both point at the same record — the new entry holds
                 # its own freshly-counted reference from create_hard_link)
-                self._release_file(old)
-            elif self.on_delete_chunks and old.chunks:
+                self._release_file(old, pending)
+            elif old.chunks:
                 # overwritten file: reclaim chunks no longer referenced
                 kept = {c.fid for c in entry.chunks}
-                orphaned = [c for c in old.chunks if c.fid not in kept]
-                if orphaned:
-                    self.on_delete_chunks(orphaned)
+                pending += [c for c in old.chunks if c.fid not in kept]
+        self._reclaim(pending)
 
     def _ensure_parents(self, dir_path: str):
         if dir_path in ("", "/"):
@@ -315,7 +315,10 @@ class Filer:
             # chunks expires wholesale on the cluster side, so no
             # per-chunk delete RPCs on the read path — and re-verify
             # under the lock so a concurrent re-create of the same path
-            # is never deleted
+            # is never deleted.  Any release RPCs (hardlink refcount
+            # drop) run AFTER the lock: a slow volume server must not
+            # stall every metadata operation behind a read
+            pending: list[FileChunk] = []
             with self.lock:
                 current = self._find_or_none(entry.full_path)
                 if current is not None and self._expired(current):
@@ -323,11 +326,12 @@ class Filer:
                         # hardlinked entries must still release their
                         # refcount; plain files skip per-chunk delete
                         # RPCs (the TTL volume expires them wholesale)
-                        self.delete_entry(
+                        pending = self._delete_entry_locked(
                             entry.full_path,
                             delete_chunks=bool(current.hard_link_id))
                     except (NotFoundError, ValueError):
                         pass
+            self._reclaim(pending)
             raise NotFoundError(path)
         return entry
 
@@ -358,24 +362,44 @@ class Filer:
         """filer_delete_entry.go semantics: directories need recursive=True
         unless empty; file deletion reclaims chunks unless the caller opts
         out (the HTTP skipChunkDelete param, used by metadata-only
-        restores)."""
-        path = self._norm(path)
+        restores).  Chunk-delete RPCs are issued after the filer lock is
+        released — a slow volume server must not stall metadata ops."""
         with self.lock:
-            entry = self.store.find_entry(path)
-            if entry.is_directory:
-                children = self.store.list_directory(path, limit=1)
-                if children and not recursive:
-                    raise ValueError(f"{path} is not empty")
-                self._delete_recursive(path, delete_chunks)
-                self.store.delete_entry(path)
-            else:
-                self.store.delete_entry(path)
-                if delete_chunks:
-                    self._release_file(entry)
-            self._notify(entry.parent, entry, None)
+            pending = self._delete_entry_locked(path, recursive,
+                                                delete_chunks)
+        self._reclaim(pending)
 
-    def _release_file(self, entry: Entry):
-        """Reclaim a deleted file's chunks, honoring hardlink refcounts."""
+    def _delete_entry_locked(self, path: str, recursive: bool = False,
+                             delete_chunks: bool = True
+                             ) -> list[FileChunk]:
+        """Metadata-side delete under self.lock; returns the chunks to
+        reclaim once the caller has dropped the lock."""
+        path = self._norm(path)
+        pending: list[FileChunk] = []
+        entry = self.store.find_entry(path)
+        if entry.is_directory:
+            children = self.store.list_directory(path, limit=1)
+            if children and not recursive:
+                raise ValueError(f"{path} is not empty")
+            self._delete_recursive(path, delete_chunks, pending)
+            self.store.delete_entry(path)
+        else:
+            self.store.delete_entry(path)
+            if delete_chunks:
+                self._release_file(entry, pending)
+        self._notify(entry.parent, entry, None)
+        return pending
+
+    def _reclaim(self, chunks: list[FileChunk]):
+        """Fire the chunk-delete callback (volume-server RPCs) — call
+        with the filer lock RELEASED."""
+        if chunks and self.on_delete_chunks:
+            self.on_delete_chunks(chunks)
+
+    def _release_file(self, entry: Entry, pending: list[FileChunk]):
+        """Collect a deleted file's reclaimable chunks into `pending`,
+        honoring hardlink refcounts.  Store mutations happen here (under
+        the caller's lock); the delete RPCs happen later via _reclaim."""
         if entry.hard_link_id:
             try:
                 record = self._read_hardlink(entry.hard_link_id)
@@ -390,25 +414,25 @@ class Filer:
             self.store.delete_entry(record_path)
             if record_entry is not None:
                 self._notify(HARDLINK_DIR, record_entry, None)
-            if self.on_delete_chunks and record["chunks"]:
-                self.on_delete_chunks(
-                    [FileChunk.from_dict(c) for c in record["chunks"]])
-        elif self.on_delete_chunks and entry.chunks:
-            self.on_delete_chunks(entry.chunks)
+            pending += [FileChunk.from_dict(c) for c in record["chunks"]]
+        else:
+            pending += entry.chunks
 
-    def _delete_recursive(self, dir_path: str, delete_chunks: bool = True):
+    def _delete_recursive(self, dir_path: str, delete_chunks: bool,
+                          pending: list[FileChunk]):
         while True:
             children = self.store.list_directory(dir_path, limit=1024)
             if not children:
                 break
             for child in children:
                 if child.is_directory:
-                    self._delete_recursive(child.full_path, delete_chunks)
+                    self._delete_recursive(child.full_path, delete_chunks,
+                                           pending)
                     self.store.delete_entry(child.full_path)
                 else:
                     self.store.delete_entry(child.full_path)
                     if delete_chunks:
-                        self._release_file(child)
+                        self._release_file(child, pending)
 
     def list_directory(self, path: str, start_file: str = "",
                        limit: int = 1024, prefix: str = "",
@@ -439,28 +463,32 @@ class Filer:
         """Atomic single-entry rename + recursive subtree move
         (filer_rename.go).  The change event carries both the old and new
         entry so feed replicas delete the old path (meta_replay.go)."""
-        old_path, new_path = self._norm(old_path), self._norm(new_path)
+        pending: list[FileChunk] = []
         with self.lock:
-            entry = self.store.find_entry(old_path)
-            dst = self._find_or_none(new_path)
-            if dst is not None:
-                if dst.is_directory and not entry.is_directory:
-                    raise ValueError(f"{new_path} is a directory")
-                if dst.hard_link_id:
-                    self._release_file(dst)  # overwrite drops one reference
-                elif self.on_delete_chunks and dst.chunks:
-                    self.on_delete_chunks(dst.chunks)
-            self._ensure_parents(new_path.rsplit("/", 1)[0] or "/")
-            if entry.is_directory:
-                for child in self.store.list_directory(old_path,
-                                                       limit=100000):
-                    self.rename(child.full_path,
-                                new_path + "/" + child.name)
-            old_snapshot = Entry.from_dict(entry.to_dict())
-            entry.full_path = new_path
-            self.store.insert_entry(entry)
-            self.store.delete_entry(old_path)
-            self._notify(entry.parent, old_snapshot, entry)
+            self._rename_locked(self._norm(old_path), self._norm(new_path),
+                                pending)
+        self._reclaim(pending)
+
+    def _rename_locked(self, old_path: str, new_path: str,
+                       pending: list[FileChunk]):
+        entry = self.store.find_entry(old_path)
+        dst = self._find_or_none(new_path)
+        if dst is not None:
+            if dst.is_directory and not entry.is_directory:
+                raise ValueError(f"{new_path} is a directory")
+            # overwrite drops one reference; RPCs deferred past the lock
+            self._release_file(dst, pending)
+        self._ensure_parents(new_path.rsplit("/", 1)[0] or "/")
+        if entry.is_directory:
+            for child in self.store.list_directory(old_path,
+                                                   limit=100000):
+                self._rename_locked(child.full_path,
+                                    new_path + "/" + child.name, pending)
+        old_snapshot = Entry.from_dict(entry.to_dict())
+        entry.full_path = new_path
+        self.store.insert_entry(entry)
+        self.store.delete_entry(old_path)
+        self._notify(entry.parent, old_snapshot, entry)
 
     @staticmethod
     def _norm(path: str) -> str:

@@ -353,13 +353,30 @@ class FilerServer:
                 # upload chunks concurrently (the reference fans chunk
                 # uploads out per goroutine, _write_upload.go): a large
                 # body otherwise pays one serial assign+POST round trip
-                # per chunk.  The first failure aborts the fan-out; the
-                # few in-flight orphans are reclaimed by vacuum
+                # per chunk.  On failure the fan-out aborts and the
+                # already-uploaded siblings are best-effort DELETEd:
+                # vacuum only compacts deleted needles, so a
+                # never-referenced upload would otherwise leak until its
+                # volume is removed
                 from concurrent.futures import ThreadPoolExecutor
 
                 workers = min(8, len(offsets))
                 with ThreadPoolExecutor(max_workers=workers) as pool:
-                    entry.chunks = list(pool.map(upload, offsets))
+                    futures = [pool.submit(upload, off) for off in offsets]
+                    uploaded, first_err = [], None
+                    for f in futures:
+                        try:
+                            uploaded.append(f.result())
+                        except Exception as e:  # noqa: BLE001 — re-raised
+                            if first_err is None:
+                                first_err = e
+                if first_err is not None:
+                    try:
+                        self._delete_chunks(uploaded)
+                    except Exception:  # noqa: BLE001 — reclamation only
+                        pass
+                    raise first_err
+                entry.chunks = uploaded
             entry.chunks = maybe_manifestize(
                 lambda blob: self._upload_blob(blob, rule.replication,
                                                rule.collection, rule_ttl),

@@ -21,6 +21,7 @@ from typing import Optional
 
 ALGORITHM = "AWS4-HMAC-SHA256"
 CHUNK_ALGORITHM = "AWS4-HMAC-SHA256-PAYLOAD"
+TRAILER_ALGORITHM = "AWS4-HMAC-SHA256-TRAILER"
 # payload sentinels for sigv4 streaming uploads (auth_signature_v4.go:50-53;
 # the -TRAILER forms are sent by SDKs with flexible checksums enabled)
 STREAMING_PAYLOAD = "STREAMING-AWS4-HMAC-SHA256-PAYLOAD"
@@ -123,20 +124,33 @@ class IdentityAccessManagement:
         sentinel = headers.get("X-Amz-Content-Sha256", "")
         if not self.enabled:
             # no identities configured: SDKs still send aws-chunked framed
-            # bodies — strip the framing (unverifiable without a secret)
+            # bodies — strip the framing (unverifiable without a secret);
+            # a declared-but-missing trailer is still a truncation
             if sentinel in ALL_STREAMING:
                 body = self._check_decoded_length(
-                    headers, self._decode_streaming_body(body))
+                    headers, self._decode_streaming_body(
+                        body,
+                        declared_trailer=headers.get("X-Amz-Trailer", "")))
             return None, body
         auth_header = headers.get("Authorization", "")
         if not auth_header.startswith(ALGORITHM):
-            # presigned-v4 / sigv2 auth: chunk signatures need the
-            # header-auth seed chain, but SDK flexible-checksum mode can
-            # still frame the body — strip the framing here too
+            # signed streaming requires header auth (AWS rejects it on
+            # presigned/sigv2 requests): without the seed-signature chain
+            # the chunk signatures are unverifiable, and silently
+            # stripping them would advertise integrity we never checked
+            if sentinel in SIGNED_STREAMING:
+                raise AuthError(
+                    "AccessDenied",
+                    "signed streaming uploads require AWS4-HMAC-SHA256 "
+                    "header authentication", 403)
+            # presigned-v4 / sigv2 auth with the UNSIGNED trailer form:
+            # SDK flexible-checksum mode frames the body — strip it
             identity = self.verify(method, path, query, headers, body)
             if sentinel in ALL_STREAMING:
                 body = self._check_decoded_length(
-                    headers, self._decode_streaming_body(body))
+                    headers, self._decode_streaming_body(
+                        body,
+                        declared_trailer=headers.get("X-Amz-Trailer", "")))
             return identity, body
         identity, seed, fields = self._verify_header(
             method, path, query, headers, body, auth_header)
@@ -150,9 +164,11 @@ class IdentityAccessManagement:
                                     service)
             decoded = self._decode_streaming_body(
                 body, key, seed, headers.get("X-Amz-Date", ""), scope,
-                allow_unsigned_final=(sentinel == STREAMING_PAYLOAD_TRAILER))
+                allow_unsigned_final=(sentinel == STREAMING_PAYLOAD_TRAILER),
+                declared_trailer=headers.get("X-Amz-Trailer", ""))
         else:  # STREAMING-UNSIGNED-PAYLOAD-TRAILER: frames carry no sigs
-            decoded = self._decode_streaming_body(body)
+            decoded = self._decode_streaming_body(
+                body, declared_trailer=headers.get("X-Amz-Trailer", ""))
         return identity, self._check_decoded_length(headers, decoded)
 
     @staticmethod
@@ -179,18 +195,22 @@ class IdentityAccessManagement:
     def _decode_streaming_body(body: bytes, signing_key: bytes = None,
                                seed_signature: str = "", amz_date: str = "",
                                scope: str = "",
-                               allow_unsigned_final: bool = False) -> bytes:
+                               allow_unsigned_final: bool = False,
+                               declared_trailer: str = "") -> bytes:
         """Decode `<hex-size>[;chunk-signature=<sig>]\\r\\n<data>\\r\\n`
         frames.  With a signing_key, each chunk signature is verified
         against the running chain (sigv4-streaming spec;
-        chunked_reader_v4.go getChunkSignature); without one (unsigned
-        trailer or auth disabled) only the framing is decoded.  Trailer
-        headers after the final zero-length frame are ignored."""
+        chunked_reader_v4.go getChunkSignature).  Trailer headers after
+        the final zero-length frame are parsed: every name announced in
+        x-amz-trailer must be present, and for the signed -TRAILER form
+        the x-amz-trailer-signature is verified over the canonical
+        trailer block (AWS4-HMAC-SHA256-TRAILER string-to-sign)."""
         verify_sigs = signing_key is not None
         out = bytearray()
         prev_sig = seed_signature
         pos = 0
         saw_final = False
+        trailer_raw = b""
         while pos < len(body):
             eol = body.find(b"\r\n", pos)
             if eol < 0:
@@ -230,11 +250,62 @@ class IdentityAccessManagement:
                 prev_sig = expected
             if size == 0:
                 saw_final = True
+                trailer_raw = body[pos:]
                 break
             out += data
         if not saw_final:
             raise AuthError("IncompleteBody", "missing final chunk", 400)
+        IdentityAccessManagement._check_trailer(
+            trailer_raw, declared_trailer,
+            signing_key if (verify_sigs and allow_unsigned_final) else None,
+            prev_sig, amz_date, scope)
         return bytes(out)
+
+    @staticmethod
+    def _check_trailer(trailer_raw: bytes, declared: str,
+                       signing_key, prev_sig: str, amz_date: str,
+                       scope: str) -> None:
+        """Validate the trailing-header block of an aws-chunked body.
+
+        Every name announced in x-amz-trailer must appear (a dropped
+        trailer checksum is a truncation, not a no-op), and when
+        `signing_key` is set (the STREAMING-...-PAYLOAD-TRAILER form) the
+        x-amz-trailer-signature must verify over the canonical
+        `name:value\\n` block chained onto the last chunk signature."""
+        entries: dict[str, str] = {}
+        trailer_sig = ""
+        canonical = []
+        for line in trailer_raw.split(b"\r\n"):
+            if not line:
+                continue
+            name, sep, value = line.decode("utf8", "replace").partition(":")
+            if not sep:
+                raise AuthError("IncompleteBody",
+                                "malformed trailer header", 400)
+            name = name.strip().lower()
+            value = value.strip()
+            if name == "x-amz-trailer-signature":
+                trailer_sig = value
+                continue
+            entries[name] = value
+            canonical.append(f"{name}:{value}\n")
+        for want in declared.split(","):
+            want = want.strip().lower()
+            if want and want not in entries:
+                raise AuthError("IncompleteBody",
+                                f"missing declared trailer {want}", 400)
+        if signing_key is not None:
+            if not trailer_sig:
+                raise AuthError("SignatureDoesNotMatch",
+                                "missing x-amz-trailer-signature", 403)
+            string_to_sign = "\n".join([
+                TRAILER_ALGORITHM, amz_date, scope, prev_sig,
+                hashlib.sha256("".join(canonical).encode()).hexdigest()])
+            expected = hmac.new(signing_key, string_to_sign.encode(),
+                                hashlib.sha256).hexdigest()
+            if not hmac.compare_digest(expected, trailer_sig):
+                raise AuthError("SignatureDoesNotMatch",
+                                "trailer signature mismatch", 403)
 
     def _parse_auth_header(self, auth_header: str) -> dict:
         # AWS4-HMAC-SHA256 Credential=AK/date/region/s3/aws4_request,

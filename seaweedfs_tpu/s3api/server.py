@@ -276,6 +276,12 @@ class S3ApiServer:
             return Response(_xml("RequestPaymentConfiguration",
                                  {"Payer": "BucketOwner"}), 200,
                             "application/xml")
+        if any(k in q for k in self.SUBRESOURCES):
+            # unhandled method+subresource combo (e.g. PUT ?versioning):
+            # never fall through to the plain bucket handlers, which would
+            # create/delete the bucket itself under a config request
+            return _error_xml("NotImplemented",
+                              "subresource not implemented", 501)
         return None
 
     def _get_bucket_acl(self, bucket: str):

@@ -293,10 +293,16 @@ def fs_configure(env: CommandEnv, location_prefix: str,
 
     filer = find_filer(env)
     conf = _get_json_config(filer, FILER_CONF_PATH)
+    existing = next((loc for loc in conf.get("locations", [])
+                     if loc.get("location_prefix") == location_prefix), {})
     locations = [loc for loc in conf.get("locations", [])
                  if loc.get("location_prefix") != location_prefix]
     if not delete:
-        rule: dict = {"location_prefix": location_prefix}
+        # merge into the existing rule for this prefix: an unrelated
+        # ttl/replication edit must not drop quota fields set by
+        # s3.bucket.quota (or any other keys) on the same prefix
+        rule: dict = dict(existing)
+        rule["location_prefix"] = location_prefix
         if collection:
             rule["collection"] = collection
         if replication:

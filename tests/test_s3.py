@@ -671,6 +671,100 @@ class TestStreamingSigV4:
         ident, body = iam.verify_and_decode("PUT", "/b/k", {}, send, frames)
         assert ident.name == "a" and body == payload
 
+    def test_signed_streaming_requires_header_auth(self):
+        """SIGNED streaming sentinels on presigned/sigv2 requests must be
+        rejected: the chunk signatures are unverifiable without the
+        header-auth seed chain (round-3 advisor finding)."""
+        from seaweedfs_tpu.s3api.auth import AuthError as AErr
+
+        iam = IdentityAccessManagement([
+            Identity(name="a", access_key="AK", secret_key="SK")])
+        for sentinel in ("STREAMING-AWS4-HMAC-SHA256-PAYLOAD",
+                         "STREAMING-AWS4-HMAC-SHA256-PAYLOAD-TRAILER"):
+            headers = {"X-Amz-Content-Sha256": sentinel,
+                       "X-Amz-Decoded-Content-Length": "4"}
+            query = {"X-Amz-Algorithm": "AWS4-HMAC-SHA256"}
+            with pytest.raises(AErr) as ei:
+                iam.verify_and_decode("PUT", "/b/k", query, headers,
+                                      b"4\r\nabcd\r\n0\r\n\r\n")
+            assert ei.value.status == 403, sentinel
+
+    def _signed_trailer_put(self, trailer_sig_tamper=None,
+                            drop_trailer=False):
+        """Build and verify a STREAMING-...-PAYLOAD-TRAILER request."""
+        iam = IdentityAccessManagement([
+            Identity(name="a", access_key="AK", secret_key="SK")])
+        payload = b"signed trailer payload"
+        now = time.gmtime()
+        amz_date = time.strftime("%Y%m%dT%H%M%SZ", now)
+        datestamp = time.strftime("%Y%m%d", now)
+        scope = f"{datestamp}/us-east-1/s3/aws4_request"
+        ph = "STREAMING-AWS4-HMAC-SHA256-PAYLOAD-TRAILER"
+        headers = {"host": "h", "x-amz-date": amz_date,
+                   "x-amz-content-sha256": ph,
+                   "x-amz-trailer": "x-amz-checksum-crc32c",
+                   "x-amz-decoded-content-length": str(len(payload))}
+        signed = sorted(headers)
+        canonical = "\n".join([
+            "PUT", "/b/k", "",
+            "".join(f"{h}:{headers[h]}\n" for h in signed),
+            ";".join(signed), ph])
+        sts = "\n".join(["AWS4-HMAC-SHA256", amz_date, scope,
+                         hashlib.sha256(canonical.encode()).hexdigest()])
+        k = _sign(_sign(_sign(_sign(b"AWS4SK", datestamp), "us-east-1"),
+                        "s3"), "aws4_request")
+        seed = hmac.new(k, sts.encode(), hashlib.sha256).hexdigest()
+        frames = _streaming_frames(payload, 1024, "SK", seed, amz_date,
+                                   scope)
+        # rebuild with an UNSIGNED final frame + signed trailer block;
+        # the trailer signature chains off the LAST DATA chunk signature
+        # (the unsigned final zero frame does not advance the chain)
+        idx = frames.rfind(b"0;chunk-signature=")
+        didx = frames.rfind(b"chunk-signature=", 0, idx)
+        prev_sig = frames[didx + len(b"chunk-signature="):
+                          frames.find(b"\r\n", didx)].decode()
+        trailer_line = "x-amz-checksum-crc32c:AAAAAA=="
+        trailer_sts = "\n".join([
+            "AWS4-HMAC-SHA256-TRAILER", amz_date, scope, prev_sig,
+            hashlib.sha256((trailer_line + "\n").encode()).hexdigest()])
+        tsig = hmac.new(k, trailer_sts.encode(), hashlib.sha256).hexdigest()
+        if trailer_sig_tamper:
+            tsig = trailer_sig_tamper(tsig)
+        trailer = b"" if drop_trailer else (
+            trailer_line.encode() + b"\r\n"
+            + f"x-amz-trailer-signature:{tsig}\r\n\r\n".encode())
+        frames = frames[:idx] + b"0\r\n" + trailer
+        send = dict(headers)
+        send["X-Amz-Date"] = amz_date
+        send["X-Amz-Content-Sha256"] = ph
+        send["X-Amz-Trailer"] = headers["x-amz-trailer"]
+        send["X-Amz-Decoded-Content-Length"] = str(len(payload))
+        send["Authorization"] = (
+            f"AWS4-HMAC-SHA256 Credential=AK/{scope}, "
+            f"SignedHeaders={';'.join(signed)}, Signature={seed}")
+        return iam.verify_and_decode("PUT", "/b/k", {}, send,
+                                     frames), payload
+
+    def test_signed_trailer_verified(self):
+        (ident, body), payload = self._signed_trailer_put()
+        assert ident.name == "a" and body == payload
+
+    def test_tampered_trailer_signature_rejected(self):
+        from seaweedfs_tpu.s3api.auth import AuthError as AErr
+
+        with pytest.raises(AErr) as ei:
+            self._signed_trailer_put(
+                trailer_sig_tamper=lambda s: s[:-1] + ("0" if s[-1] != "0"
+                                                       else "1"))
+        assert ei.value.code == "SignatureDoesNotMatch"
+
+    def test_missing_declared_trailer_rejected(self):
+        from seaweedfs_tpu.s3api.auth import AuthError as AErr
+
+        with pytest.raises(AErr) as ei:
+            self._signed_trailer_put(drop_trailer=True)
+        assert ei.value.status in (400, 403)
+
 
 class TestBucketSubresources:
     """Canned/conf-backed answers for SDK startup probes
@@ -707,6 +801,26 @@ class TestBucketSubresources:
         assert status == 200 and b"AccessControlPolicy" in body
         status, _, _ = req(s3, "PUT", "/sr", query="acl=", body=b"<x/>")
         assert status == 501
+
+    def test_unhandled_subresource_never_touches_bucket(self, stack):
+        """PUT/DELETE with an unhandled subresource must answer 501, not
+        fall through to bucket create/delete (round-3 advisor finding)."""
+        s3 = stack
+        req(s3, "PUT", "/sr")
+        req(s3, "PUT", "/sr/keep", body=b"x")
+        status, _, _ = req(s3, "DELETE", "/sr", query="versioning=")
+        assert status == 501
+        # the bucket (and its object) must still exist
+        status, _, body = req(s3, "GET", "/sr/keep")
+        assert status == 200 and body == b"x"
+        status, _, _ = req(s3, "PUT", "/sr", query="versioning=",
+                           body=b"<x/>")
+        assert status == 501
+        status, _, _ = req(s3, "PUT", "/missing-bucket",
+                           query="object-lock=", body=b"<x/>")
+        assert status in (404, 501)  # never a silent 200 bucket-create
+        status, _, _ = req(s3, "GET", "/missing-bucket")
+        assert status == 404
 
     def test_object_probes(self, stack):
         s3 = stack

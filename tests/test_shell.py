@@ -284,6 +284,21 @@ class TestFsCommands:
         assert e.value.status == 403
         fs.fs_configure(env, "/protected/", delete=True)
 
+    def test_fs_configure_merges_existing_rule(self, with_filer):
+        """An fs.configure edit must merge into the existing rule for the
+        prefix: quota fields set by s3.bucket.quota on the same prefix
+        survive an unrelated ttl edit (round-3 advisor finding)."""
+        master, servers, env, filer = with_filer
+        fs.s3_bucket_create(env, "qb")
+        fs.s3_bucket_quota(env, "qb", "set", 50)
+        conf = fs.fs_configure(env, "/buckets/qb/", ttl="3d")
+        rules = [r for r in conf["locations"]
+                 if r["location_prefix"] == "/buckets/qb/"]
+        assert len(rules) == 1
+        assert rules[0]["ttl"] == "3d"
+        assert rules[0]["quota_mb"] == 50
+        assert fs.s3_bucket_quota(env, "qb", "get")["quota_mb"] == 50
+
 
 class TestS3Commands:
     @pytest.fixture
