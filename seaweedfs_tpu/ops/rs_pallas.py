@@ -93,106 +93,179 @@ def apply_matrix_pallas(matrix: np.ndarray, data, block: int = DEFAULT_BLOCK,
 #
 # The XLA formulation (parallel/mesh.batched_encode_step) materializes the
 # 8x bit expansion in HBM twice (parity matmul input + CRC matmul input).
-# Here one VMEM-resident expansion feeds both: each grid program computes a
-# (d, BLOCK) tile's parity AND its CRC32C segment image (the per-segment
-# raw CRC of all 14 shards), so HBM traffic stays at parity-kernel levels
-# and only (B, nseg, 14) uint32 segment images are added.  Segments combine
-# into whole-chunk CRCs with the log-tree of 32x32 advance matrices from
-# ops/crc_device.py, outside the kernel (tiny).
+# Here one VMEM-resident expansion feeds both, and the data rides the MXU
+# in WORD layout: 4 packed bytes per int32 lane.  That makes the bit
+# expansion rows (shard, byteidx, plane) = d*32 rows per W = BLOCK/4
+# lanes, so
+#
+#   * the parity matmul is (p*32, d*32) @ (d*32, W) — a full 128-row MXU
+#     tile at 4x fewer lane tiles than the byte layout, and
+#   * the CRC matmul is (d*32, W) @ (W, 32) — W/128 weight tiles against
+#     the plane-7 segment matrix restricted to word-anchor byte positions.
+#
+# Byte-position and bit-plane dependence of CRC32C folds into per-
+# (byteidx, plane) 32x32 GF(2) advance corrections applied OUTSIDE the
+# kernel on the tiny (B, nseg, 14*32)-word partials:
+#
+#   true[(s, bi, b)] = Bz^(7-b-8*bi) @ raw[(s, bi, b)]
+#
+# with Bz the one-zero-BIT CRC advance (all powers commute; verified
+# against the byte-layout segment matrices).  Segments then combine into
+# whole-chunk CRCs with the log-tree of 32x32 advance matrices from
+# ops/crc_device.py.
+#
+# Parity stays in packed int32 words end-to-end: a device-side
+# int32->uint8 bitcast is a byte-granular relayout on TPU (measured 10x
+# the kernel's own cost), while host-side numpy views of the downloaded
+# words are free.  Measured on TPU v5e: ~49 GiB/s fused vs ~58 GiB/s for
+# the parity-only kernel (the round-3 plane-partial byte-layout kernel
+# ran 26 GiB/s).
 # ---------------------------------------------------------------------------
 
+_POLY_REFLECTED = 0x82F63B78
 
-def _fused_kernel(bm_ref, w3_ref, x_ref, par_ref, crc_ref, *, d: int,
-                  p: int):
-    x = x_ref[0].astype(jnp.int32)  # (d, BLOCK)
-    block = x.shape[-1]
-    shifts = jax.lax.broadcasted_iota(jnp.int32, (1, 8, 1), 1)
-    bits = ((x[:, None, :] >> shifts) & 1).astype(jnp.int8)
-    bits = bits.reshape(d * 8, block)
-    prod = jax.lax.dot(
-        bm_ref[:], bits, preferred_element_type=jnp.int32)  # (p*8, BLOCK)
-    out_bits = (prod & 1)
-    weights = jnp.left_shift(1, shifts)  # (1, 8, 1)
-    par_ref[0] = (out_bits.reshape(p, 8, block) * weights).sum(
-        axis=1).astype(jnp.uint8)
-    # CRC via plane-partial images: one matmul of the SAME bit rows the
-    # parity used (rows (shard, plane), no re-extraction or relayout)
-    # against a widened (BLOCK, 8*32) matrix whose column group p8' holds
-    # the segment matrix restricted to plane p8'.  Row (s, p8) x group
-    # p8' is only meaningful on the diagonal p8 == p8'; the off-diagonal
-    # 7/8 of the MXU work is the price of skipping a second 14-row bit
-    # extraction, and measures ~1.6x faster end to end
-    full_bits = jnp.concatenate(
-        [bits, out_bits.astype(jnp.int8)], axis=0)  # ((d+p)*8, BLOCK)
-    y2 = jax.lax.dot(
-        full_bits, w3_ref[:],
-        preferred_element_type=jnp.int32)  # ((d+p)*8, 256)
-    # sublane-dim reshape only (Mosaic cannot split the 256 lane dim),
-    # then 8 static diagonal slices accumulate the per-plane partials
-    y3 = y2.reshape(d + p, 8, 256)
-    acc = y3[:, 0, 0:32]
-    for p8 in range(1, 8):
-        acc = acc + y3[:, p8, p8 * 32:(p8 + 1) * 32]
-    crc_bits = acc & 1  # (d+p, 32)
-    # pack bits into words in int32 (Mosaic has no unsigned reductions;
-    # bit 31 rides the sign bit with the right pattern) and bitcast out
+
+def _gf2_inv(m: np.ndarray) -> np.ndarray:
+    """Inverse of a GF(2) matrix via Gaussian elimination."""
+    n = m.shape[0]
+    a = m.astype(np.uint8).copy()
+    inv = np.eye(n, dtype=np.uint8)
+    for col in range(n):
+        piv = next(r for r in range(col, n) if a[r, col])
+        if piv != col:
+            a[[col, piv]] = a[[piv, col]]
+            inv[[col, piv]] = inv[[piv, col]]
+        for r in range(n):
+            if r != col and a[r, col]:
+                a[r] ^= a[col]
+                inv[r] ^= inv[col]
+    return inv
+
+
+@functools.lru_cache(maxsize=1)
+def _bit_advance() -> np.ndarray:
+    """Bz: 32x32 GF(2) one-zero-BIT advance of the raw CRC32C state
+    (s' = (s >> 1) ^ (POLY if s & 1)); Bz^8 equals the one-byte advance
+    crc32c._advance_one()."""
+    from . import crc32c as crc_host
+
+    def col(i):
+        s = 1 << i
+        return crc_host._bits_of((s >> 1)
+                                 ^ (_POLY_REFLECTED if s & 1 else 0))
+    return np.stack([col(i) for i in range(32)], axis=1).astype(np.uint8)
+
+
+@functools.lru_cache(maxsize=1)
+def _word_corrections() -> np.ndarray:
+    """CT (4, 8, 32, 32) int8: CT[bi, b] = (Bz^(7-b) Bz^(-8 bi))^T, the
+    row-transform turning a raw word-anchor partial into the true
+    (byteidx bi, plane b) contribution."""
+    bz = _bit_advance().astype(np.int64)
+    bzinv = _gf2_inv(_bit_advance()).astype(np.int64)
+    out = np.zeros((4, 8, 32, 32), dtype=np.int8)
+    for bi in range(4):
+        for b in range(8):
+            m = (np.linalg.matrix_power(bz, 7 - b)
+                 @ np.linalg.matrix_power(bzinv, 8 * bi)) % 2
+            out[bi, b] = m.T.astype(np.int8)
+    return out
+
+
+@functools.lru_cache(maxsize=8)
+def _anchor_matrix(block: int) -> np.ndarray:
+    """V (block//4, 32) int8: plane-7 segment-CRC images at the word
+    anchor byte positions 4w of a block-byte segment."""
+    from .crc_device import _segment_matrix
+
+    w = _segment_matrix(block)  # (8*block, 32) plane-major rows
+    return np.ascontiguousarray(w.reshape(8, block, 32)[7][::4])
+
+
+@functools.lru_cache(maxsize=4)
+def _bm_word_cached(matrix_bytes: bytes, p: int, d: int) -> np.ndarray:
+    """The (p*32, d*32) word-layout GF(2) bit matrix: block-diagonal over
+    byteidx (RS parity is per-byte, so word bit k=8*bi+b maps within its
+    own byte group)."""
+    from .rs_jax import _bit_matrix_cached
+
+    bm = _bit_matrix_cached(matrix_bytes, p, d)
+    bmr = bm.reshape(p, 8, d, 8)
+    bmw = np.zeros((p, 4, 8, d, 4, 8), np.int8)
+    for bi in range(4):
+        bmw[:, bi, :, :, bi, :] = bmr
+    return np.ascontiguousarray(bmw.reshape(p * 32, d * 32))
+
+
+def _fused_words_kernel(bmw_ref, v_ref, x_ref, par_ref, crc_ref, *,
+                        d: int, p: int):
+    xw = x_ref[0]  # (d, W) int32 packed little-endian bytes
+    w = xw.shape[-1]
+    shifts = jax.lax.broadcasted_iota(jnp.int32, (1, 32, 1), 1)
+    bits = ((xw[:, None, :] >> shifts) & 1).astype(jnp.int8)
+    bits = bits.reshape(d * 32, w)  # rows (shard, byteidx, plane)
+    prod = jax.lax.dot(bmw_ref[:], bits,
+                       preferred_element_type=jnp.int32)  # (p*32, W)
+    out_bits = prod & 1
+    # pack parity bit rows back into int32 words (wrapping shifts leave
+    # exactly the right bit pattern)
+    wts = jnp.left_shift(jnp.int32(1), shifts)
+    par_ref[0] = (out_bits.reshape(p, 32, w) * wts).sum(axis=1)
+    # raw CRC partials: one narrow matmul against the anchor matrix; the
+    # parity shards' partials follow algebraically through the same bit
+    # matrix (parity bits are GF(2)-linear in data bits per position)
+    yd = jax.lax.dot(bits, v_ref[:], preferred_element_type=jnp.int32)
+    yd8 = (yd & 1).astype(jnp.int8)  # (d*32, 32)
+    yp = jax.lax.dot(bmw_ref[:], yd8,
+                     preferred_element_type=jnp.int32)  # (p*32, 32)
+    y_all = jnp.concatenate([yd8.astype(jnp.int32), yp & 1], axis=0)
+    # pack each row's 32 bits into an int32 word (Mosaic has no unsigned
+    # reductions; bit 31 rides the sign bit with the right pattern)
     w32 = jnp.left_shift(
         jnp.int32(1), jax.lax.broadcasted_iota(jnp.int32, (1, 32), 1))
-    packed = (crc_bits * w32).sum(axis=-1)  # (d+p,) int32
-    # the CRC words ride an (8, 128) tile: TPU block shapes must be
-    # (8, 128)-aligned in their last two dims, and d+p=14 is neither —
-    # row 0 holds the real words, the rest is padding the host slices off
-    tile = jnp.pad(packed[None, :], ((0, 7), (0, 128 - (d + p))))
+    packed = (y_all * w32).sum(axis=-1)  # ((d+p)*32,) int32
+    # output tiles need (8, 128)-aligned trailing dims: (d+p)*32 = 448
+    # raw words ride row 0 of an (8, 512) tile
+    tile = jnp.pad(packed[None, :], ((0, 7), (0, 512 - (d + p) * 32)))
     crc_ref[0, 0] = jax.lax.bitcast_convert_type(tile, jnp.uint32)
 
 
 @functools.partial(
     jax.jit, static_argnames=("d", "p", "block", "interpret"))
-def _fused_encode_pallas(bit_matrix, w3, data, d: int, p: int, block: int,
-                         interpret: bool):
-    b, _, length = data.shape
-    nseg = length // block
-    kernel = functools.partial(_fused_kernel, d=d, p=p)
+def _fused_encode_words(bmw, v, words, d: int, p: int, block: int,
+                        interpret: bool):
+    b, _, lw = words.shape
+    wblk = block // 4
+    nseg = (lw * 4) // block
+    kernel = functools.partial(_fused_words_kernel, d=d, p=p)
     return pl.pallas_call(
         kernel,
         out_shape=(
-            jax.ShapeDtypeStruct((b, p, length), jnp.uint8),
-            jax.ShapeDtypeStruct((b, nseg, 8, 128), jnp.uint32),
+            jax.ShapeDtypeStruct((b, p, lw), jnp.int32),
+            jax.ShapeDtypeStruct((b, nseg, 8, 512), jnp.uint32),
         ),
         grid=(b, nseg),
         in_specs=[
-            pl.BlockSpec((p * 8, d * 8), lambda bi, i: (0, 0),
+            pl.BlockSpec((p * 32, d * 32), lambda bi, i: (0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((block, 256), lambda bi, i: (0, 0),
+            pl.BlockSpec((wblk, 32), lambda bi, i: (0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, d, block), lambda bi, i: (bi, 0, i),
+            pl.BlockSpec((1, d, wblk), lambda bi, i: (bi, 0, i),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=(
-            pl.BlockSpec((1, p, block), lambda bi, i: (bi, 0, i),
+            pl.BlockSpec((1, p, wblk), lambda bi, i: (bi, 0, i),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, 8, 128), lambda bi, i: (bi, i, 0, 0),
+            pl.BlockSpec((1, 1, 8, 512), lambda bi, i: (bi, i, 0, 0),
                          memory_space=pltpu.VMEM),
         ),
         interpret=interpret,
         cost_estimate=pl.CostEstimate(
-            flops=2 * (p * 8 * d * 8 + (d + p) * 8 * 256) * length * b,
-            bytes_accessed=(d + p) * length * b,
+            flops=2 * (p * 32 * d * 32 + d * 32 * 32) * lw * b,
+            bytes_accessed=(d + p) * lw * 4 * b,
             transcendentals=0,
         ),
-    )(bit_matrix, w3, data)
-
-
-@functools.lru_cache(maxsize=8)
-def _plane_partial_matrix(block: int) -> np.ndarray:
-    """W3 (block, 256) int8: column group p8 (cols 32*p8..32*p8+31) is the
-    segment CRC matrix restricted to bit-plane p8, so a (shard, plane) bit
-    row contracted with group p8 yields that plane's partial CRC image."""
-    from .crc_device import _segment_matrix
-
-    w = _segment_matrix(block)  # (8*block, 32), rows (plane, byte)
-    return np.ascontiguousarray(
-        w.reshape(8, block, 32).transpose(1, 0, 2).reshape(block, 256))
+    )(bmw, v, words)
 
 
 def fused_encode_block(length: int, block: int = DEFAULT_BLOCK) -> int:
@@ -206,38 +279,66 @@ def fused_encode_block(length: int, block: int = DEFAULT_BLOCK) -> int:
     return 0
 
 
+def fused_encode_words(matrix: np.ndarray, words,
+                       block: int | None = None,
+                       interpret: bool | None = None):
+    """Batched parity + per-shard raw CRC32C, word-layout (the production
+    encode step).
+
+    words: (B, d, L//4) int32 — each lane is 4 consecutive shard bytes,
+    little-endian (a free numpy .view(np.int32) of the (B, d, L) uint8
+    host buffer).  Returns (parity_words (B, p, L//4) int32, crc_raw
+    (B, d+p) uint32).  Parity words are the packed parity bytes — view
+    the downloaded array as uint8 on the host; no device bitcast happens
+    in either direction.  L must divide into a power-of-two count of
+    `block`-byte segments (check with fused_encode_block first)."""
+    from ..util.platform import on_tpu
+    from .crc_device import combine_tree
+    from .rs_jax import _matrix_key
+
+    p, d = matrix.shape
+    words = jnp.asarray(words, dtype=jnp.int32)
+    length = words.shape[-1] * 4
+    if block is None:
+        block = fused_encode_block(length)
+    if not block or block % 4:
+        raise ValueError(f"length {length} unsupported by fused kernel")
+    nseg = length // block
+    bmw = jnp.asarray(_bm_word_cached(*_matrix_key(matrix)))
+    v = jnp.asarray(_anchor_matrix(block))
+    if interpret is None:
+        interpret = not on_tpu()
+    parity_w, tiles = _fused_encode_words(bmw, v, words, d, p, block,
+                                          interpret)
+    # per-(byteidx, plane) advance corrections + the shared combine fold:
+    # tiny (B * nseg * 448 words) XLA work next to the kernel itself
+    packed = tiles[:, :, 0, :(d + p) * 32]
+    shifts = jnp.arange(32, dtype=jnp.uint32)
+    bits = ((packed[..., None] >> shifts) & 1).astype(jnp.int8)
+    bits = bits.reshape(*packed.shape[:2], d + p, 4, 8, 32)
+    ct = jnp.asarray(_word_corrections())
+    corr = jnp.einsum("bnsiqc,iqcd->bnsd", bits, ct,
+                      preferred_element_type=jnp.int32) & 1
+    state = corr.astype(jnp.int8).transpose(0, 2, 1, 3)
+    return parity_w, combine_tree(state, block, nseg)
+
+
 def fused_encode_pallas(matrix: np.ndarray, data,
                         block: int | None = None,
                         interpret: bool | None = None):
-    """Batched parity + per-shard raw CRC32C in one fused kernel.
+    """Byte-layout convenience wrapper over fused_encode_words.
 
     data: (B, d, L) uint8 -> (parity (B, p, L) uint8, crc_raw (B, d+p)
-    uint32), same contract as parallel.mesh.batched_encode_step.  L must
-    divide into a power-of-two count of `block`-byte segments (check
-    with fused_encode_block first).
-    """
-    from ..util.platform import on_tpu
-    from .crc_device import _segment_matrix, combine_tree
-    from .rs_jax import _bit_matrix_cached, _matrix_key
-
-    p, d = matrix.shape
+    uint32), same contract as parallel.mesh.batched_encode_step.  The
+    device-side uint8<->int32 bitcasts this needs are relayouts on TPU —
+    production paths (parallel/batched_encode.py) upload int32 views and
+    call fused_encode_words directly."""
     data = jnp.asarray(data, dtype=jnp.uint8)
-    length = data.shape[-1]
-    if block is None:
-        block = fused_encode_block(length)
-    if not block:
-        raise ValueError(f"length {length} unsupported by fused kernel")
-    nseg = length // block
-    bm = jnp.asarray(_bit_matrix_cached(*_matrix_key(matrix)))
-    w3 = jnp.asarray(_plane_partial_matrix(block))
-    if interpret is None:
-        interpret = not on_tpu()
-    parity, seg_tiles = _fused_encode_pallas(bm, w3, data, d, p, block,
-                                             interpret)
-    seg = seg_tiles[:, :, 0, :d + p]  # strip the (8, 128) tile padding
-    # combine segment images left-to-right with the advance-matrix tree
-    # (the shared fold from crc_device)
-    shifts = jnp.arange(32, dtype=jnp.uint32)
-    state = ((seg[..., None] >> shifts) & 1).astype(jnp.int8)
-    state = state.transpose(0, 2, 1, 3)  # (B, shards, nseg, 32)
-    return parity, combine_tree(state, block, nseg)
+    b, d, length = data.shape
+    words = jax.lax.bitcast_convert_type(
+        data.reshape(b, d, length // 4, 4), jnp.int32)
+    parity_w, crc_raw = fused_encode_words(matrix, words, block=block,
+                                           interpret=interpret)
+    parity = jax.lax.bitcast_convert_type(
+        parity_w, jnp.uint8).reshape(b, matrix.shape[0], length)
+    return parity, crc_raw

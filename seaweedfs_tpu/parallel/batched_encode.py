@@ -149,7 +149,7 @@ def encode_volumes(bases: list[str], large_block: Optional[int] = None,
     from ..ops.crc_device import finalize
     from ..storage.erasure_coding import (LARGE_BLOCK_SIZE, SMALL_BLOCK_SIZE,
                                           to_ext)
-    from .mesh import make_mesh, make_sharded_encoder
+    from .mesh import make_mesh, make_sharded_encoder, words_capable
 
     large_block = large_block or LARGE_BLOCK_SIZE
     small_block = small_block or SMALL_BLOCK_SIZE
@@ -178,7 +178,10 @@ def encode_volumes(bases: list[str], large_block: Optional[int] = None,
     b = min(batch_units, len(units))
     b = max(n_data, ((b + n_data - 1) // n_data) * n_data)
 
-    step = make_sharded_encoder(mesh)
+    # word-layout fast path: packed int32 views move host<->device with
+    # no device bitcasts (the int32->uint8 relayout costs 10x the kernel)
+    use_words = words_capable(mesh, chunk)
+    step = make_sharded_encoder(mesh, words=use_words)
     sharding = NamedSharding(mesh, P("data", None, "block"))
 
     n_batches = (len(units) + b - 1) // b
@@ -259,6 +262,9 @@ def encode_volumes(bases: list[str], large_block: Optional[int] = None,
         # blocks until compute done; sharded gathers can come back
         # non-contiguous, and file writes need a contiguous buffer
         parity = np.ascontiguousarray(np.asarray(parity_dev))
+        if use_words:  # packed int32 parity words -> bytes (free view)
+            parity = parity.view(np.uint8).reshape(
+                parity.shape[0], PARITY_SHARDS, chunk)
         crcs = finalize(crc_dev, chunk)
         free_slots.put(buf)  # device consumed the input transfer
         for k, u in enumerate(batch):
@@ -274,7 +280,13 @@ def encode_volumes(bases: list[str], large_block: Optional[int] = None,
             if item is None:
                 break
             buf, batch = item
-            dev = jax.device_put(buf, sharding)
+            if use_words:
+                # pin to the mesh's device: the caller may run several
+                # 1-device meshes side by side
+                dev = jax.device_put(buf.view(np.int32),
+                                     mesh.devices.flat[0])
+            else:
+                dev = jax.device_put(buf, sharding)
             parity_dev, crc_dev = step(dev)
             inflight.append((buf, batch, parity_dev, crc_dev))
             if len(inflight) >= _INFLIGHT:

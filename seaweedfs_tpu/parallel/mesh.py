@@ -101,17 +101,21 @@ def _pallas_fused_ok(matrix) -> bool:
     if key in _PALLAS_OK:
         return _PALLAS_OK[key]
     try:
-        from ..ops.rs_pallas import fused_encode_pallas
+        from ..ops.rs_pallas import fused_encode_words
         from ..ops.rs_numpy import gf_apply_matrix
         from ..ops import crc32c as crc_host
 
         rng = np.random.default_rng(0)
         # batch >= 2 so BOTH grid dimensions take nonzero indices on the
-        # hardware — a bi>0-only miscompile must not pass the guard
+        # hardware — a bi>0-only miscompile must not pass the guard;
+        # drive the exact production invocation (int32 word views)
         data = rng.integers(0, 256, (2, m.shape[1], 2 * DEFAULT_BLOCK),
                             dtype=np.uint8)
-        parity, crcs = fused_encode_pallas(m, data, interpret=False)
-        parity, crcs = np.asarray(parity), np.asarray(crcs)
+        parity_w, crcs = fused_encode_words(m, data.view(np.int32),
+                                            interpret=False)
+        parity = np.ascontiguousarray(np.asarray(parity_w)).view(np.uint8)
+        parity = parity.reshape(data.shape[0], m.shape[0], -1)
+        crcs = np.asarray(crcs)
         ok = True
         for bi in range(data.shape[0]):
             expect = gf_apply_matrix(m, data[bi])
@@ -173,45 +177,64 @@ def make_sharded_apply(mesh: Mesh, matrix: np.ndarray):
     return step
 
 
-def make_sharded_encoder(mesh: Mesh, data_shards: int = 10,
-                         parity_shards: int = 4):
-    """jit-compiled batched encoder with shardings over the mesh:
-    batch -> "data" axis, byte columns -> "block" axis.  Cached per
-    (mesh, geometry) so repeated callers reuse the jit cache instead of
-    recompiling every batch.
-
-    On a single real-TPU device the fused Pallas kernel serves the step
-    (one VMEM bit expansion feeds parity AND CRC — HBM traffic stays at
-    parity-kernel levels); multi-device meshes and CPU use the portable
-    XLA formulation, which GSPMD can partition."""
-    from ..ops.rs_pallas import fused_encode_block, fused_encode_pallas
+def words_capable(mesh: Mesh, chunk_len: int,
+                  data_shards: int = 10, parity_shards: int = 4) -> bool:
+    """True when the word-layout fused Pallas step can serve (single
+    real-TPU device, fusable chunk length).  The words step moves packed
+    int32 views host<->device with NO device bitcasts — the production
+    fast path."""
+    from ..ops.rs_pallas import fused_encode_block
     from ..util.platform import on_tpu
 
-    cache_key = (mesh, data_shards, parity_shards)
+    matrix = gf256.parity_matrix(data_shards, data_shards + parity_shards)
+    return (mesh.devices.size == 1 and chunk_len % 4 == 0
+            and bool(fused_encode_block(chunk_len)) and on_tpu()
+            and _pallas_fused_ok(matrix))
+
+
+def make_sharded_encoder(mesh: Mesh, data_shards: int = 10,
+                         parity_shards: int = 4, words: bool = False):
+    """jit-compiled batched encoder with shardings over the mesh:
+    batch -> "data" axis, byte columns -> "block" axis.  Cached per
+    (mesh, geometry, layout) so repeated callers reuse the jit cache
+    instead of recompiling every batch.
+
+    words=False — portable XLA formulation on (B, d, L) uint8, which
+    GSPMD partitions over multi-device meshes.
+    words=True  — the fused word-layout Pallas kernel on (B, d, L//4)
+    int32 views (gate with words_capable first): one VMEM bit expansion
+    feeds parity AND CRC, packed words move in both directions, and the
+    returned parity is (B, p, L//4) int32 to .view(np.uint8) on host."""
+    cache_key = (mesh, data_shards, parity_shards, words)
     cached = _ENCODER_CACHE.get(cache_key)
     if cached is not None:
         return cached
     matrix = gf256.parity_matrix(
         data_shards, data_shards + parity_shards)
     bit_matrix = jnp.asarray(_bit_matrix_cached(*_matrix_key(matrix)))
-    use_pallas = (mesh.devices.size == 1 and on_tpu()
-                  and _pallas_fused_ok(matrix))
-    data_sharding = NamedSharding(mesh, P("data", None, "block"))
-    out_shardings = (
-        NamedSharding(mesh, P("data", None, "block")),  # parity
-        NamedSharding(mesh, P("data", None)),  # crc_raw
-    )
 
-    @functools.partial(
-        jax.jit,
-        in_shardings=(data_sharding,),
-        out_shardings=out_shardings,
-        donate_argnums=(0,),
-    )
-    def step(data):
-        if use_pallas and fused_encode_block(data.shape[-1]):
-            return fused_encode_pallas(matrix, data, interpret=False)
-        return batched_encode_step(bit_matrix, data)
+    if words:
+        from ..ops.rs_pallas import fused_encode_words
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def step(data_words):
+            return fused_encode_words(matrix, data_words,
+                                      interpret=False)
+    else:
+        data_sharding = NamedSharding(mesh, P("data", None, "block"))
+        out_shardings = (
+            NamedSharding(mesh, P("data", None, "block")),  # parity
+            NamedSharding(mesh, P("data", None)),  # crc_raw
+        )
+
+        @functools.partial(
+            jax.jit,
+            in_shardings=(data_sharding,),
+            out_shardings=out_shardings,
+            donate_argnums=(0,),
+        )
+        def step(data):
+            return batched_encode_step(bit_matrix, data)
 
     _ENCODER_CACHE[cache_key] = step
     return step
@@ -227,6 +250,15 @@ def encode_batch(data: np.ndarray, mesh: Mesh | None = None):
 
     if mesh is None:
         mesh = make_mesh()
+    data = np.ascontiguousarray(data).astype(np.uint8, copy=False)
+    b, d, length = data.shape
+    if words_capable(mesh, length):
+        step = make_sharded_encoder(mesh, words=True)
+        parity_w, crc_raw = step(jax.device_put(data.view(np.int32),
+                                                mesh.devices.flat[0]))
+        parity = np.ascontiguousarray(np.asarray(parity_w)) \
+            .view(np.uint8).reshape(b, -1, length)
+        return parity, finalize(crc_raw, length)
     step = make_sharded_encoder(mesh)
     sharding = NamedSharding(mesh, P("data", None, "block"))
     device_data = jax.device_put(jnp.asarray(data, dtype=jnp.uint8),

@@ -134,3 +134,29 @@ class TestFusedPallasKernel:
         assert fused_encode_block(100) == 0  # unsupported shape
         # 1536 = 3*512: nseg = 3 is not a power of two at any block
         assert fused_encode_block(1536, 512) == 0
+
+    def test_words_api_matches_and_views_are_free(self):
+        """The production words API (packed int32 views, no device
+        bitcasts) must agree with the uint8 wrapper and the host codec,
+        and its parity words must view back to the exact parity bytes."""
+        from seaweedfs_tpu.ops import crc32c as crc_host
+        from seaweedfs_tpu.ops import gf256
+        from seaweedfs_tpu.ops.crc_device import finalize
+        from seaweedfs_tpu.ops.rs_numpy import gf_apply_matrix
+        from seaweedfs_tpu.ops.rs_pallas import fused_encode_words
+
+        matrix = gf256.parity_matrix(10, 14)
+        rng = np.random.default_rng(99)
+        batch, length = 2, 16384
+        data = rng.integers(0, 256, (batch, 10, length), dtype=np.uint8)
+        parity_w, crc_raw = fused_encode_words(matrix,
+                                               data.view(np.int32))
+        parity = np.ascontiguousarray(np.asarray(parity_w)) \
+            .view(np.uint8).reshape(batch, 4, length)
+        crcs = finalize(crc_raw, length)
+        for bi in range(batch):
+            expect = gf_apply_matrix(np.asarray(matrix), data[bi])
+            assert np.array_equal(parity[bi], expect)
+            full = np.concatenate([data[bi], expect], axis=0)
+            for s in range(14):
+                assert int(crcs[bi, s]) == crc_host.crc32c(full[s])
