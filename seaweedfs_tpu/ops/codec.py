@@ -45,6 +45,16 @@ class NativeEncoder(RSCodecBase):
         return out
 
 
+def new_host_encoder(data_shards: int = 10, parity_shards: int = 4):
+    """Best HOST codec (native AVX2/SSE, else numpy) — never a device
+    backend.  The link-throughput auto-selection falls back to this when
+    the host<->device link would cap the device path below the host
+    rate; resolving "auto" there would pick the device codec again."""
+    if native.lib() is not None:
+        return NativeEncoder(data_shards, parity_shards)
+    return NumpyEncoder(data_shards, parity_shards)
+
+
 def new_encoder(data_shards: int = 10, parity_shards: int = 4,
                 backend: str = "auto"):
     if backend == "auto":

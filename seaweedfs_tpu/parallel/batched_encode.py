@@ -133,23 +133,25 @@ class _ShardWriters:
 
 def encode_volumes(bases: list[str], large_block: Optional[int] = None,
                    small_block: Optional[int] = None,
-                   mesh=None, batch_units: Optional[int] = None
-                   ) -> dict[str, list[int]]:
-    """Encode every `base` (.dat) into 14 shard files via the sharded TPU
+                   mesh=None, batch_units: Optional[int] = None,
+                   host_codec=None) -> dict[str, list[int]]:
+    """Encode every `base` (.dat) into 14 shard files via the batched
     pipeline.  Returns {base: [crc32c of each shard file] * 14}.
 
     Volumes are batched together: chunks from different volumes ride the
     same device dispatch, which is what makes the 100-volume HBM-resident
     configuration (BASELINE config 4) one pipeline rather than 100 encodes.
-    """
-    import jax
-    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-    from ..ops import crc32c as crc_host
-    from ..ops.crc_device import finalize
+    host_codec: pass an encoder object (or True for the best host codec)
+    to run the SAME pipeline — reader thread, staging slots, CRC combine,
+    writer backpressure — with the native host codec as the compute stage
+    instead of a device dispatch.  This is the auto-selected fallback on
+    link-capped machines: unlike the reference's synchronous loop
+    (ec_encoder.go:194-231) the pipeline overlaps file I/O with compute,
+    and it still produces the fused shard-file CRCs for the .vif.
+    """
     from ..storage.erasure_coding import (LARGE_BLOCK_SIZE, SMALL_BLOCK_SIZE,
                                           to_ext)
-    from .mesh import make_mesh, make_sharded_encoder, words_capable
 
     large_block = large_block or LARGE_BLOCK_SIZE
     small_block = small_block or SMALL_BLOCK_SIZE
@@ -165,6 +167,124 @@ def encode_volumes(bases: list[str], large_block: Optional[int] = None,
             writers[vi].close()
             out[p.base] = [0] * TOTAL_SHARDS
         return out
+    if host_codec:
+        return _encode_units_host(plans, units, chunk, writers, host_codec)
+    return _encode_units_device(plans, units, chunk, writers, mesh,
+                                batch_units)
+
+
+class _PipelineIO:
+    """Shared reader/writer scaffolding of the streaming pipeline:
+    staging slots, backpressure queues, the reader thread (fills slots
+    and appends data shards), the writer thread (appends parity shards),
+    and the torn-shutdown sequencing.  The device and host compute
+    stages differ only in what happens between `ready` and `parity_q`."""
+
+    def __init__(self, plans, units, chunk, writers, b):
+        self.plans, self.units, self.chunk = plans, units, chunk
+        self.writers, self.b = writers, b
+        self.n_batches = (len(units) + b - 1) // b
+        self.dats = [open(p.base + ".dat", "rb") for p in plans]
+        self.free_slots: "queue.Queue[np.ndarray]" = queue.Queue()
+        for _ in range(_SLOTS):
+            self.free_slots.put(
+                np.zeros((b, DATA_SHARDS, chunk), dtype=np.uint8))
+        self.ready: "queue.Queue" = queue.Queue(maxsize=_SLOTS)
+        self.parity_q: "queue.Queue" = queue.Queue(maxsize=_SLOTS)
+        self.errors: list[BaseException] = []
+        self.stop = threading.Event()
+        self._rt = threading.Thread(target=self._reader, daemon=True)
+        self._wt = threading.Thread(target=self._writer, daemon=True)
+
+    def put(self, q, item) -> bool:
+        while not self.stop.is_set():
+            try:
+                q.put(item, timeout=0.5)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def get(self, q):
+        while not self.stop.is_set():
+            try:
+                return q.get(timeout=0.5)
+            except queue.Empty:
+                continue
+        return None
+
+    def _reader(self):
+        try:
+            for n in range(self.n_batches):
+                batch = self.units[n * self.b:(n + 1) * self.b]
+                buf = self.get(self.free_slots)
+                if buf is None:
+                    return
+                for k, u in enumerate(batch):
+                    _read_unit(self.dats[u.vol],
+                               self.plans[u.vol].dat_size, u,
+                               self.chunk, buf[k])
+                    w = self.writers[u.vol]
+                    for i in range(DATA_SHARDS):
+                        w.files[i].seek(u.shard_off)
+                        w.files[i].write(buf[k, i])
+                if not self.put(self.ready, (buf, batch)):
+                    return
+            self.put(self.ready, None)
+        except BaseException as e:  # propagate to the main thread
+            self.errors.append(e)
+            self.stop.set()
+
+    def _writer(self):
+        try:
+            while True:
+                item = self.get(self.parity_q)
+                if item is None:
+                    return
+                parity, batch = item
+                for k, u in enumerate(batch):
+                    w = self.writers[u.vol]
+                    for i in range(PARITY_SHARDS):
+                        f = w.files[DATA_SHARDS + i]
+                        f.seek(u.shard_off)
+                        f.write(parity[k, i])
+        except BaseException as e:
+            self.errors.append(e)
+            self.stop.set()
+
+    def start(self):
+        self._rt.start()
+        self._wt.start()
+
+    def finish(self):
+        self.put(self.parity_q, None)
+        self._wt.join(timeout=60)
+        self.stop.set()
+        self._rt.join(timeout=30)
+        for f in self.dats:
+            f.close()
+        for w in self.writers.values():
+            w.close()
+
+    def result(self) -> dict[str, list[int]]:
+        if self.errors:
+            raise self.errors[0]
+        from ..stats import metrics as stats
+
+        stats.EcEncodeBytesCounter.inc(
+            sum(p.dat_size for p in self.plans))
+        return {p.base: self.writers[vi].crcs
+                for vi, p in enumerate(self.plans)}
+
+
+def _encode_units_device(plans, units, chunk, writers, mesh,
+                         batch_units) -> dict[str, list[int]]:
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from ..ops import crc32c as crc_host
+    from ..ops.crc_device import finalize
+    from .mesh import make_mesh, make_sharded_encoder, words_capable
 
     if mesh is None:
         mesh = make_mesh()
@@ -184,77 +304,7 @@ def encode_volumes(bases: list[str], large_block: Optional[int] = None,
     step = make_sharded_encoder(mesh, words=use_words)
     sharding = NamedSharding(mesh, P("data", None, "block"))
 
-    n_batches = (len(units) + b - 1) // b
-    dats = [open(p.base + ".dat", "rb") for p in plans]
-
-    free_slots: "queue.Queue[np.ndarray]" = queue.Queue()
-    for _ in range(_SLOTS):
-        free_slots.put(np.zeros((b, DATA_SHARDS, chunk), dtype=np.uint8))
-    ready: "queue.Queue" = queue.Queue(maxsize=_SLOTS)
-    parity_q: "queue.Queue" = queue.Queue(maxsize=_SLOTS)
-    errors: list[BaseException] = []
-    stop = threading.Event()
-
-    def _put(q, item) -> bool:
-        while not stop.is_set():
-            try:
-                q.put(item, timeout=0.5)
-                return True
-            except queue.Full:
-                continue
-        return False
-
-    def _get(q):
-        while not stop.is_set():
-            try:
-                return q.get(timeout=0.5)
-            except queue.Empty:
-                continue
-        return None
-
-    def reader():
-        try:
-            for n in range(n_batches):
-                batch = units[n * b:(n + 1) * b]
-                buf = _get(free_slots)
-                if buf is None:
-                    return
-                for k, u in enumerate(batch):
-                    _read_unit(dats[u.vol], plans[u.vol].dat_size, u,
-                               chunk, buf[k])
-                    w = writers[u.vol]
-                    for i in range(DATA_SHARDS):
-                        w.files[i].seek(u.shard_off)
-                        w.files[i].write(buf[k, i])
-                if not _put(ready, (buf, batch)):
-                    return
-            _put(ready, None)
-        except BaseException as e:  # propagate to the main thread
-            errors.append(e)
-            stop.set()
-
-    def writer():
-        try:
-            while True:
-                item = _get(parity_q)
-                if item is None:
-                    return
-                parity, batch = item
-                for k, u in enumerate(batch):
-                    w = writers[u.vol]
-                    for i in range(PARITY_SHARDS):
-                        f = w.files[DATA_SHARDS + i]
-                        f.seek(u.shard_off)
-                        f.write(parity[k, i])
-        except BaseException as e:
-            errors.append(e)
-            stop.set()
-
-    rt = threading.Thread(target=reader, daemon=True)
-    wt = threading.Thread(target=writer, daemon=True)
-    rt.start()
-    wt.start()
-
+    io = _PipelineIO(plans, units, chunk, writers, b)
     inflight: list = []  # (buf, batch, parity_dev, crc_dev)
 
     def drain_one():
@@ -266,17 +316,18 @@ def encode_volumes(bases: list[str], large_block: Optional[int] = None,
             parity = parity.view(np.uint8).reshape(
                 parity.shape[0], PARITY_SHARDS, chunk)
         crcs = finalize(crc_dev, chunk)
-        free_slots.put(buf)  # device consumed the input transfer
+        io.free_slots.put(buf)  # device consumed the input transfer
         for k, u in enumerate(batch):
             w = writers[u.vol]
             for s in range(TOTAL_SHARDS):
                 w.crcs[s] = crc_host.crc32c_combine(
                     w.crcs[s], int(crcs[k, s]), chunk)
-        _put(parity_q, (parity, batch))
+        io.put(io.parity_q, (parity, batch))
 
+    io.start()
     try:
-        while not stop.is_set():
-            item = _get(ready)
+        while not io.stop.is_set():
+            item = io.get(io.ready)
             if item is None:
                 break
             buf, batch = item
@@ -291,26 +342,60 @@ def encode_volumes(bases: list[str], large_block: Optional[int] = None,
             inflight.append((buf, batch, parity_dev, crc_dev))
             if len(inflight) >= _INFLIGHT:
                 drain_one()
-        while inflight and not stop.is_set():
+        while inflight and not io.stop.is_set():
             drain_one()
     except BaseException:
-        stop.set()
+        io.stop.set()
         raise
     finally:
-        _put(parity_q, None)
-        wt.join(timeout=60)
-        stop.set()
-        rt.join(timeout=30)
-        for f in dats:
-            f.close()
-        for w in writers.values():
-            w.close()
-    if errors:
-        raise errors[0]
-    from ..stats import metrics as stats
+        io.finish()
+    return io.result()
 
-    stats.EcEncodeBytesCounter.inc(sum(p.dat_size for p in plans))
-    return {p.base: writers[vi].crcs for vi, p in enumerate(plans)}
+
+def _encode_units_host(plans, units, chunk, writers,
+                       host_codec) -> dict[str, list[int]]:
+    """The pipeline with the host codec as the compute stage: same
+    reader thread / staging slots / writer backpressure / rolling CRC
+    combine as the device path (via _PipelineIO), no JAX involved.  The
+    native codec and SSE4.2 CRC release the GIL, so the reader and
+    writer threads overlap with compute on multi-core hosts."""
+    from ..ops import codec as codec_mod
+    from ..ops import crc32c as crc_host
+
+    enc = host_codec if hasattr(host_codec, "_apply") \
+        else codec_mod.new_host_encoder(DATA_SHARDS, PARITY_SHARDS)
+    parity_matrix = np.asarray(enc.matrix[DATA_SHARDS:])
+
+    batch_units = max(1, TARGET_BATCH_BYTES // (DATA_SHARDS * chunk))
+    b = min(batch_units, len(units))
+    io = _PipelineIO(plans, units, chunk, writers, b)
+    io.start()
+    try:
+        while not io.stop.is_set():
+            item = io.get(io.ready)
+            if item is None:
+                break
+            buf, batch = item
+            parity = np.empty((len(batch), PARITY_SHARDS, chunk),
+                              dtype=np.uint8)
+            for k, u in enumerate(batch):
+                parity[k] = enc._apply(parity_matrix, buf[k])
+                w = writers[u.vol]
+                for s in range(DATA_SHARDS):
+                    w.crcs[s] = crc_host.crc32c_combine(
+                        w.crcs[s], crc_host.crc32c(buf[k, s]), chunk)
+                for s in range(PARITY_SHARDS):
+                    w.crcs[DATA_SHARDS + s] = crc_host.crc32c_combine(
+                        w.crcs[DATA_SHARDS + s],
+                        crc_host.crc32c(parity[k, s]), chunk)
+            io.free_slots.put(buf)
+            io.put(io.parity_q, (parity, batch))
+    except BaseException:
+        io.stop.set()
+        raise
+    finally:
+        io.finish()
+    return io.result()
 
 
 def rebuild_matrix(present: list[int], missing: list[int],

@@ -28,6 +28,23 @@ from . import (DATA_SHARDS_COUNT, LARGE_BLOCK_SIZE, SMALL_BLOCK_SIZE,
                TOTAL_SHARDS_COUNT, to_ext)
 from .locate import Interval, locate_data
 
+_recover_pool_lock = threading.Lock()
+_recover_pool_inst = None
+
+
+def _recover_pool():
+    """Shared fan-out pool for degraded-read survivor fetches: built
+    once, sized for a few concurrent recoveries, never rebuilt on the
+    hot path of an outage."""
+    global _recover_pool_inst
+    with _recover_pool_lock:
+        if _recover_pool_inst is None:
+            import concurrent.futures as cf
+
+            _recover_pool_inst = cf.ThreadPoolExecutor(
+                max_workers=32, thread_name_prefix="ec-recover")
+        return _recover_pool_inst
+
 
 class EcError(Exception):
     pass
@@ -257,25 +274,53 @@ class EcVolume:
     def _recover_span(self, target_shard: int, offset: int,
                       size: int) -> bytes:
         """On-the-fly reconstruction of one missing shard's span from >=10
-        other shards (recoverOneRemoteEcShardInterval, store_ec.go:328-382)."""
+        other shards (recoverOneRemoteEcShardInterval, store_ec.go:328-382).
+
+        Survivor fetches fan out in PARALLEL like the reference's
+        per-shard goroutines: local shards are read synchronously (disk,
+        cheap, first-10-wins), then the remaining remote candidates are
+        requested at once on a SHARED pool and the first arrivals win —
+        a degraded read during an outage costs ~one RPC round-trip, not
+        ten serial ones.  Queued stragglers are cancelled; in-flight
+        ones drain on the shared pool (remote_reader RPCs carry their
+        own timeouts)."""
         shards: list[Optional[np.ndarray]] = [None] * TOTAL_SHARDS_COUNT
         have = 0
+        remote_candidates: list[int] = []
         for sid in range(TOTAL_SHARDS_COUNT):
-            if sid == target_shard or have >= DATA_SHARDS_COUNT:
+            if sid == target_shard:
                 continue
-            data = None
             shard = self.shards.get(sid)
             if shard is not None:
+                if have >= DATA_SHARDS_COUNT:
+                    continue  # reconstruct needs exactly 10 survivors
                 data = shard.read_at(size, offset)
-                if len(data) != size:
-                    data = None
+                if len(data) == size:
+                    shards[sid] = np.frombuffer(data, dtype=np.uint8)
+                    have += 1
             elif self.remote_reader is not None:
-                data = self.remote_reader(sid, offset, size)
-                if data is not None and len(data) != size:
-                    data = None
-            if data is not None:
-                shards[sid] = np.frombuffer(data, dtype=np.uint8)
-                have += 1
+                remote_candidates.append(sid)
+        if have < DATA_SHARDS_COUNT and remote_candidates:
+            import concurrent.futures as cf
+
+            pool = _recover_pool()
+            futs = {pool.submit(self.remote_reader, sid, offset, size): sid
+                    for sid in remote_candidates}
+            try:
+                for fut in cf.as_completed(futs):
+                    try:
+                        data = fut.result()
+                    except Exception:
+                        data = None
+                    if data is not None and len(data) == size:
+                        shards[futs[fut]] = np.frombuffer(data,
+                                                          dtype=np.uint8)
+                        have += 1
+                        if have >= DATA_SHARDS_COUNT:
+                            break
+            finally:
+                for fut in futs:
+                    fut.cancel()
         if have < DATA_SHARDS_COUNT:
             raise EcError(
                 f"need {DATA_SHARDS_COUNT} shards to recover shard "

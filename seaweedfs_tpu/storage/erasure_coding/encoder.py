@@ -50,18 +50,24 @@ def write_ec_files(base_file_name: str, encoder=None,
                    batched: Optional[bool] = None):
     """Generate .ec00..ec13 from .dat (WriteEcFiles, ec_encoder.go:57-59).
 
-    Default path (no explicit codec): the streaming batched TPU pipeline
-    (parallel/batched_encode.py) — device-batched parity with fused CRC32C
-    and pipelined host I/O.  Returns the 14 shard-file CRC32Cs it computed.
-    With an explicit `encoder` (or batched=False) falls back to the
-    synchronous per-row host loop and returns None.  When the JAX backend
-    does not answer device enumeration in time (wedged TPU transport),
+    Default path (no explicit codec): auto-selected by PREDICTED
+    throughput on this machine — the streaming batched TPU pipeline
+    (parallel/batched_encode.py; device-batched parity with fused CRC32C
+    and pipelined host I/O) when the measured host<->device link can
+    carry it faster than the host codec, else the synchronous host loop
+    (util/platform.prefer_batched_encode; behind a slow relay tunnel the
+    link, not the chip, is the bottleneck).  Returns the 14 shard-file
+    CRC32Cs from the batched path, None from the host loop.  An explicit
+    `encoder` (or batched=False) forces the host loop; batched=True
+    forces the device pipeline (-ec.backend=tpu).  A wedged JAX backend
     falls back to the host codec rather than hanging a daemon.
     """
+    auto_host = False
     if batched is None:
-        from ...util.platform import jax_usable
+        from ...util.platform import prefer_batched_encode
 
-        batched = encoder is None and jax_usable()
+        batched = encoder is None and prefer_batched_encode()
+        auto_host = encoder is None and not batched
     if batched:
         from ...parallel.batched_encode import encode_volumes
 
@@ -69,8 +75,27 @@ def write_ec_files(base_file_name: str, encoder=None,
                               large_block=large_block_size,
                               small_block=small_block_size)
         return crcs[base_file_name]
+    if auto_host and (os.cpu_count() or 1) >= 4:
+        # auto-selection rejected the (link-capped) device path: on a
+        # multi-core host run the PIPELINED host mode — reader/writer
+        # threads overlap with the native codec (which releases the
+        # GIL), and fused shard CRCs come along for the .vif.  On a
+        # 1-2 core host threads only add switching, so fall through to
+        # the synchronous loop (the reference architecture, and the
+        # floor on a purely CPU-bound box).
+        from ...parallel.batched_encode import encode_volumes
+
+        crcs = encode_volumes([base_file_name],
+                              large_block=large_block_size,
+                              small_block=small_block_size,
+                              host_codec=True)
+        return crcs[base_file_name]
     if encoder is None:
-        encoder = codec_mod.new_encoder(DATA_SHARDS_COUNT, PARITY_SHARDS_COUNT)
+        # explicit batched=False: the reference-architecture synchronous
+        # host loop, with a genuine host codec (not "auto", which would
+        # pick the device backend right back on a TPU machine)
+        encoder = codec_mod.new_host_encoder(DATA_SHARDS_COUNT,
+                                             PARITY_SHARDS_COUNT)
     dat_size = os.path.getsize(base_file_name + ".dat")
     outputs = [open(base_file_name + to_ext(i), "wb")
                for i in range(TOTAL_SHARDS_COUNT)]
@@ -123,20 +148,22 @@ def rebuild_ec_files(base_file_name: str, encoder=None,
 
     Default path (no explicit codec): the batched device pipeline —
     survivor chunks stream through one reconstruction bit-matmul with
-    fused CRC32C (BASELINE config 3).  Falls back to the synchronous
-    host loop with an explicit `encoder`, batched=False, or an
-    unreachable JAX backend.
+    fused CRC32C (BASELINE config 3) — when the link can carry it
+    faster than the host codec (same auto-selection as write_ec_files).
+    Falls back to the synchronous host loop with an explicit `encoder`,
+    batched=False, or an unreachable JAX backend.
     """
     if batched is None:
-        from ...util.platform import jax_usable
+        from ...util.platform import prefer_batched_encode
 
-        batched = encoder is None and jax_usable()
+        batched = encoder is None and prefer_batched_encode()
     if batched:
         from ...parallel.batched_encode import rebuild_shards
 
         return rebuild_shards(base_file_name)
     if encoder is None:
-        encoder = codec_mod.new_encoder(DATA_SHARDS_COUNT, PARITY_SHARDS_COUNT)
+        encoder = codec_mod.new_host_encoder(DATA_SHARDS_COUNT,
+                                             PARITY_SHARDS_COUNT)
     has_data = [os.path.exists(base_file_name + to_ext(i))
                 for i in range(TOTAL_SHARDS_COUNT)]
     generated = [i for i in range(TOTAL_SHARDS_COUNT) if not has_data[i]]

@@ -154,17 +154,22 @@ class Store:
     def ec_generate(self, vid: int, encoder=None):
         """VolumeEcShardsGenerate: encode a local volume into shard files.
 
-        Default backend is the streaming batched TPU pipeline; the fused
-        per-shard-file CRC32Cs it produces are persisted in the .vif
-        sidecar for scrub tooling.
+        Backend: -ec.backend=tpu forces the streaming batched device
+        pipeline; the default (None) auto-selects batched vs host codec
+        by predicted throughput on this machine's host<->device link
+        (write_ec_files).  Fused per-shard-file CRC32Cs from the batched
+        path are persisted in the .vif sidecar for scrub tooling.
         """
         v = self.find_volume(vid)
         if v is None:
             raise NotFoundError(f"volume {vid} not found")
         base = v.file_name()
         v.sync()
+        forced = True if (encoder is None
+                          and self.ec_encoder_backend == "tpu") else None
         crcs = ec_encoder.write_ec_files(
-            base, encoder=encoder or self._resolve_ec_encoder())
+            base, encoder=encoder or self._resolve_ec_encoder(),
+            batched=forced)
         ec_encoder.write_sorted_file_from_idx(base)
         extra = {"shard_crc32c": crcs} if crcs else None
         ec_encoder.save_volume_info(base, version=v.version, extra=extra)
@@ -173,12 +178,14 @@ class Store:
         """Batched VolumeEcShardsGenerate: encode MANY local volumes in one
         device pipeline — their row chunks share (B, 10, L) dispatches
         (BASELINE config 4; no reference analogue, per-volume sequential at
-        ec_encoder.go:194).  Only used when no explicit CPU codec backend
-        is configured."""
-        from ..util.platform import jax_usable
+        ec_encoder.go:194).  Used when -ec.backend=tpu forces the device
+        path or the link-throughput auto-selection predicts the device
+        pipeline beats the host codec on this machine."""
+        from ..util.platform import prefer_batched_encode
 
-        if self.ec_encoder_backend not in (None, "tpu") or \
-                not jax_usable():
+        use_batched = self.ec_encoder_backend == "tpu" or (
+            self.ec_encoder_backend is None and prefer_batched_encode())
+        if not use_batched:
             enc = self._resolve_ec_encoder()  # resolve the codec ONCE
             for vid in vids:
                 self.ec_generate(vid, encoder=enc)

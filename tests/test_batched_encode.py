@@ -69,6 +69,54 @@ class TestBatchedEncode:
         for i in range(14):
             assert os.path.getsize(base + to_ext(i)) == 0
 
+    @pytest.mark.parametrize("size", [1, SMALL * 10 * 7 + 13,
+                                      LARGE * 10 * 2 + 12345])
+    def test_host_pipeline_mode_matches(self, tmp_path, size):
+        """encode_volumes(host_codec=True): the same pipeline with the
+        native codec as the compute stage — byte-identical shards and
+        correct rolling CRCs (the link-capped auto-fallback path)."""
+        base = _make_volume(tmp_path, "hp", size, size % 97)
+        crcs = encode_volumes([base], large_block=LARGE,
+                              small_block=SMALL, host_codec=True)
+        ref = _host_reference(tmp_path, base, "hpref")
+        for i in range(14):
+            with open(base + to_ext(i), "rb") as f:
+                got = f.read()
+            with open(ref + to_ext(i), "rb") as f:
+                assert got == f.read(), f"shard {i}"
+            assert crcs[base][i] == crc_host.crc32c(got), f"crc {i}"
+
+    def test_odd_chunk_length_on_cpu_mesh(self, tmp_path):
+        """Chunk lengths not divisible by 4 must keep working on CPU
+        meshes (the SWAR packing needs %4; the step falls back to the
+        bit-matmul formulation — round-4 review finding)."""
+        base = _make_volume(tmp_path, "odd", 1230, 3)
+        crcs = encode_volumes([base], large_block=500, small_block=50)
+        ref = str(tmp_path / "oddref")
+        os.link(base + ".dat", ref + ".dat")
+        ec_encoder.write_ec_files(ref, large_block_size=500,
+                                  small_block_size=50, batched=False)
+        for i in range(14):
+            with open(base + to_ext(i), "rb") as a, \
+                    open(ref + to_ext(i), "rb") as b:
+                got = a.read()
+                assert got == b.read(), f"shard {i}"
+            assert crcs[base][i] == crc_host.crc32c(got)
+
+    def test_host_pipeline_multi_volume(self, tmp_path):
+        bases = [_make_volume(tmp_path, f"hm{k}", 977 * (k + 1), k)
+                 for k in range(5)]
+        crcs = encode_volumes(bases, large_block=LARGE, small_block=SMALL,
+                              host_codec=True)
+        for k, base in enumerate(bases):
+            ref = _host_reference(tmp_path, base, f"hmref{k}")
+            for i in range(14):
+                with open(base + to_ext(i), "rb") as f:
+                    got = f.read()
+                with open(ref + to_ext(i), "rb") as f:
+                    assert got == f.read(), f"vol {k} shard {i}"
+                assert crcs[base][i] == crc_host.crc32c(got)
+
     def test_write_ec_files_default_is_batched(self, tmp_path):
         """write_ec_files with no codec returns the fused shard CRCs."""
         from seaweedfs_tpu.util.platform import jax_usable
@@ -81,6 +129,91 @@ class TestBatchedEncode:
         assert isinstance(crcs, list) and len(crcs) == 14
         with open(base + to_ext(12), "rb") as f:
             assert crcs[12] == crc_host.crc32c(f.read())
+
+
+class TestBackendAutoSelection:
+    """Link-throughput-aware default: behind a slow host<->device link the
+    default ec.encode must never lose to the host codec (round-3 verdict
+    item 2); -ec.backend=tpu still forces the device pipeline."""
+
+    def test_slow_link_prefers_host_codec(self, tmp_path, monkeypatch):
+        import os as _os
+
+        from seaweedfs_tpu.util import platform as plat
+
+        monkeypatch.setattr(plat, "_probe", lambda t: (True, "tpu"))
+        monkeypatch.setattr(plat, "link_throughput",
+                            lambda **kw: (5.0, 2.0))  # MB/s relay-class
+        assert plat.predicted_batched_gibps() < 0.01
+        assert plat.prefer_batched_encode() is False
+        # multi-core host: the fallback is the PIPELINED host mode,
+        # which still returns shard CRCs
+        monkeypatch.setattr(_os, "cpu_count", lambda: 8)
+        base = _make_volume(tmp_path, "slow", 12345, 5)
+        crcs = ec_encoder.write_ec_files(base, large_block_size=LARGE,
+                                         small_block_size=SMALL)
+        assert isinstance(crcs, list) and len(crcs) == 14
+        with open(base + to_ext(12), "rb") as f:
+            assert crcs[12] == crc_host.crc32c(f.read())
+        # 1-2 core host: the synchronous reference-architecture loop
+        monkeypatch.setattr(_os, "cpu_count", lambda: 1)
+        base2 = _make_volume(tmp_path, "slow1c", 12345, 5)
+        crcs2 = ec_encoder.write_ec_files(base2, large_block_size=LARGE,
+                                          small_block_size=SMALL)
+        assert crcs2 is None
+        for i in range(14):
+            with open(base + to_ext(i), "rb") as a, \
+                    open(base2 + to_ext(i), "rb") as b:
+                assert a.read() == b.read(), f"shard {i}"
+
+    def test_fast_link_prefers_batched(self, tmp_path):
+        from seaweedfs_tpu.util import platform as plat
+
+        # a fast-link TPU picks batched...
+        with pytest.MonkeyPatch.context() as mp:
+            mp.setattr(plat, "_probe", lambda t: (True, "tpu"))
+            mp.setattr(plat, "link_throughput", lambda **kw: (1e6, 1e6))
+            assert plat.prefer_batched_encode() is True
+        # ...and so does the CPU/virtual-mesh backend (device == host, no
+        # link to lose on); the actual write runs on the real backend
+        assert plat.prefer_batched_encode() is True
+        base = _make_volume(tmp_path, "fast", 12345, 6)
+        crcs = ec_encoder.write_ec_files(base, large_block_size=LARGE,
+                                         small_block_size=SMALL)
+        assert isinstance(crcs, list) and len(crcs) == 14
+
+    def test_backend_tpu_forces_batched_on_slow_link(self, monkeypatch,
+                                                     tmp_path):
+        from seaweedfs_tpu.util import platform as plat
+
+        monkeypatch.setattr(plat, "link_throughput",
+                            lambda **kw: (5.0, 2.0))
+        base = _make_volume(tmp_path, "forced", 23456, 7)
+        # batched=True is what store.ec_generate passes for -ec.backend=tpu
+        crcs = ec_encoder.write_ec_files(base, large_block_size=LARGE,
+                                         small_block_size=SMALL,
+                                         batched=True)
+        assert isinstance(crcs, list) and len(crcs) == 14
+
+    def test_slow_link_encode_decode_roundtrip(self, tmp_path,
+                                               monkeypatch):
+        """The host-selected path must produce byte-identical shards to
+        the batched path."""
+        from seaweedfs_tpu.util import platform as plat
+
+        base = _make_volume(tmp_path, "rt", 77777, 8)
+        ref = _make_volume(tmp_path, "rtref", 77777, 8)
+        with pytest.MonkeyPatch.context() as mp:
+            mp.setattr(plat, "_probe", lambda t: (True, "tpu"))
+            mp.setattr(plat, "link_throughput", lambda **kw: (5.0, 2.0))
+            ec_encoder.write_ec_files(base, large_block_size=LARGE,
+                                      small_block_size=SMALL)
+        ec_encoder.write_ec_files(ref, large_block_size=LARGE,
+                                  small_block_size=SMALL)
+        for i in range(14):
+            with open(base + to_ext(i), "rb") as a, \
+                    open(ref + to_ext(i), "rb") as b:
+                assert a.read() == b.read(), f"shard {i}"
 
 
 class TestPlan:

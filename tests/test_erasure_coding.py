@@ -172,6 +172,57 @@ class TestEncode:
             assert n.id == nid  # CRC verified inside read
         ev.close()
 
+    def test_degraded_read_fans_out_survivor_fetches(self, encoded):
+        """Remote survivor fetches must run in PARALLEL (the reference
+        fans out per-shard goroutines, store_ec.go:328-382): with every
+        survivor 150 ms away, a recovery needing 10 of them must finish
+        in ~one round-trip, not ten serial ones."""
+        import time as _t
+
+        base, d = encoded
+        shard_bytes = {i: open(base + to_ext(i), "rb").read()
+                       for i in range(TOTAL_SHARDS_COUNT)}
+        ev = EcVolume(d, "", 1, large_block_size=LARGE,
+                      small_block_size=SMALL)
+        # NO local shards: every survivor is a (slow) remote fetch
+        calls = []
+
+        def slow_remote(sid, offset, size):
+            calls.append(sid)
+            if sid == 0:  # the target shard is lost cluster-wide
+                return None
+            _t.sleep(0.15)
+            return shard_bytes[sid][offset:offset + size]
+
+        ev.remote_reader = slow_remote
+        t0 = _t.monotonic()
+        span = ev.read_shard_span(0, 0, 64)
+        elapsed = _t.monotonic() - t0
+        assert span == shard_bytes[0][:64]
+        assert len(calls) >= DATA_SHARDS_COUNT
+        # 10 serial fetches would take >= 1.5 s; parallel ~0.15-0.3 s
+        assert elapsed < 1.0, f"survivor fetches look serial: {elapsed:.2f}s"
+        ev.close()
+
+    def test_degraded_read_survives_failing_survivors(self, encoded):
+        """First-10-wins with 3 of 13 remotes erroring/timing out."""
+        base, d = encoded
+        shard_bytes = {i: open(base + to_ext(i), "rb").read()
+                       for i in range(TOTAL_SHARDS_COUNT)}
+        ev = EcVolume(d, "", 1, large_block_size=LARGE,
+                      small_block_size=SMALL)
+
+        def flaky_remote(sid, offset, size):
+            if sid == 0:  # the target shard is lost cluster-wide
+                return None
+            if sid in (1, 5, 12):
+                raise OSError("connection refused")
+            return shard_bytes[sid][offset:offset + size]
+
+        ev.remote_reader = flaky_remote
+        assert ev.read_shard_span(0, 0, 64) == shard_bytes[0][:64]
+        ev.close()
+
     def test_too_many_missing_fails(self, encoded):
         base, d = encoded
         ev = EcVolume(d, "", 1, large_block_size=LARGE,
