@@ -436,11 +436,27 @@ class Filer:
 
     def list_directory(self, path: str, start_file: str = "",
                        limit: int = 1024, prefix: str = "",
-                       include_start: bool = False) -> list[Entry]:
-        # filter expired entries BEFORE the limit counts them, or a page
-        # of expired entries would truncate pagination and hide live
-        # entries sorted after it
+                       include_start: bool = False,
+                       name_pattern: str = "",
+                       name_pattern_exclude: str = "") -> list[Entry]:
+        """List children, filtering expired entries BEFORE the limit
+        counts them (a page of expired entries must not truncate
+        pagination) and applying optional glob patterns the way the
+        reference's filer_search.go does: a literal pattern head becomes
+        a store-side prefix, the rest matches fnmatch-style, and
+        name_pattern_exclude drops matching names."""
+        import fnmatch
+
         path = self._norm(path)
+        if name_pattern and not prefix:
+            # split the pattern at the first wildcard: the literal head
+            # narrows the store scan (splitPattern, filer_search.go:11-21)
+            cut = len(name_pattern)
+            for wc in "*?[":
+                pos = name_pattern.find(wc)
+                if pos >= 0:
+                    cut = min(cut, pos)
+            prefix = name_pattern[:cut]
         out: list[Entry] = []
         cursor, inc = start_file, include_start
         while len(out) < limit:
@@ -451,13 +467,56 @@ class Filer:
             if not batch:
                 break
             for e in batch:
-                if not self._expired(e):
-                    out.append(self._resolve_hardlink(e)
-                               if e.hard_link_id else e)
+                if self._expired(e):
+                    continue
+                if name_pattern and not fnmatch.fnmatchcase(
+                        e.name, name_pattern):
+                    continue
+                if name_pattern_exclude and fnmatch.fnmatchcase(
+                        e.name, name_pattern_exclude):
+                    continue
+                out.append(self._resolve_hardlink(e)
+                           if e.hard_link_id else e)
             cursor, inc = batch[-1].name, False
             if len(batch) < want:
                 break
         return out
+
+    # -- generic KV (filer_grpc_server_kv.go KvGet/KvPut) ---------------------
+    # Clients use this for small cluster-wide state.  Stored as raw
+    # store entries under a reserved prefix (every store kind inherits
+    # it); store-level access skips event notification like the
+    # reference's Store.KvPut does.
+    KV_DIR = "/etc/seaweedfs/kv"
+
+    def _kv_path(self, key: bytes) -> str:
+        return f"{self.KV_DIR}/{key.hex()}"
+
+    def kv_put(self, key: bytes, value: bytes):
+        """Set key -> value; empty value deletes (KvPut semantics)."""
+        if not value:
+            self.kv_delete(key)
+            return
+        entry = Entry(full_path=self._kv_path(key),
+                      attr=Attr(crtime=time.time(), mtime=time.time()))
+        entry.content = value
+        with self.lock:
+            self.store.insert_entry(entry)
+
+    def kv_get(self, key: bytes) -> Optional[bytes]:
+        """Value for key, or None when absent (ErrKvNotFound -> empty)."""
+        try:
+            return bytes(self.store.find_entry(
+                self._kv_path(key)).content)
+        except NotFoundError:
+            return None
+
+    def kv_delete(self, key: bytes):
+        with self.lock:
+            try:
+                self.store.delete_entry(self._kv_path(key))
+            except NotFoundError:
+                pass
 
     def rename(self, old_path: str, new_path: str):
         """Atomic single-entry rename + recursive subtree move

@@ -23,6 +23,7 @@ from typing import Optional
 
 from ..rpc.http_rpc import Request, Response, RpcError, RpcServer, call
 from ..security import Guard, gen_read_jwt, gen_write_jwt
+from ..storage.needle import PAIR_NAME_PREFIX
 from .entry import Attr, Entry, FileChunk, total_size
 from .filechunk_manifest import (MANIFEST_BATCH, has_chunk_manifest,
                                  maybe_manifestize, resolve_chunk_manifest)
@@ -99,6 +100,10 @@ class FilerServer:
         self.server.add("POST", "/remote/meta_sync", self._h_remote_meta_sync)
         self.server.add("POST", "/remote/cache", self._h_remote_cache)
         self.server.add("POST", "/remote/uncache", self._h_remote_uncache)
+        # generic KV (the HTTP/JSON face of filer_grpc_server_kv.go)
+        self.server.add("GET", "/kv/get", self._h_kv_get)
+        self.server.add("POST", "/kv/put", self._h_kv_put)
+        self.server.add("POST", "/kv/delete", self._h_kv_delete)
         self.server.default_route = self._handle
         self._stop_event = threading.Event()
         self._register_thread: Optional[threading.Thread] = None
@@ -225,6 +230,10 @@ class FilerServer:
 
     # -- write (auto-chunk) --------------------------------------------------
     def _h_write(self, path: str, req: Request):
+        if "tagging" in req.query:
+            # add/replace Seaweed- prefixed attributes from headers
+            # (PutTaggingHandler, filer_server_handlers_tagging.go:16-54)
+            return self._h_put_tagging(path, req)
         move_from = req.param("mv.from")
         if move_from:
             self._check_writable(move_from)
@@ -255,9 +264,97 @@ class FilerServer:
 
         body = req.body
         mime = req.headers.get("Content-Type") or ""
-        entry = self.save_bytes(path, body, mime)
+        entry = self.save_bytes(path, body, mime,
+                                extended=self._seaweed_headers(req))
         return {"name": entry.name, "size": len(body),
                 "md5": entry.attr.md5}
+
+    @staticmethod
+    def _is_tag(name) -> bool:
+        """Case-insensitive Seaweed- prefix test, used consistently by
+        the write, read, response-header, and delete paths (clients and
+        HTTP/2 intermediaries may lowercase header names)."""
+        return isinstance(name, str) and \
+            name.lower().startswith(PAIR_NAME_PREFIX.lower())
+
+    @staticmethod
+    def _seaweed_headers(req: Request) -> dict:
+        """Seaweed- prefixed request headers become extended attributes
+        (needle.PairNamePrefix pass-through, the tagging surface)."""
+        out = {}
+        for name in req.headers:
+            if FilerServer._is_tag(name):
+                out[name] = req.headers[name]
+        return out
+
+    def _h_put_tagging(self, path: str, req: Request):
+        self._check_writable(path)
+        try:
+            entry = self.filer.find_entry(path)
+        except NotFoundError:
+            raise RpcError(f"{path} not found", 404)
+        entry.extended = dict(entry.extended or {})
+        entry.extended.update(self._seaweed_headers(req))
+        self.filer.update_entry(entry)
+        return Response(b"", 202)
+
+    def _h_delete_tagging(self, path: str, req: Request):
+        """Remove all (or the listed) Seaweed- attributes
+        (DeleteTaggingHandler: ?tagging=tag1,tag2 picks specific tags)."""
+        self._check_writable(path)
+        try:
+            entry = self.filer.find_entry(path)
+        except NotFoundError:
+            raise RpcError(f"{path} not found", 404)
+        wanted = {t.strip().lower() for t in
+                  (req.param("tagging") or "").split(",") if t.strip()}
+        kept, dropped = {}, False
+        for k, v in (entry.extended or {}).items():
+            if self._is_tag(k) and (
+                    not wanted
+                    or k[len(PAIR_NAME_PREFIX):].lower() in wanted):
+                dropped = True
+                continue
+            kept[k] = v
+        if not dropped:
+            return Response(b"", 304)
+        entry.extended = kept
+        self.filer.update_entry(entry)
+        return Response(b"", 202)
+
+    def _proxy_chunk(self, file_id: str, req: Request):
+        """Relay one chunk through the filer
+        (filer_server_handlers_proxy.go proxyToVolumeServer).  Range
+        requests fetch the whole chunk and slice locally so the reply
+        carries a correct 206 + Content-Range (forwarding the Range and
+        rewrapping as 200 would mislabel a partial body as complete)."""
+        url = self._lookup_url(file_id)
+        try:
+            data = call(url, f"/{file_id}", timeout=30)
+        except RpcError as e:
+            raise RpcError(f"proxy chunk {file_id}: {e}", e.status or 502)
+        if not isinstance(data, (bytes, bytearray)):
+            import json as _json
+
+            data = _json.dumps(data).encode()
+        data = bytes(data)
+        range_header = req.headers.get("Range", "")
+        if range_header.startswith("bytes="):
+            size = len(data)
+            spec = range_header[6:].split(",")[0]
+            lo_s, _, hi_s = spec.partition("-")
+            if lo_s:
+                start = int(lo_s)
+                stop = min(int(hi_s), size - 1) + 1 if hi_s else size
+            else:  # suffix range
+                start = max(0, size - int(hi_s or 0))
+                stop = size
+            if start >= size or stop <= start:
+                raise RpcError("range not satisfiable", 416)
+            return Response(
+                data[start:stop], 206, "application/octet-stream",
+                {"Content-Range": f"bytes {start}-{stop - 1}/{size}"})
+        return Response(data, 200, "application/octet-stream")
 
     def _upload_blob(self, piece: bytes, replication: str = "",
                      collection: str = "", ttl: str = "") -> FileChunk:
@@ -490,10 +587,20 @@ class FilerServer:
 
     # -- read ----------------------------------------------------------------
     def _h_read(self, path: str, req: Request, method: str):
+        proxy_chunk = req.param("proxyChunkId")
+        if proxy_chunk:
+            # direct filer->volume chunk relay for clients that cannot
+            # reach volume servers (filer_server_handlers_proxy.go)
+            return self._proxy_chunk(proxy_chunk, req)
         try:
             entry = self.filer.find_entry(path)
         except NotFoundError:
             raise RpcError(f"{path} not found", 404)
+        if "tagging" in req.query:
+            # object tags as JSON (the Seaweed- extended attributes;
+            # write with PUT ?tagging, remove with DELETE ?tagging)
+            return {k: v for k, v in (entry.extended or {}).items()
+                    if self._is_tag(k)}
         if entry.is_directory:
             if "text/html" in (req.headers.get("Accept") or ""):
                 return self._render_ui(entry)  # browser surface
@@ -528,6 +635,11 @@ class FilerServer:
             content_type = "application/octet-stream"
         headers["Etag"] = f'"{entry.attr.md5 or etag_of_chunks(entry.chunks)}"'
         headers["Accept-Ranges"] = "bytes"
+        for k, v in (entry.extended or {}).items():
+            # tags ride responses as Seaweed- headers (the reference's
+            # read path exposes PairNamePrefix attributes this way)
+            if self._is_tag(k) and isinstance(v, str):
+                headers[k] = v
         if method == "HEAD":
             headers["Content-Length"] = str(length)
             return Response(b"", status, content_type, headers)
@@ -538,8 +650,11 @@ class FilerServer:
     def _list_directory(self, entry: Entry, req: Request):
         limit = int(req.param("limit", "100"))
         last = req.param("lastFileName", "") or ""
-        entries = self.filer.list_directory(entry.full_path,
-                                            start_file=last, limit=limit)
+        entries = self.filer.list_directory(
+            entry.full_path, start_file=last, limit=limit,
+            prefix=req.param("prefix", "") or "",
+            name_pattern=req.param("namePattern", "") or "",
+            name_pattern_exclude=req.param("namePatternExclude", "") or "")
         if req.param("metadata") == "true":
             # full entry dicts incl. chunks (fs.meta.cat / fsck surface)
             rendered = [e.to_dict() for e in entries]
@@ -564,6 +679,8 @@ class FilerServer:
 
     # -- delete --------------------------------------------------------------
     def _h_delete(self, path: str, req: Request):
+        if "tagging" in req.query:
+            return self._h_delete_tagging(path, req)
         self._check_writable(path)
         recursive = req.param("recursive") == "true"
         try:
@@ -731,6 +848,44 @@ class FilerServer:
         return {"uncached": uncached}
 
     # -- metadata subscription ----------------------------------------------
+    # -- generic KV (filer_grpc_server_kv.go over the HTTP substrate) --------
+    @staticmethod
+    def _b64(value: str, urlsafe: bool = False) -> bytes:
+        import base64
+        import binascii
+
+        try:
+            decode = base64.urlsafe_b64decode if urlsafe \
+                else base64.b64decode
+            return decode(value or "")
+        except (binascii.Error, ValueError):
+            raise RpcError("malformed base64", 400)
+
+    def _h_kv_get(self, req: Request):
+        import base64
+
+        key = self._b64(req.param("key", "") or "", urlsafe=True)
+        if not key:
+            raise RpcError("missing key", 400)
+        value = self.filer.kv_get(key)
+        return {"value": base64.b64encode(value).decode()
+                if value is not None else None}
+
+    def _h_kv_put(self, req: Request):
+        body = req.json()
+        key = self._b64(body.get("key", ""))
+        if not key:
+            raise RpcError("missing key", 400)
+        self.filer.kv_put(key, self._b64(body.get("value", "")))
+        return {}
+
+    def _h_kv_delete(self, req: Request):
+        key = self._b64(req.json().get("key", ""))
+        if not key:
+            raise RpcError("missing key", 400)
+        self.filer.kv_delete(key)
+        return {}
+
     def _h_subscribe(self, req: Request):
         since = int(req.param("since", "0"))
         prefix = req.param("pathPrefix", "/") or "/"

@@ -1,0 +1,160 @@
+"""Shared out-of-process filer store: a store SERVICE + a client store.
+
+The reference's redis family (weed/filer/redis/universal_redis_store.go:
+20-130, redis2/, redis_lua/) lets many STATELESS filers share one
+metadata store — an HA mode the embedded stores (filer_store.py) cannot
+provide.  No redis server exists in this image, so the same capability is
+built on the repo's own RPC substrate: `weed filer.store` hosts any
+embedded store kind behind HTTP/JSON routes, and RemoteStore is a
+FilerStore client speaking to it over pooled keep-alive connections.
+Filers configured with `-store remote -storeAddress host:port` keep no
+local metadata at all — kill one, start another, same namespace.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from ..rpc.http_rpc import Request, RpcError, RpcServer, call
+from .entry import Entry
+from .filer_store import (FilerStore, MemoryStore, NotFoundError,
+                          PerBucketStoreRouter, ShardedSqliteStore,
+                          SqliteStore)
+
+
+def make_store(kind: str, directory: Optional[str] = None) -> FilerStore:
+    """Construct an embedded store by kind name (shared by the filer CLI
+    and the store service)."""
+    import os
+
+    if kind in ("memory", ""):
+        return MemoryStore()
+    if directory is None:
+        raise ValueError(f"store kind {kind!r} needs a directory")
+    os.makedirs(directory, exist_ok=True)
+    if kind == "sqlite":
+        return SqliteStore(os.path.join(directory, "filer.db"))
+    if kind == "sharded":
+        return ShardedSqliteStore(os.path.join(directory, "meta"))
+    if kind == "perbucket":
+        return PerBucketStoreRouter(os.path.join(directory, "meta"))
+    raise ValueError(f"unknown store kind {kind!r}")
+
+
+class FilerStoreServer:
+    """`weed filer.store`: host one embedded store for many filers."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 store: Optional[FilerStore] = None):
+        self.store = store or MemoryStore()
+        # one writer lock: the embedded stores are already thread-safe,
+        # but insert/update of the SAME path from two filers must not
+        # interleave partially (universal_redis_store serialises per key
+        # through redis itself)
+        self._lock = threading.RLock()
+        self.server = RpcServer(host, port)
+        self.server.add("POST", "/store/insert", self._h_insert)
+        self.server.add("POST", "/store/update", self._h_insert)
+        self.server.add("GET", "/store/find", self._h_find)
+        self.server.add("POST", "/store/delete", self._h_delete)
+        self.server.add("POST", "/store/delete_children",
+                        self._h_delete_children)
+        self.server.add("GET", "/store/list", self._h_list)
+        self.server.add("GET", "/store/status", self._h_status)
+
+    @property
+    def address(self) -> str:
+        return self.server.address
+
+    def start(self):
+        self.server.start()
+
+    def stop(self):
+        self.server.stop()
+        self.store.close()
+
+    def _h_insert(self, req: Request):
+        entry = Entry.from_dict(req.json())
+        with self._lock:
+            self.store.insert_entry(entry)
+        return {}
+
+    def _h_find(self, req: Request):
+        path = req.param("path", "") or "/"
+        try:
+            return self.store.find_entry(path).to_dict()
+        except NotFoundError:
+            raise RpcError(f"{path} not found", 404)
+
+    def _h_delete(self, req: Request):
+        with self._lock:
+            self.store.delete_entry(req.json().get("path", ""))
+        return {}
+
+    def _h_delete_children(self, req: Request):
+        with self._lock:
+            self.store.delete_folder_children(req.json().get("path", ""))
+        return {}
+
+    def _h_list(self, req: Request):
+        entries = self.store.list_directory(
+            req.param("dir", "") or "/",
+            start_file=req.param("start", "") or "",
+            include_start=req.param("include_start") == "true",
+            limit=int(req.param("limit", "1024")),
+            prefix=req.param("prefix", "") or "")
+        return {"entries": [e.to_dict() for e in entries]}
+
+    def _h_status(self, req: Request):
+        return {"store": type(self.store).__name__}
+
+
+class RemoteStore(FilerStore):
+    """FilerStore client against a FilerStoreServer — the stateless-filer
+    mode.  Every operation is one pooled keep-alive round trip (the
+    substrate retries per rpc/http_rpc's phase-split policy)."""
+
+    def __init__(self, address: str, timeout: float = 20.0):
+        self.address = address
+        self.timeout = timeout
+
+    def _call(self, path: str, payload=None, method: str = "GET"):
+        try:
+            return call(self.address, path, payload=payload,
+                        method=method, timeout=self.timeout)
+        except RpcError as e:
+            if e.status == 404:
+                raise NotFoundError(str(e))
+            raise
+
+    def insert_entry(self, entry: Entry):
+        self._call("/store/insert", payload=entry.to_dict(),
+                   method="POST")
+
+    update_entry = insert_entry
+
+    def find_entry(self, path: str) -> Entry:
+        import urllib.parse
+
+        return Entry.from_dict(self._call(
+            "/store/find?path=" + urllib.parse.quote(path, safe="/")))
+
+    def delete_entry(self, path: str):
+        self._call("/store/delete", payload={"path": path}, method="POST")
+
+    def delete_folder_children(self, path: str):
+        self._call("/store/delete_children", payload={"path": path},
+                   method="POST")
+
+    def list_directory(self, dir_path: str, start_file: str = "",
+                       include_start: bool = False, limit: int = 1024,
+                       prefix: str = "") -> list[Entry]:
+        import urllib.parse
+
+        q = urllib.parse.urlencode({
+            "dir": dir_path, "start": start_file,
+            "include_start": "true" if include_start else "false",
+            "limit": str(limit), "prefix": prefix})
+        out = self._call("/store/list?" + q)
+        return [Entry.from_dict(d) for d in out.get("entries", [])]

@@ -92,3 +92,115 @@ def load_notification_queue(conf) -> Optional[NotificationQueue]:
         topic = str(conf.get("notification.kafka.topic", "seaweedfs"))
         return KafkaQueue(hosts, topic)
     return None
+
+
+# -- notification INPUTS (weed/replication/sub): the consumer half ----------
+# `weed filer.replicate` reads events back OUT of the queue and applies
+# them through replication/replicator.py — the MQ-driven replication mode
+# (command/filer_replication.go:24-100), vs filer.sync's direct
+# subscribe-driven mode.
+
+
+class NotificationInput:
+    """Consumer interface (sub.NotificationInput): receive_message
+    returns (key, event) or None when the queue is drained; ack()
+    persists consumption so restarts resume where they left off."""
+
+    name = "none"
+
+    def receive_message(self) -> Optional[tuple[str, dict]]:
+        raise NotImplementedError
+
+    def ack(self):
+        pass
+
+    def close(self):
+        pass
+
+
+class FileQueueInput(NotificationInput):
+    """Tail the FileQueue's JSON-lines file with a durable byte offset —
+    the consumer half of the air-gapped queue stand-in."""
+
+    name = "file"
+
+    def __init__(self, path: str, offset_path: Optional[str] = None):
+        self.path = path
+        self.offset_path = offset_path or path + ".offset"
+        self._offset = 0
+        try:
+            with open(self.offset_path) as f:
+                self._offset = int(f.read().strip() or 0)
+        except (FileNotFoundError, ValueError):
+            pass
+        self._pending: Optional[int] = None  # offset after unacked msg
+
+    def receive_message(self) -> Optional[tuple[str, dict]]:
+        try:
+            with open(self.path, "rb") as f:
+                f.seek(self._offset)
+                line = f.readline()
+        except FileNotFoundError:
+            return None
+        if not line or not line.endswith(b"\n"):
+            return None  # nothing new / torn tail write — retry later
+        self._pending = self._offset + len(line)
+        record = json.loads(line)
+        key = record.pop("key", "")
+        return key, record
+
+    def ack(self):
+        if self._pending is None:
+            return
+        self._offset = self._pending
+        self._pending = None
+        with open(self.offset_path, "w") as f:
+            f.write(str(self._offset))
+
+
+class KafkaQueueInput(NotificationInput):
+    """Kafka consumer input; requires a kafka client library."""
+
+    name = "kafka"
+
+    def __init__(self, hosts: list[str], topic: str,
+                 group: str = "seaweedfs-replicate"):
+        try:
+            from kafka import KafkaConsumer  # type: ignore
+        except ImportError as e:
+            raise RuntimeError(
+                "kafka notification input needs the kafka-python "
+                "package, which is not installed in this environment"
+            ) from e
+        self.consumer = KafkaConsumer(topic, bootstrap_servers=hosts,
+                                      group_id=group,
+                                      enable_auto_commit=False)
+
+    def receive_message(self) -> Optional[tuple[str, dict]]:
+        batch = self.consumer.poll(timeout_ms=1000, max_records=1)
+        for records in batch.values():
+            for r in records:
+                return (r.key or b"").decode(), json.loads(r.value)
+        return None
+
+    def ack(self):
+        self.consumer.commit()
+
+    def close(self):
+        self.consumer.close()
+
+
+def load_notification_input(conf) -> Optional[NotificationInput]:
+    """Consumer counterpart of load_notification_queue."""
+    if conf is None:
+        return None
+    if conf.get_bool("notification.file.enabled"):
+        path = str(conf.get("notification.file.path",
+                            "filer_events.jsonl"))
+        return FileQueueInput(path)
+    if conf.get_bool("notification.kafka.enabled"):
+        hosts = str(conf.get("notification.kafka.hosts",
+                             "localhost:9092")).split(",")
+        topic = str(conf.get("notification.kafka.topic", "seaweedfs"))
+        return KafkaQueueInput(hosts, topic)
+    return None

@@ -184,3 +184,33 @@ class Replicator:
         if fail_ts:
             return applied, fail_ts - 1
         return applied, max(e["ts_ns"] for e in events)
+
+
+def run_from_queue(queue_input, replicator: Replicator,
+                   once: bool = False, idle_sleep: float = 1.0,
+                   stop_event=None) -> int:
+    """`weed filer.replicate` core loop (filer_replication.go:80-100):
+    consume metadata events from a notification INPUT and apply each
+    through the replicator, acking only after a successful apply so a
+    crash retries the in-flight event.  Returns events applied (loops
+    forever unless `once`, which drains the queue and returns)."""
+    applied = 0
+    while stop_event is None or not stop_event.is_set():
+        msg = queue_input.receive_message()
+        if msg is None:
+            if once:
+                return applied
+            time.sleep(idle_sleep)
+            continue
+        key, event = msg
+        try:
+            if replicator.replicate(event):
+                applied += 1
+        except Exception as e:
+            glog.errorf("filer.replicate %s: %s (will retry)", key, e)
+            if once:
+                return applied
+            time.sleep(idle_sleep)
+            continue  # NOT acked: the message replays next poll
+        queue_input.ack()
+    return applied

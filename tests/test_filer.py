@@ -55,10 +55,33 @@ class TestChunkModel:
         assert multi.endswith("-2")
 
 
+_remote_store_servers = []
+
+
+def _remote_store(tmp):
+    """Factory for the shared-store conformance rows: a live
+    FilerStoreServer + RemoteStore client (the redis-family analogue)."""
+    from seaweedfs_tpu.filer.store_server import (FilerStoreServer,
+                                                  RemoteStore)
+
+    srv = FilerStoreServer(port=0)
+    srv.start()
+    _remote_store_servers.append(srv)
+    return RemoteStore(srv.address)
+
+
+@pytest.fixture(autouse=True)
+def _stop_remote_store_servers():
+    yield
+    while _remote_store_servers:
+        _remote_store_servers.pop().stop()
+
+
 @pytest.mark.parametrize("store_factory", [
     lambda tmp: MemoryStore(),
     lambda tmp: SqliteStore(str(tmp / "meta.db")),
-], ids=["memory", "sqlite"])
+    _remote_store,
+], ids=["memory", "sqlite", "remote"])
 class TestStoreConformance:
     """Shared store harness (the filer/store_test analogue)."""
 
@@ -357,3 +380,199 @@ class TestPathTtlRules:
         with pytest.raises(NotFoundError):
             f.find_entry("/t/old.bin")
         assert f.find_entry("/t/fresh.bin").content == b"y"
+
+
+class TestFilerApiParity:
+    """Round-4 parity surfaces: object tagging, generic KV, glob listing,
+    chunk proxy (filer_server_handlers_tagging.go, filer_grpc_server_kv.go,
+    filer_search.go, filer_server_handlers_proxy.go)."""
+
+    @pytest.fixture
+    def stack(self, tmp_path):
+        from seaweedfs_tpu.filer.server import FilerServer
+        from seaweedfs_tpu.master.server import MasterServer
+        from seaweedfs_tpu.volume_server.server import VolumeServer
+
+        master = MasterServer(port=0, pulse_seconds=0.2)
+        master.start()
+        d = tmp_path / "v"
+        d.mkdir()
+        vs = VolumeServer([str(d)], master.address, port=0,
+                          pulse_seconds=0.2)
+        vs.start()
+        vs.heartbeat_once()
+        filer = FilerServer(master.address, port=0, chunk_size=1024)
+        filer.start()
+        yield master, vs, filer
+        filer.stop()
+        vs.stop()
+        master.stop()
+
+    def test_object_tagging_lifecycle(self, stack):
+        import urllib.request
+
+        from seaweedfs_tpu.rpc.http_rpc import call
+
+        master, vs, filer = stack
+        call(filer.address, "/t/file.txt", raw=b"data", method="POST")
+        # PUT ?tagging with Seaweed- headers
+        call(filer.address, "/t/file.txt?tagging", raw=b"",
+             method="PUT", headers={"Seaweed-Color": "blue",
+                                    "Seaweed-Owner": "ops",
+                                    "X-Other": "ignored"})
+        tags = call(filer.address, "/t/file.txt?tagging")
+        assert tags == {"Seaweed-Color": "blue", "Seaweed-Owner": "ops"}
+        # tags ride normal GETs as response headers
+        with urllib.request.urlopen(
+                f"http://{filer.address}/t/file.txt") as resp:
+            assert resp.headers["Seaweed-Color"] == "blue"
+            assert resp.read() == b"data"
+        # DELETE ?tagging=Color removes just that tag
+        call(filer.address, "/t/file.txt?tagging=Color", method="DELETE")
+        tags = call(filer.address, "/t/file.txt?tagging")
+        assert tags == {"Seaweed-Owner": "ops"}
+        # DELETE ?tagging removes the rest
+        call(filer.address, "/t/file.txt?tagging", method="DELETE")
+        assert call(filer.address, "/t/file.txt?tagging") == {}
+
+    def test_kv_api(self, stack):
+        import base64
+
+        from seaweedfs_tpu.rpc.http_rpc import call
+
+        master, vs, filer = stack
+        key = base64.b64encode(b"cluster/state").decode()
+        call(filer.address, "/kv/put", method="POST",
+             payload={"key": key,
+                      "value": base64.b64encode(b"v1").decode()})
+        got = call(filer.address,
+                   "/kv/get?key="
+                   + base64.urlsafe_b64encode(b"cluster/state").decode())
+        assert base64.b64decode(got["value"]) == b"v1"
+        # empty value deletes (KvPut semantics)
+        call(filer.address, "/kv/put", method="POST",
+             payload={"key": key, "value": ""})
+        got = call(filer.address,
+                   "/kv/get?key="
+                   + base64.urlsafe_b64encode(b"cluster/state").decode())
+        assert got["value"] is None
+        # kv entries never appear in plain listings of /
+        listing = call(filer.address, "/?limit=100")
+        names = [e["FullPath"] for e in listing["Entries"]]
+        assert all("/etc" == n or not n.startswith("/etc/seaweedfs/kv")
+                   for n in names)
+
+    def test_glob_listing(self, stack):
+        from seaweedfs_tpu.rpc.http_rpc import call
+
+        master, vs, filer = stack
+        for name in ("a1.log", "a2.log", "a2.txt", "b1.log", "readme"):
+            call(filer.address, f"/g/{name}", raw=b"x", method="POST")
+        out = call(filer.address, "/g/?namePattern=*.log")
+        names = [e["FullPath"].rsplit("/", 1)[1] for e in out["Entries"]]
+        assert names == ["a1.log", "a2.log", "b1.log"]
+        out = call(filer.address, "/g/?namePattern=a%3F.log")
+        names = [e["FullPath"].rsplit("/", 1)[1] for e in out["Entries"]]
+        assert names == ["a1.log", "a2.log"]
+        out = call(filer.address,
+                   "/g/?namePattern=a*&namePatternExclude=*.txt")
+        names = [e["FullPath"].rsplit("/", 1)[1] for e in out["Entries"]]
+        assert names == ["a1.log", "a2.log"]
+
+    def test_chunk_proxy(self, stack):
+        import urllib.request
+
+        from seaweedfs_tpu.rpc.http_rpc import call
+
+        master, vs, filer = stack
+        payload = bytes(range(256)) * 40  # 10240 -> chunked at 1024
+        call(filer.address, "/p/blob.bin", raw=payload, method="POST")
+        entry = filer.filer.find_entry("/p/blob.bin")
+        assert entry.chunks
+        fid = entry.chunks[0].fid
+        got = call(filer.address, f"/?proxyChunkId={fid}")
+        assert bytes(got) == payload[:entry.chunks[0].size]
+        # ranged proxy read: proper 206 + Content-Range, correct slice
+        req = urllib.request.Request(
+            f"http://{filer.address}/?proxyChunkId={fid}",
+            headers={"Range": "bytes=100-199"})
+        with urllib.request.urlopen(req) as resp:
+            assert resp.status == 206
+            assert resp.headers["Content-Range"] == \
+                f"bytes 100-199/{entry.chunks[0].size}"
+            assert resp.read() == payload[100:200]
+
+    def test_lowercase_tag_headers_roundtrip(self, stack):
+        """HTTP/2-style clients lowercase header names: tags must still
+        read back and delete (round-4 review finding)."""
+        from seaweedfs_tpu.rpc.http_rpc import call
+
+        master, vs, filer = stack
+        call(filer.address, "/t/lower.txt", raw=b"x", method="POST")
+        call(filer.address, "/t/lower.txt?tagging", raw=b"",
+             method="PUT", headers={"seaweed-shade": "grey"})
+        tags = call(filer.address, "/t/lower.txt?tagging")
+        assert tags == {"seaweed-shade": "grey"}
+        call(filer.address, "/t/lower.txt?tagging=Shade", method="DELETE")
+        assert call(filer.address, "/t/lower.txt?tagging") == {}
+
+    def test_kv_malformed_base64_is_400(self, stack):
+        from seaweedfs_tpu.rpc.http_rpc import RpcError, call
+
+        master, vs, filer = stack
+        with pytest.raises(RpcError) as ei:
+            call(filer.address, "/kv/get?key=%21not-base64%21")
+        assert ei.value.status == 400
+        with pytest.raises(RpcError) as ei:
+            call(filer.address, "/kv/put", method="POST",
+                 payload={"key": "!bad!", "value": ""})
+        assert ei.value.status == 400
+
+
+class TestSharedStore:
+    """Two STATELESS filers over one `weed filer.store` service share a
+    namespace (the reference's redis-store HA mode,
+    universal_redis_store.go: filers keep no local metadata)."""
+
+    def test_two_filers_one_namespace(self):
+        from seaweedfs_tpu.filer.server import FilerServer
+        from seaweedfs_tpu.filer.store_server import (FilerStoreServer,
+                                                      RemoteStore)
+        from seaweedfs_tpu.rpc.http_rpc import RpcError, call
+
+        srv = FilerStoreServer(port=0)
+        srv.start()
+        fa = FilerServer(master_address="127.0.0.1:1",
+                         store=RemoteStore(srv.address))
+        fb = FilerServer(master_address="127.0.0.1:1",
+                         store=RemoteStore(srv.address))
+        fa.server.start()
+        fb.server.start()
+        try:
+            # write via A (small -> inlined, no volume cluster needed)
+            call(fa.address, "/shared/hello.txt", raw=b"from-A",
+                 method="POST")
+            # read via B: same namespace, no replication hop
+            assert call(fb.address, "/shared/hello.txt") == b"from-A"
+            # tag via B, visible via A
+            call(fb.address, "/shared/hello.txt?tagging", raw=b"",
+                 method="PUT", headers={"Seaweed-Team": "infra"})
+            tags = call(fa.address, "/shared/hello.txt?tagging")
+            assert tags == {"Seaweed-Team": "infra"}
+            # delete via B, gone via A
+            call(fb.address, "/shared/hello.txt", method="DELETE")
+            with pytest.raises(RpcError):
+                call(fa.address, "/shared/hello.txt")
+            # "failover": a brand-new stateless filer sees everything
+            fc = FilerServer(master_address="127.0.0.1:1",
+                             store=RemoteStore(srv.address))
+            fc.server.start()
+            try:
+                listing = call(fc.address, "/shared/")
+                assert listing["Entries"] == []
+            finally:
+                fc.server.stop()
+        finally:
+            fa.server.stop()
+            fb.server.stop()
+            srv.stop()

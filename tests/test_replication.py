@@ -238,3 +238,44 @@ class TestConcurrentSync:
         # idempotent catch-up: nothing new
         applied2, cursor2 = rep.run_once(cursor, concurrency=4)
         assert applied2 == 0 and cursor2 == cursor
+
+
+class TestQueueDrivenReplication:
+    """`weed filer.replicate`: the MQ-driven consumer — events flow
+    filer -> notification FileQueue -> FileQueueInput -> Replicator ->
+    sink (command/filer_replication.go), closing the loop on the
+    notification subsystem's producer half."""
+
+    def test_filequeue_roundtrip(self, two_clusters, tmp_path):
+        from seaweedfs_tpu.notification import FileQueue, FileQueueInput
+        from seaweedfs_tpu.replication.replicator import run_from_queue
+
+        (ma, va, fa), (mb, vb, fb) = two_clusters
+        qpath = str(tmp_path / "events.jsonl")
+        fa.filer.notification_queue = FileQueue(qpath)
+        bodies = {}
+        for i in range(10):
+            body = (b"mq-%02d-" % i) * 40
+            put(fa, f"/src/q{i % 2}/f{i}.bin", body)
+            bodies[f"/dst/q{i % 2}/f{i}.bin"] = body
+        put(fa, "/src/q0/gone.bin", b"to-delete")
+        call(fa.address, "/src/q0/gone.bin", method="DELETE")
+
+        rep = Replicator(FilerSource(fa.address, "/src/"),
+                         FilerSink(fb.address, "/dst/"))
+        qin = FileQueueInput(qpath)
+        applied = run_from_queue(qin, rep, once=True)
+        assert applied >= 10
+        for path, body in bodies.items():
+            assert get(fb, path) == body
+        from seaweedfs_tpu.filer.filer_store import NotFoundError
+        with pytest.raises(Exception):
+            fb.filer.find_entry("/dst/q0/gone.bin")
+
+        # durable offset: a fresh consumer replays nothing
+        qin2 = FileQueueInput(qpath)
+        assert run_from_queue(qin2, rep, once=True) == 0
+        # new events resume from the offset
+        put(fa, "/src/q1/late.bin", b"late arrival")
+        assert run_from_queue(FileQueueInput(qpath), rep, once=True) == 1
+        assert get(fb, "/dst/q1/late.bin") == b"late arrival"

@@ -140,14 +140,22 @@ def cmd_volume(args):
     _wait_forever([vs])
 
 
-def _make_filer_store(kind: str, path: str):
+def _make_filer_store(kind: str, path: str, store_address: str = ""):
     from seaweedfs_tpu.filer.filer_store import (PerBucketStoreRouter,
                                                  ShardedSqliteStore,
                                                  SqliteStore)
 
+    if kind == "remote":
+        # stateless filer against a shared `weed filer.store` service
+        # (the redis-family HA mode, universal_redis_store.go)
+        from seaweedfs_tpu.filer.store_server import RemoteStore
+
+        if not store_address:
+            raise SystemExit("-store remote needs -storeAddress host:port")
+        return RemoteStore(store_address)
     if kind not in ("sqlite", "sharded", "perbucket"):
         raise SystemExit(f"unknown filer store kind {kind!r} "
-                         "(sqlite | sharded | perbucket)")
+                         "(sqlite | sharded | perbucket | remote)")
     if not path:
         if kind != "sqlite":
             raise SystemExit(
@@ -160,10 +168,24 @@ def _make_filer_store(kind: str, path: str):
     return PerBucketStoreRouter(path)
 
 
+def cmd_filer_store(args):
+    """`weed filer.store`: host one shared metadata store for many
+    stateless filers (-store remote)."""
+    from seaweedfs_tpu.filer.store_server import (FilerStoreServer,
+                                                  make_store)
+
+    store = make_store(args.db_kind, args.dir)
+    s = FilerStoreServer(host=args.ip, port=args.port, store=store)
+    s.start()
+    print(f"filer.store ({args.db_kind}) listening on {s.address}")
+    _wait_forever([s])
+
+
 def cmd_filer(args):
     from seaweedfs_tpu.filer.server import FilerServer
 
-    store = _make_filer_store(args.store, args.db)
+    store = _make_filer_store(args.store, args.db,
+                              getattr(args, "storeAddress", ""))
     f = FilerServer(args.master, host=args.ip, port=args.port, store=store,
                     chunk_size=args.maxMB * 1024 * 1024,
                     replication=args.replication,
@@ -286,7 +308,8 @@ def cmd_server(args):
     print(f"volume server on {vs.address}")
 
     if args.filer or args.s3 or args.iam:
-        store = _make_filer_store(args.store, args.db)
+        store = _make_filer_store(args.store, args.db,
+                                  getattr(args, "storeAddress", ""))
         filer = FilerServer(master.address, host=args.ip,
                             port=args.filerPort, store=store, guard=guard,
                             cipher=args.encryptVolumeData)
@@ -661,6 +684,35 @@ def cmd_filer_backup(args):
             _time.sleep(args.interval)
 
 
+def cmd_filer_replicate(args):
+    """MQ-driven replication consumer (weed/command/filer_replication.go):
+    events arrive from the notification queue configured in
+    notification.toml, not from a live filer subscription."""
+    import time as _time
+
+    from seaweedfs_tpu.notification import load_notification_input
+    from seaweedfs_tpu.replication import FilerSource, Replicator, make_sink
+    from seaweedfs_tpu.replication.replicator import run_from_queue
+    from seaweedfs_tpu.util.config import load_configuration
+
+    queue_input = load_notification_input(load_configuration("notification"))
+    if queue_input is None:
+        raise SystemExit(
+            "no notification input defined in notification.toml "
+            "(enable notification.file or notification.kafka)")
+    sink = make_sink(args.sink, access_key=args.accessKey,
+                     secret_key=args.secretKey,
+                     is_incremental=args.incremental)
+    source = FilerSource(args.filer, args.filerPath)
+    rep = Replicator(source, sink,
+                     exclude_dirs=[d for d in args.exclude.split(",") if d])
+    print(f"filer.replicate: {queue_input.name} queue -> {args.sink}")
+    applied = run_from_queue(queue_input, rep, once=args.once,
+                             idle_sleep=args.interval)
+    if args.once:
+        print(f"applied {applied} events")
+
+
 def cmd_filer_meta_backup(args):
     """Metadata-only backup into a local sqlite store
     (weed/command/filer_meta_backup.go)."""
@@ -1016,7 +1068,9 @@ def main(argv=None):
     p.add_argument("-maxMB", type=int, default=4)
     p.add_argument("-db", default="", help="sqlite path (default: memory)")
     p.add_argument("-store", default="sqlite",
-                   help="store kind: sqlite | sharded | perbucket")
+                   help="store kind: sqlite | sharded | perbucket | remote")
+    p.add_argument("-storeAddress", default="",
+                   help="shared `weed filer.store` address (-store remote)")
     p.add_argument("-replication", default="")
     p.add_argument("-collection", default="")
     p.add_argument("-peers", default="",
@@ -1031,6 +1085,18 @@ def main(argv=None):
     p.add_argument("-cacheCapacityMB", type=int, default=1024,
                    help="on-disk chunk cache budget (with -cacheDir)")
     p.set_defaults(fn=cmd_filer)
+
+    p = sub.add_parser("filer.store",
+                       help="host one shared metadata store for many "
+                            "stateless filers (-store remote)")
+    p.add_argument("-ip", default="127.0.0.1")
+    p.add_argument("-port", type=int, default=8889)
+    p.add_argument("-dir", default="",
+                   help="persistence directory (default: memory)")
+    p.add_argument("-db_kind", default="memory",
+                   help="embedded kind: memory | sqlite | sharded | "
+                        "perbucket")
+    p.set_defaults(fn=cmd_filer_store)
 
     p = sub.add_parser("s3", help="start an s3 gateway (+embedded filer)")
     p.add_argument("-metricsPort", type=int, default=0,
@@ -1071,7 +1137,10 @@ def main(argv=None):
     p.add_argument("-iamPort", type=int, default=8111)
     p.add_argument("-db", default="")
     p.add_argument("-store", default="sqlite",
-                   help="filer store kind: sqlite | sharded | perbucket")
+                   help="filer store kind: sqlite | sharded | perbucket | "
+                        "remote")
+    p.add_argument("-storeAddress", default="",
+                   help="shared `weed filer.store` address (-store remote)")
     p.add_argument("-config", default="")
     p.add_argument("-rack", default="")
     p.add_argument("-tcp", action="store_true",
@@ -1194,6 +1263,24 @@ def main(argv=None):
     p.add_argument("-interval", type=float, default=2.0)
     p.add_argument("-once", action="store_true")
     p.set_defaults(fn=cmd_filer_backup)
+
+    p = sub.add_parser("filer.replicate",
+                       help="consume notification-queue events into a "
+                            "replication sink (MQ-driven mode)")
+    p.add_argument("-filer", default="127.0.0.1:8888",
+                   help="source filer (chunk data reads)")
+    p.add_argument("-filerPath", default="/")
+    p.add_argument("-sink", required=True,
+                   help="local:///dir | s3://bucket/dir?endpoint=host:port"
+                        " | filer://host:port/dir")
+    p.add_argument("-accessKey", default="")
+    p.add_argument("-secretKey", default="")
+    p.add_argument("-incremental", action="store_true")
+    p.add_argument("-exclude", default="")
+    p.add_argument("-interval", type=float, default=1.0)
+    p.add_argument("-once", action="store_true",
+                   help="drain the queue and exit")
+    p.set_defaults(fn=cmd_filer_replicate)
 
     p = sub.add_parser("filer.meta.backup",
                        help="continuously back up filer metadata to sqlite")
