@@ -402,8 +402,12 @@ def main():
                           ("mxu", None)):
         name = f"{method}{block or ''}"
         try:
-            candidates[name] = bench_tpu_kernel(
-                method, probe_len, block=block, chains=(2, 6), reps=2)
+            for _ in range(3):
+                value = bench_tpu_kernel(
+                    method, probe_len, block=block, chains=(2, 6), reps=2)
+                if value <= 500:  # > 500 GiB/s = jitter ate the slope
+                    candidates[name] = value
+                    break
         except Exception as e:
             print(f"note: {name} failed: {e}", file=sys.stderr)
 

@@ -245,19 +245,64 @@ class S3ApiServer:
         if "acl" in q:
             if method == "GET":
                 return self._get_bucket_acl(bucket)
-            return _error_xml("NotImplemented", "acl is read-only", 501)
+            if method == "PUT":
+                # persist the canned ACL (PutBucketAclHandler accepts
+                # x-amz-acl canned values; grant XML bodies are not
+                # supported, as in the reference — and must NOT be
+                # silently swallowed as a reset to private)
+                canned = req.headers.get("X-Amz-Acl", "")
+                if not canned and req.body:
+                    return _error_xml("NotImplemented",
+                                      "grant-based ACL bodies are not "
+                                      "supported; use x-amz-acl", 501)
+                canned = canned or "private"
+                if canned not in ("private", "public-read",
+                                  "public-read-write",
+                                  "authenticated-read"):
+                    return _error_xml("InvalidArgument",
+                                      f"unsupported ACL {canned}", 400)
+                self._set_bucket_config(bucket, "s3-acl", canned)
+                return Response(b"", 200)
+            return _error_xml("NotImplemented", "acl", 501)
         if "cors" in q:
             if method == "GET":
-                return _error_xml("NoSuchCORSConfiguration",
-                                  "no CORS configuration", 404)
+                stored = self._get_bucket_config(bucket, "s3-cors")
+                if not stored:
+                    return _error_xml("NoSuchCORSConfiguration",
+                                      "no CORS configuration", 404)
+                return Response(stored.encode(), 200, "application/xml")
             if method == "DELETE":
+                self._set_bucket_config(bucket, "s3-cors", None)
                 return Response(b"", 204)
+            if method == "PUT":
+                try:  # reject malformed XML up front
+                    ET.fromstring(req.body)
+                except ET.ParseError:
+                    return _error_xml("MalformedXML", "bad CORS XML", 400)
+                self._set_bucket_config(bucket, "s3-cors",
+                                        req.body.decode("utf8", "replace"))
+                return Response(b"", 200)
             return _error_xml("NotImplemented", "cors", 501)
         if "policy" in q:
             if method == "GET":
-                return _error_xml("NoSuchBucketPolicy",
-                                  "no bucket policy", 404)
+                stored = self._get_bucket_config(bucket, "s3-policy")
+                if not stored:
+                    return _error_xml("NoSuchBucketPolicy",
+                                      "no bucket policy", 404)
+                return Response(stored.encode(), 200, "application/json")
             if method == "DELETE":
+                self._set_bucket_config(bucket, "s3-policy", None)
+                return Response(b"", 204)
+            if method == "PUT":
+                try:
+                    if not req.body:
+                        raise ValueError("empty policy")
+                    json.loads(req.body)
+                except ValueError:
+                    return _error_xml("MalformedPolicy",
+                                      "policy is not valid JSON", 400)
+                self._set_bucket_config(bucket, "s3-policy",
+                                        req.body.decode("utf8", "replace"))
                 return Response(b"", 204)
             return _error_xml("NotImplemented", "policy", 501)
         if "lifecycle" in q:
@@ -284,8 +329,29 @@ class S3ApiServer:
                               "subresource not implemented", 501)
         return None
 
+    # -- persisted bucket configs (extended attrs on the bucket entry) -------
+    def _set_bucket_config(self, bucket: str, key: str,
+                           value: Optional[str]):
+        # the read-modify-write of extended must be atomic: concurrent
+        # config PUTs (cors vs policy) would otherwise lose updates
+        with self.filer.lock:
+            entry = self.filer.find_entry(self._bucket_path(bucket))
+            entry.extended = dict(entry.extended or {})
+            if value is None:
+                entry.extended.pop(key, None)
+            else:
+                entry.extended[key] = value
+            self.filer.update_entry(entry)
+
+    def _get_bucket_config(self, bucket: str, key: str) -> Optional[str]:
+        entry = self.filer.find_entry(self._bucket_path(bucket))
+        value = (entry.extended or {}).get(key)
+        return value if isinstance(value, str) else None
+
     def _get_bucket_acl(self, bucket: str):
-        """Canned ACL from the identity table (GetBucketAclHandler)."""
+        """Canned ACL from the identity table plus the persisted canned
+        grant, if any (GetBucketAclHandler)."""
+        canned = self._get_bucket_config(bucket, "s3-acl")
         owner = {"ID": "seaweedfs_tpu", "DisplayName": "seaweedfs_tpu"}
         grants = []
         for ident in self.iam.identities.values():
@@ -305,6 +371,21 @@ class S3ApiServer:
                     "Grantee": {"ID": ident.access_key,
                                 "DisplayName": ident.name},
                     "Permission": perm})
+        if canned and canned.startswith("public-read"):
+            grants.append({
+                "Grantee": {"URI": "http://acs.amazonaws.com/groups/"
+                                   "global/AllUsers"},
+                "Permission": "READ"})
+            if canned == "public-read-write":
+                grants.append({
+                    "Grantee": {"URI": "http://acs.amazonaws.com/groups/"
+                                       "global/AllUsers"},
+                    "Permission": "WRITE"})
+        elif canned == "authenticated-read":
+            grants.append({
+                "Grantee": {"URI": "http://acs.amazonaws.com/groups/"
+                                   "global/AuthenticatedUsers"},
+                "Permission": "READ"})
         return Response(_xml("AccessControlPolicy", {
             "Owner": owner,
             "AccessControlList": {"Grant": grants},
