@@ -117,9 +117,10 @@ def apply_matrix_pallas(matrix: np.ndarray, data, block: int = DEFAULT_BLOCK,
 # Parity stays in packed int32 words end-to-end: a device-side
 # int32->uint8 bitcast is a byte-granular relayout on TPU (measured 10x
 # the kernel's own cost), while host-side numpy views of the downloaded
-# words are free.  Measured on TPU v5e: ~49 GiB/s fused vs ~58 GiB/s for
-# the parity-only kernel (the round-3 plane-partial byte-layout kernel
-# ran 26 GiB/s).
+# words are free.  Measured on TPU v5e at the shipped 32 KiB fused
+# block: ~60 GiB/s fused vs ~60 GiB/s parity-only — CRC fusion is
+# essentially free (the round-3 plane-partial byte-layout kernel ran
+# 26 GiB/s; see DEFAULT_FUSED_BLOCK below for the block sweep).
 # ---------------------------------------------------------------------------
 
 _POLY_REFLECTED = 0x82F63B78
@@ -268,7 +269,15 @@ def _fused_encode_words(bmw, v, words, d: int, p: int, block: int,
     )(bmw, v, words)
 
 
-def fused_encode_block(length: int, block: int = DEFAULT_BLOCK) -> int:
+# The fused words kernel runs FASTER at larger in-kernel segments
+# (fewer grid steps, better MXU amortisation): measured on TPU v5e at
+# (6, 10, 1 MiB): 8192 -> 49.5, 16384 -> 58.2, 32768 -> 59.9 GiB/s
+# (parity-only ceiling 60.2 — CRC fusion is essentially free at 32 KiB).
+DEFAULT_FUSED_BLOCK = 32768
+
+
+def fused_encode_block(length: int,
+                       block: int = DEFAULT_FUSED_BLOCK) -> int:
     """Largest kernel block that divides length with a power-of-two
     segment count, or 0 when the fused kernel cannot handle this shape."""
     while block >= 512:

@@ -111,11 +111,11 @@ _PALLAS_OK: dict = {}
 def _pallas_fused_ok(matrix) -> bool:
     """One-time self-test (per matrix geometry) of the fused Mosaic
     kernel on this backend: compile+run at a production-representative
-    shape (the production 8192-byte block with a multi-segment combine)
+    shape (the production fused block with a multi-segment combine)
     checked against the host codec.  A Mosaic lowering regression then
     degrades the production encode path to the portable XLA step instead
     of crashing it."""
-    from ..ops.rs_pallas import DEFAULT_BLOCK
+    from ..ops.rs_pallas import DEFAULT_FUSED_BLOCK
 
     m = np.ascontiguousarray(matrix, dtype=np.uint8)
     key = (m.tobytes(), m.shape)
@@ -130,7 +130,8 @@ def _pallas_fused_ok(matrix) -> bool:
         # batch >= 2 so BOTH grid dimensions take nonzero indices on the
         # hardware — a bi>0-only miscompile must not pass the guard;
         # drive the exact production invocation (int32 word views)
-        data = rng.integers(0, 256, (2, m.shape[1], 2 * DEFAULT_BLOCK),
+        data = rng.integers(0, 256,
+                            (2, m.shape[1], 2 * DEFAULT_FUSED_BLOCK),
                             dtype=np.uint8)
         parity_w, crcs = fused_encode_words(m, data.view(np.int32),
                                             interpret=False)

@@ -129,16 +129,26 @@ class TestFusedPallasKernel:
     def test_block_selector(self):
         from seaweedfs_tpu.ops.rs_pallas import fused_encode_block
 
-        assert fused_encode_block(1 << 20) == 8192  # nseg = 128
+        assert fused_encode_block(1 << 20) == 32768  # nseg = 32
+        assert fused_encode_block(1 << 20, 8192) == 8192  # nseg = 128
         assert fused_encode_block(512) == 512
         assert fused_encode_block(100) == 0  # unsupported shape
         # 1536 = 3*512: nseg = 3 is not a power of two at any block
         assert fused_encode_block(1536, 512) == 0
 
-    def test_words_api_matches_and_views_are_free(self):
-        """The production words API (packed int32 views, no device
-        bitcasts) must agree with the uint8 wrapper and the host codec,
-        and its parity words must view back to the exact parity bytes."""
+    def test_words_api_at_large_blocks(self):
+        """The production default (32 KiB in-kernel segments) and the
+        16 KiB step must stay bit-exact (interpret mode)."""
+        rng = np.random.default_rng(41)
+        for block, length in ((16384, 32768), (32768, 65536)):
+            self._check_words_exact(
+                rng.integers(0, 256, (1, 10, length), dtype=np.uint8),
+                block=block)
+
+    @staticmethod
+    def _check_words_exact(data: np.ndarray, block=None):
+        """Run fused_encode_words on int32 views of `data` and verify
+        parity bytes + finalized CRCs against the host codec."""
         from seaweedfs_tpu.ops import crc32c as crc_host
         from seaweedfs_tpu.ops import gf256
         from seaweedfs_tpu.ops.crc_device import finalize
@@ -146,17 +156,25 @@ class TestFusedPallasKernel:
         from seaweedfs_tpu.ops.rs_pallas import fused_encode_words
 
         matrix = gf256.parity_matrix(10, 14)
-        rng = np.random.default_rng(99)
-        batch, length = 2, 16384
-        data = rng.integers(0, 256, (batch, 10, length), dtype=np.uint8)
+        batch, _, length = data.shape
         parity_w, crc_raw = fused_encode_words(matrix,
-                                               data.view(np.int32))
+                                               data.view(np.int32),
+                                               block=block)
         parity = np.ascontiguousarray(np.asarray(parity_w)) \
             .view(np.uint8).reshape(batch, 4, length)
-        crcs = finalize(crc_raw, length)
+        crcs = finalize(np.asarray(crc_raw), length)
         for bi in range(batch):
             expect = gf_apply_matrix(np.asarray(matrix), data[bi])
-            assert np.array_equal(parity[bi], expect)
+            assert np.array_equal(parity[bi], expect), (block, bi)
             full = np.concatenate([data[bi], expect], axis=0)
             for s in range(14):
-                assert int(crcs[bi, s]) == crc_host.crc32c(full[s])
+                assert int(crcs[bi, s]) == crc_host.crc32c(full[s]), \
+                    (block, bi, s)
+
+    def test_words_api_matches_and_views_are_free(self):
+        """The production words API (packed int32 views, no device
+        bitcasts) must agree with the uint8 wrapper and the host codec,
+        and its parity words must view back to the exact parity bytes."""
+        rng = np.random.default_rng(99)
+        self._check_words_exact(
+            rng.integers(0, 256, (2, 10, 16384), dtype=np.uint8))
