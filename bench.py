@@ -201,20 +201,29 @@ def bench_hbm_fused(batch: int, length: int,
 
 
 def bench_rebuild_kernel(length: int, chains: tuple[int, int] = (8, 24),
-                         reps: int = 3) -> float:
+                         reps: int = 3,
+                         on_tpu: bool | None = None) -> float:
     """BASELINE config 3: device reconstruction throughput.  Hard
     direction: 4 DATA shards lost, rebuilt from 6 data + 4 parity
     survivors through the same bit-matmul kernel the encode uses, with
     the reconstruction matrix from rebuild_matrix (inverted survivor
-    submatrix — the one-matmul form of klauspost Reconstruct)."""
+    submatrix — the one-matmul form of klauspost Reconstruct).  Off-TPU
+    the SWAR XLA apply serves (interpret-mode pallas is minutes/call)."""
     import jax
     import jax.numpy as jnp
 
     from seaweedfs_tpu.ops import rs_pallas
+    from seaweedfs_tpu.ops.rs_jax import apply_matrix_swar
     from seaweedfs_tpu.parallel.batched_encode import rebuild_matrix
 
+    if on_tpu is None:
+        from seaweedfs_tpu.util.platform import on_tpu as _on_tpu
+
+        on_tpu = _on_tpu()
     present = [4, 5, 6, 7, 8, 9, 10, 11, 12, 13]  # data 0-3 lost
     _, matrix = rebuild_matrix(present, [0, 1, 2, 3])
+    apply = (rs_pallas.apply_matrix_pallas if on_tpu
+             else apply_matrix_swar)
 
     @jax.jit
     def gen(key):
@@ -229,7 +238,7 @@ def bench_rebuild_kernel(length: int, chains: tuple[int, int] = (8, 24),
         def f(x):
             acc, out = x, None
             for _ in range(k):
-                out = rs_pallas.apply_matrix_pallas(matrix, acc)
+                out = apply(matrix, acc)
                 acc = acc.at[0, 0].set(out[0, 0])
             return out[0, :8]
         return f
@@ -396,10 +405,15 @@ def main():
     cpu_kernel = bench_cpu_kernel()
 
     # -- device kernel ceiling (no CRC) --------------------------------------
+    # off-TPU the pallas kernels only run in interpret mode (a Python
+    # grid emulation measured in minutes per call) — probe the XLA
+    # formulations only so a wedged relay cannot stall the whole bench
     candidates: dict[str, float] = {}
     probe_len = (64 << 20) if on_tpu else (8 << 20)
-    for method, block in (("pallas", 8192), ("pallas", 32768),
-                          ("mxu", None)):
+    kernel_candidates = (
+        (("pallas", 8192), ("pallas", 32768), ("mxu", None))
+        if on_tpu else (("mxu", None), ("swar", None)))
+    for method, block in kernel_candidates:
         name = f"{method}{block or ''}"
         try:
             for _ in range(3):
@@ -453,7 +467,7 @@ def main():
     rebuild_kernel = 0.0
     try:
         rebuild_kernel = bench_rebuild_kernel(
-            (64 << 20) if on_tpu else (4 << 20))
+            (64 << 20) if on_tpu else (4 << 20), on_tpu=on_tpu)
     except Exception as e:
         print(f"note: rebuild kernel failed: {e}", file=sys.stderr)
 
