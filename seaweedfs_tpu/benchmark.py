@@ -51,7 +51,12 @@ def run_benchmark(master_address: str, num_files: int = 1000,
                   file_size: int = 1024, concurrency: int = 16,
                   delete_percent: int = 0, replication: str = "000",
                   do_read: bool = True, quiet: bool = False,
-                  use_tcp: bool = False):
+                  use_tcp: bool = False, use_native: bool = False,
+                  assign_batch: int = 256):
+    if use_native:
+        return _run_native(master_address, num_files, file_size,
+                           concurrency, delete_percent, replication,
+                           do_read, quiet, assign_batch)
     tcp_client = None
     if use_tcp:  # benchmark -useTcp (command/benchmark.go)
         from .wdclient.volume_tcp_client import VolumeTcpClient
@@ -150,4 +155,77 @@ def run_benchmark(master_address: str, num_files: int = 1000,
         print(write.report("write"))
         if do_read:
             print(read.report("read"))
+    return write, read
+
+
+def _run_native(master_address: str, num_files: int, file_size: int,
+                concurrency: int, delete_percent: int, replication: str,
+                do_read: bool, quiet: bool, assign_batch: int):
+    """Native-engine benchmark: the load generator is the C++ driver in
+    native/vol_native.cpp (like the reference's compiled Go benchmark
+    client), hitting the volume server's native fast-path port.  File ids
+    are assigned from the master in batches via /dir/assign?count=N (the
+    reference's Assign count parameter, operation/assign_file_id.go) and
+    expanded with the fid "_delta" convention."""
+    from .storage import native_engine
+    from .wdclient.volume_tcp_client import VolumeTcpClient
+
+    if not native_engine.available():
+        raise RuntimeError("native engine unavailable (build native/)")
+    resolver = VolumeTcpClient()
+    by_server: dict[str, list[str]] = {}
+    write = BenchResult()
+    t_assign0 = time.perf_counter()
+    remaining = num_files
+    while remaining > 0:
+        k = min(assign_batch, remaining)
+        a = call(master_address,
+                 f"/dir/assign?replication={replication}&count={k}")
+        fid = a["fid"]
+        group = by_server.setdefault(a["url"], [])
+        group.append(fid)
+        group.extend(f"{fid}_{i}" for i in range(1, k))
+        remaining -= k
+    assign_seconds = time.perf_counter() - t_assign0
+
+    def tcp_endpoint(url: str) -> tuple[str, int]:
+        host, port = resolver.tcp_address(url).rsplit(":", 1)
+        return host, int(port)
+
+    for url, fids in by_server.items():
+        host, port = tcp_endpoint(url)
+        secs, errs, lat = native_engine.bench(
+            host, port, "W", fids, len(fids), file_size, concurrency)
+        write.requests += len(fids) - errs
+        write.errors += errs
+        write.bytes += (len(fids) - errs) * file_size
+        write.seconds += secs
+        write.latencies_ms.extend(lat.tolist())
+
+    read = BenchResult()
+    if do_read:
+        for url, fids in by_server.items():
+            host, port = tcp_endpoint(url)
+            secs, errs, lat = native_engine.bench(
+                host, port, "R", fids, len(fids), 0, concurrency)
+            read.requests += len(fids) - errs
+            read.errors += errs
+            read.bytes += (len(fids) - errs) * file_size
+            read.seconds += secs
+            read.latencies_ms.extend(lat.tolist())
+
+    if delete_percent > 0:
+        for url, fids in by_server.items():
+            host, port = tcp_endpoint(url)
+            n = len(fids) * delete_percent // 100
+            if n:
+                native_engine.bench(host, port, "D", fids[:n], n, 0,
+                                    concurrency)
+
+    if not quiet:
+        print(f"(assign: {num_files} fids in {assign_seconds:.2f}s, "
+              f"batch={assign_batch})")
+        print(write.report("write (native)"))
+        if do_read:
+            print(read.report("read (native)"))
     return write, read

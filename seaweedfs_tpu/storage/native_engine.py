@@ -1,0 +1,298 @@
+"""ctypes bindings for the native volume engine (native/vol_native.cpp).
+
+The engine owns the hot data plane of a volume: the needle index, the
+.dat append path with its .idx entry log, and a framed-TCP server that
+answers read/write/delete requests entirely off the GIL (the reference's
+equivalent surface is compiled Go: weed/storage/needle_map,
+volume_write.go, and the volume server's handler goroutines).
+
+Python and C++ share one index and one append mutex per volume, so
+requests served natively and requests served by the Python HTTP handlers
+always see each other's writes.  `NativeNeedleMap` plugs the engine into
+`Volume` behind the same interface as the pure-Python map kinds
+(needle_map.py BaseNeedleMap).
+
+Set SW_NATIVE=0 to disable the engine even when the library builds.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import functools
+import os
+import subprocess
+from typing import Callable, Iterator, Optional
+
+import numpy as np
+
+from . import types as t
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+)
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libseaweedvol.so")
+
+_i64 = ctypes.c_int64
+_u64 = ctypes.c_uint64
+_u32 = ctypes.c_uint32
+
+
+@functools.lru_cache(maxsize=1)
+def lib() -> Optional[ctypes.CDLL]:
+    if os.environ.get("SW_NATIVE", "1") == "0":
+        return None
+    try:
+        subprocess.run(["make", "-s"], cwd=_NATIVE_DIR, check=True,
+                       capture_output=True, timeout=180)
+    except Exception:
+        if not os.path.exists(_LIB_PATH):
+            return None
+    try:
+        cdll = ctypes.CDLL(_LIB_PATH)
+    except OSError:
+        return None
+    cdll.svn_register.restype = _i64
+    cdll.svn_register.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
+                                  ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                                  ctypes.c_int]
+    cdll.svn_unregister.argtypes = [_i64]
+    cdll.svn_set_flags.argtypes = [_i64, ctypes.c_int, ctypes.c_int]
+    cdll.svn_serve.argtypes = [_u32, _i64]
+    cdll.svn_nm_put.argtypes = [_i64, _u64, _u64, _i64]
+    cdll.svn_nm_put_if_newer.argtypes = [_i64, _u64, _u64, _i64]
+    cdll.svn_nm_delete.argtypes = [_i64, _u64, _u64]
+    cdll.svn_nm_set_memory.argtypes = [_i64, _u64, _u64, _i64]
+    cdll.svn_nm_get.argtypes = [_i64, _u64, ctypes.POINTER(_u64),
+                                ctypes.POINTER(_i64)]
+    cdll.svn_nm_stats.argtypes = [_i64, ctypes.POINTER(_i64)]
+    cdll.svn_nm_visit.restype = _i64
+    cdll.svn_nm_visit.argtypes = [_i64, ctypes.POINTER(_i64), _i64]
+    cdll.svn_append.restype = _i64
+    cdll.svn_append.argtypes = [_i64, ctypes.c_char_p, _i64]
+    cdll.svn_size.restype = _i64
+    cdll.svn_size.argtypes = [_i64]
+    cdll.svn_sync.argtypes = [_i64]
+    cdll.svn_touch.argtypes = [_i64, _u64, _i64]
+    cdll.svn_quiesce.argtypes = [_i64]
+    cdll.svn_last_modified.restype = _i64
+    cdll.svn_last_modified.argtypes = [_i64]
+    cdll.svn_server_start.restype = ctypes.c_int
+    cdll.svn_server_start.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    cdll.svn_server_stop.restype = ctypes.c_int
+    cdll.svn_bench.restype = ctypes.c_double
+    cdll.svn_bench.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+                               ctypes.c_char_p, _i64, _i64, ctypes.c_int,
+                               ctypes.c_int, ctypes.POINTER(ctypes.c_float),
+                               ctypes.POINTER(_i64)]
+    return cdll
+
+
+def available() -> bool:
+    return lib() is not None
+
+
+class NeedleValue:
+    __slots__ = ("offset", "size")
+
+    def __init__(self, offset: int, size: int):
+        self.offset = offset
+        self.size = size
+
+
+class NativeNeedleMap:
+    """BaseNeedleMap-compatible map whose storage, counters and .idx
+    append log live in the native engine (one source of truth shared with
+    the native TCP server)."""
+
+    kind = "native"
+
+    def __init__(self, dat_path: str, idx_path: str, version: int,
+                 writable: bool, read_only: bool, fsync: bool):
+        self._lib = lib()
+        if self._lib is None:
+            raise RuntimeError("native engine unavailable")
+        self.index_path = idx_path
+        h = self._lib.svn_register(dat_path.encode(), idx_path.encode(),
+                                   version, int(writable), int(read_only),
+                                   int(fsync))
+        if h <= 0:
+            raise OSError(-h, f"svn_register({dat_path!r}) failed")
+        self.handle = h
+
+    # -- mutate --------------------------------------------------------------
+    def put(self, nid: int, offset: int, size: int):
+        self._lib.svn_nm_put(self.handle, nid, offset, size)
+
+    def put_if_newer(self, nid: int, offset: int, size: int) -> bool:
+        """Atomic form of the write path's "newer offset wins" guard
+        (volume_write.go:160-165): evaluated under the engine's map lock
+        so a racing native-port write cannot be clobbered."""
+        return self._lib.svn_nm_put_if_newer(
+            self.handle, nid, offset, size) == 1
+
+    def delete(self, nid: int, offset: int):
+        self._lib.svn_nm_delete(self.handle, nid, offset)
+
+    def set_in_memory(self, nid: int, offset: int, size: int):
+        self._lib.svn_nm_set_memory(self.handle, nid, offset, size)
+
+    # -- query ---------------------------------------------------------------
+    def get(self, nid: int) -> Optional[NeedleValue]:
+        off = _u64()
+        size = _i64()
+        r = self._lib.svn_nm_get(self.handle, nid, ctypes.byref(off),
+                                 ctypes.byref(size))
+        if r != 1:
+            return None
+        return NeedleValue(off.value, size.value)
+
+    def __contains__(self, nid: int) -> bool:
+        return self.get(nid) is not None
+
+    def _stats(self) -> np.ndarray:
+        out = (ctypes.c_int64 * 7)()
+        self._lib.svn_nm_stats(self.handle, out)
+        return np.ctypeslib.as_array(out).copy()
+
+    @property
+    def file_count(self) -> int:
+        return int(self._stats()[0])
+
+    @property
+    def deleted_count(self) -> int:
+        return int(self._stats()[1])
+
+    def content_size(self) -> int:
+        return int(self._stats()[2])
+
+    def deleted_size(self) -> int:
+        return int(self._stats()[3])
+
+    def max_file_key(self) -> int:
+        return int(self._stats()[4])
+
+    def __len__(self) -> int:
+        return int(self._stats()[5])
+
+    def last_append_ns(self) -> int:
+        return int(self._stats()[6])
+
+    def last_modified(self) -> int:
+        return max(0, int(self._lib.svn_last_modified(self.handle)))
+
+    def items_ascending(self) -> Iterator[tuple[int, NeedleValue]]:
+        if not self.handle:
+            return
+        cap = max(len(self), 1)
+        while True:
+            buf = (ctypes.c_int64 * (cap * 3))()
+            n = self._lib.svn_nm_visit(self.handle, buf, cap)
+            if n >= 0:
+                break
+            if n == -(2 ** 63):  # INT64_MIN: handle gone (closed under us)
+                return
+            cap = -n  # raced a concurrent insert: retry at the new size
+        arr = np.ctypeslib.as_array(buf)[: n * 3].reshape(n, 3)
+        for nid, off, size in arr:
+            yield int(nid), NeedleValue(int(off), int(size))
+
+    def ascending_visit(self, fn: Callable[[int, NeedleValue], None]):
+        for nid, nv in self.items_ascending():
+            fn(nid, nv)
+
+    # -- append path ---------------------------------------------------------
+    def append_dat(self, blob: bytes) -> int:
+        """Append a record to the .dat under the engine's shared write
+        mutex; returns the landing offset."""
+        off = self._lib.svn_append(self.handle, blob, len(blob))
+        if off < 0:
+            raise OSError(-off, "native append failed")
+        return off
+
+    def touch(self, append_ns: int, modified_ts: int):
+        self._lib.svn_touch(self.handle, append_ns, modified_ts)
+
+    def set_flags(self, writable: Optional[bool] = None,
+                  read_only: Optional[bool] = None):
+        self._lib.svn_set_flags(
+            self.handle,
+            -1 if writable is None else int(writable),
+            -1 if read_only is None else int(read_only))
+
+    def quiesce(self):
+        """Disable native-path writes and drain any in-flight append."""
+        self._lib.svn_quiesce(self.handle)
+
+    # -- durability ----------------------------------------------------------
+    def flush(self):
+        pass  # idx appends are unbuffered write()s
+
+    def sync(self):
+        self._lib.svn_sync(self.handle)
+
+    def close(self):
+        if self.handle:
+            self._lib.svn_unregister(self.handle)
+            self.handle = 0
+
+    def bytes_per_entry(self) -> float:
+        return 25.0  # 16B slot + state byte + vector overhead
+
+
+# -- server / serving registry ----------------------------------------------
+
+def serve_volume(vid: int, nm) -> bool:
+    """Bind vid -> nm.handle for the native TCP server (0 unbinds)."""
+    cdll = lib()
+    if cdll is None or not isinstance(nm, NativeNeedleMap):
+        return False
+    return cdll.svn_serve(vid, nm.handle) == 0
+
+
+def unserve_volume(vid: int):
+    cdll = lib()
+    if cdll is not None:
+        cdll.svn_serve(vid, 0)
+
+
+def server_start(host: str, port: int) -> int:
+    """Start the native fast-path server; returns the bound port."""
+    cdll = lib()
+    if cdll is None:
+        raise RuntimeError("native engine unavailable")
+    bound = cdll.svn_server_start(host.encode(), port)
+    if bound < 0:
+        raise OSError(-bound, "native server start failed")
+    return bound
+
+
+def server_stop():
+    cdll = lib()
+    if cdll is not None:
+        cdll.svn_server_stop()
+
+
+def bench(host: str, port: int, op: str, fids: list[str], nreqs: int,
+          payload_size: int = 0, concurrency: int = 16
+          ) -> tuple[float, int, np.ndarray]:
+    """Drive the native load generator; returns (seconds, errors,
+    latencies_ms ndarray)."""
+    cdll = lib()
+    if cdll is None:
+        raise RuntimeError("native engine unavailable")
+    blob = "\n".join(fids).encode()
+    lat = (ctypes.c_float * nreqs)()
+    errs = _i64()
+    seconds = cdll.svn_bench(host.encode(), port, ord(op[0]), blob,
+                             len(fids), nreqs, payload_size, concurrency,
+                             lat, ctypes.byref(errs))
+    lat_ms = np.ctypeslib.as_array(lat).astype(np.float64) / 1000.0
+    # request slots never claimed (all workers dead) report latency 0;
+    # they are already counted in errs — drop them from the histogram
+    lat_ms = lat_ms[lat_ms > 0]
+    return seconds, int(errs.value), lat_ms
+
+
+__all__ = ["lib", "available", "NativeNeedleMap", "serve_volume",
+           "unserve_volume", "server_start", "server_stop", "bench"]

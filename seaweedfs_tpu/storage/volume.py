@@ -22,6 +22,7 @@ import time
 from typing import Optional
 
 from . import idx as idx_mod
+from . import native_engine
 from . import types as t
 from .backend import DiskFile
 from .needle import (CURRENT_VERSION, Needle, NeedleError, get_actual_size,
@@ -123,12 +124,12 @@ class Volume:
         self.lock = threading.RLock()
         self.data: Optional[DiskFile] = None
         self.nm: Optional[NeedleMap] = None
-        self.last_append_at_ns = 0
-        self.last_modified_ts = 0
+        self._last_append_at_ns = 0
+        self._last_modified_ts = 0
         self.is_compacting = False
         self.last_compact_index_offset = 0
         self.last_compact_revision = 0
-        self.read_only = False
+        self._read_only = False
         self._load(create_if_missing=True,
                    replica_placement=replica_placement or ReplicaPlacement(),
                    ttl=ttl)
@@ -146,6 +147,57 @@ class Volume:
     @property
     def ttl(self) -> TTL:
         return self.super_block.ttl
+
+    # -- native-engine coupling ----------------------------------------------
+    # read_only and the append/modify timestamps are mirrored with the
+    # native engine: its TCP fast path writes volumes without entering
+    # Python, so these views merge both sides.
+
+    @property
+    def read_only(self) -> bool:
+        return self._read_only
+
+    @read_only.setter
+    def read_only(self, value: bool):
+        self._read_only = value
+        nm = getattr(self, "nm", None)
+        if isinstance(nm, native_engine.NativeNeedleMap):
+            nm.set_flags(read_only=value)
+
+    @property
+    def last_append_at_ns(self) -> int:
+        nm = getattr(self, "nm", None)
+        if isinstance(nm, native_engine.NativeNeedleMap):
+            return max(self._last_append_at_ns, nm.last_append_ns())
+        return self._last_append_at_ns
+
+    @last_append_at_ns.setter
+    def last_append_at_ns(self, value: int):
+        self._last_append_at_ns = value
+
+    @property
+    def last_modified_ts(self) -> int:
+        nm = getattr(self, "nm", None)
+        if isinstance(nm, native_engine.NativeNeedleMap):
+            return max(self._last_modified_ts, nm.last_modified())
+        return self._last_modified_ts
+
+    @last_modified_ts.setter
+    def last_modified_ts(self, value: int):
+        self._last_modified_ts = value
+
+    def _append_blob(self, blob: bytes) -> int:
+        """Append one record to the .dat.  In native mode the engine's
+        per-volume mutex serializes this with TCP fast-path writes."""
+        if isinstance(self.nm, native_engine.NativeNeedleMap):
+            return self.nm.append_dat(blob)
+        return self.data.append(blob)
+
+    def _native_writable(self) -> bool:
+        """Whether the native fast path may write this volume directly
+        (no replication fan-out or TTL logic to bypass)."""
+        return (self.super_block.replica_placement.copy_count() == 1
+                and not self.ttl and self.version == CURRENT_VERSION)
 
     # -- load/create ---------------------------------------------------------
     def _load(self, create_if_missing: bool, replica_placement=None,
@@ -194,7 +246,27 @@ class Volume:
             # seed quiescence tracking from the .dat mtime so -quietFor
             # gates survive a restart (volume_loading.go:63 semantics)
             self.last_modified_ts = int(os.path.getmtime(dat))
-        self.nm = new_needle_map(self.needle_map_kind, idx_path)
+        self.nm = self._new_needle_map(dat, idx_path, tiered)
+
+    def _new_needle_map(self, dat: str, idx_path: str, tiered):
+        """Pick the index implementation.  The in-memory kinds upgrade to
+        the native engine's shared map when the library is available (one
+        index serves both the Python handlers and the native TCP fast
+        path); sqlite and tiered volumes keep their Python maps."""
+        want_native = (self.needle_map_kind in ("memory", "native")
+                       and tiered is None
+                       and native_engine.available()
+                       and isinstance(self.data, DiskFile))
+        if want_native:
+            try:
+                return native_engine.NativeNeedleMap(
+                    dat, idx_path, self.version, self._native_writable(),
+                    self.read_only, self.fsync)
+            except (OSError, RuntimeError):
+                pass
+        kind = ("memory" if self.needle_map_kind == "native"
+                else self.needle_map_kind)
+        return new_needle_map(kind, idx_path)
 
     def _check_integrity(self, idx_path: str) -> int:
         """Verify index<->dat consistency; truncate corrupt tails.
@@ -308,9 +380,14 @@ class Volume:
                         f"mismatching cookie {n.cookie:x}")
             n.append_at_ns = time.time_ns()
             blob = n.to_bytes(self.version)
-            offset = self.data.append(blob)
+            offset = self._append_blob(blob)
             self.last_append_at_ns = n.append_at_ns
-            if nv is None or nv.offset < offset:
+            if isinstance(self.nm, native_engine.NativeNeedleMap):
+                # the "newer offset wins" check must read the map under
+                # its own lock: a native-port write to the same id may
+                # have landed after our pre-append lookup
+                self.nm.put_if_newer(n.id, offset, n.size)
+            elif nv is None or nv.offset < offset:
                 self.nm.put(n.id, offset, n.size)
             if n.last_modified > self.last_modified_ts:
                 self.last_modified_ts = n.last_modified
@@ -332,7 +409,7 @@ class Volume:
             n.data = b""
             n.append_at_ns = time.time_ns()
             blob = n.to_bytes(self.version)
-            offset = self.data.append(blob)
+            offset = self._append_blob(blob)
             self.last_append_at_ns = n.append_at_ns
             self.nm.delete(n.id, offset)
         if self.fsync:
@@ -463,12 +540,21 @@ class Volume:
         """Swap in .cpd/.cpx, replaying any writes that raced the copy
         (CommitCompact + makeupDiff, volume_vacuum.go:102-190)."""
         with self.lock:
+            if isinstance(self.nm, native_engine.NativeNeedleMap):
+                # barrier: no native fast-path write may land after the
+                # diff replay reads the idx tail (clients get a 307 and
+                # retry over HTTP, which blocks on self.lock)
+                self.nm.quiesce()
             self.nm.flush()
             try:
                 self._makeup_diff()
             except VolumeError:
                 os.remove(self.file_name(".cpd"))
                 os.remove(self.file_name(".cpx"))
+                if isinstance(self.nm, native_engine.NativeNeedleMap):
+                    # aborted commit: the old files stay live, so native
+                    # writes may resume
+                    self.nm.set_flags(writable=self._native_writable())
                 raise
             self.nm.close()
             self.data.close()

@@ -210,6 +210,13 @@ class VolumeServer:
 
     def stop(self):
         self._stop.set()
+        if getattr(self, "_native_owner", False):
+            from ..storage import native_engine
+
+            for vid in getattr(self, "_native_bound", set()):
+                native_engine.unserve_volume(vid)
+            native_engine.server_stop()
+            self._native_owner = False
         if self._tcp_sock is not None:
             try:
                 self._tcp_sock.close()
@@ -218,8 +225,60 @@ class VolumeServer:
         self.server.stop()
         self.store.close()
 
+    # -- native fast-path serving registry ------------------------------------
+    def _sync_native_serving(self):
+        """Keep the native TCP server's vid->volume bindings in step with
+        the store (only the server instance that owns the process-wide
+        native listener binds; others leave the registry alone)."""
+        if not getattr(self, "_native_owner", False):
+            return
+        from ..storage import native_engine
+
+        current = {}
+        for loc in self.store.locations:
+            for vid, v in list(loc.volumes.items()):
+                # TTL volumes stay off the native port: its read path has
+                # no expiry check, so they must 307 to the HTTP handler
+                # (volume.py read_needle expiry, volume_read.go:27-35)
+                if (isinstance(v.nm, native_engine.NativeNeedleMap)
+                        and not v.ttl):
+                    current[vid] = v.nm
+        bound = getattr(self, "_native_bound", set())
+        for vid in bound - current.keys():
+            native_engine.unserve_volume(vid)
+        for vid, nm in current.items():
+            native_engine.serve_volume(vid, nm)
+        self._native_bound = set(current)
+
     # -- TCP fast path (volume_server_tcp, port+20000) -----------------------
     def _start_tcp(self):
+        """Prefer the native engine's off-GIL server; fall back to the
+        Python loop (reads only) when the library is missing, JWT signing
+        requires the Python guard, or another in-process volume server
+        already owns the native listener."""
+        from ..storage import native_engine
+        from ..wdclient.volume_tcp_client import TCP_PORT_OFFSET
+
+        if (native_engine.available() and not self.guard.read_signing
+                and not self.guard.signing):
+            host, port = self.server.address.rsplit(":", 1)
+            wanted = int(port) + TCP_PORT_OFFSET
+            try:
+                bound = native_engine.server_start(
+                    host, wanted if wanted <= 65535 else 0)
+            except OSError:
+                bound = 0
+            if bound > 0:
+                self.tcp_port = bound
+                self._native_owner = True
+                self._native_bound = set()
+                self._sync_native_serving()
+                return
+        if not self.enable_tcp:
+            return
+        self._start_tcp_python()
+
+    def _start_tcp_python(self):
         import socket
         import struct
 
@@ -293,6 +352,9 @@ class VolumeServer:
         threading.Thread(target=accept_loop, daemon=True).start()
 
     def heartbeat_once(self):
+        # keep native fast-path bindings fresh (handles change across
+        # vacuum commits and volume add/delete)
+        self._sync_native_serving()
         hb = self.store.collect_heartbeat()
         targets = [self.master_address] + [
             m for m in self._seed_masters if m != self.master_address]

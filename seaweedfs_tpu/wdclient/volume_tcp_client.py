@@ -1,4 +1,4 @@
-"""TCP fast path for volume reads (wdclient/volume_tcp_client.go).
+"""TCP fast path for volume reads/writes (wdclient/volume_tcp_client.go).
 
 HTTP adds per-request header parsing on the hottest path — the
 reference's experimental TCP mode trades it for a trivial framed
@@ -6,10 +6,15 @@ protocol on a dedicated port (http port + 20000).  Frame format:
 
   request:  "G <fid>[ <jwt>]\n"          (read needle; jwt when the
                                           cluster signs reads)
+            "W <fid> <length>\n<body>"   (write needle, native engine)
+            "D <fid>\n"                  (delete needle, native engine)
   response: u32be status | u32be length | payload
-            status 0 = ok, 401 = unauthorized, 404 = not found,
+            status 0 = ok, 307 = fall back to the HTTP port (volume not
+            served natively), 401 = unauthorized, 404 = not found,
             500 = error
 
+The server side is the native engine (native/vol_native.cpp) when the
+library is available, else the Python TCP loop (reads only).
 Connections are pooled per server address via ResourcePool.
 """
 
@@ -80,20 +85,69 @@ class VolumeTcpClient:
             self._resolved[http_url] = resolved
         return resolved
 
-    def read_needle(self, volume_server_url: str, fid: str,
-                    jwt: str = "") -> bytes:
+    def _request(self, volume_server_url: str, frame: bytes) -> bytes:
         pool = self._pool(self.tcp_address(volume_server_url))
         with pool.use() as conn:
-            line = f"G {fid} {jwt}\n" if jwt else f"G {fid}\n"
-            conn.sendall(line.encode())
+            conn.sendall(frame)
             header = _read_exact(conn, 8)
             status, length = struct.unpack(">II", header)
             payload = _read_exact(conn, length)
             if status != 0:
                 raise VolumeTcpError(
-                    payload.decode(errors="replace") or "read failed",
+                    payload.decode(errors="replace") or "request failed",
                     status)
             return payload
+
+    def read_needle(self, volume_server_url: str, fid: str,
+                    jwt: str = "") -> bytes:
+        """Fast-path read; a 307 (volume not served natively: EC volume,
+        sqlite index, TTL volume, vacuum window) falls back to HTTP."""
+        line = f"G {fid} {jwt}\n" if jwt else f"G {fid}\n"
+        try:
+            return self._request(volume_server_url, line.encode())
+        except VolumeTcpError as e:
+            if e.status != 307:
+                raise
+            return self._http_fallback(volume_server_url, fid, "GET",
+                                       jwt=jwt)
+
+    def write_needle(self, volume_server_url: str, fid: str,
+                     data: bytes) -> bytes:
+        """Fast-path write (native engine only); 307 (replicated/TTL
+        volume, no native engine) falls back to the HTTP handler, which
+        owns the replication fan-out."""
+        frame = f"W {fid} {len(data)}\n".encode() + data
+        try:
+            return self._request(volume_server_url, frame)
+        except VolumeTcpError as e:
+            if e.status != 307:
+                raise
+            return self._http_fallback(volume_server_url, fid, "POST",
+                                       body=data)
+
+    def delete_needle(self, volume_server_url: str, fid: str) -> bytes:
+        try:
+            return self._request(volume_server_url, f"D {fid}\n".encode())
+        except VolumeTcpError as e:
+            if e.status != 307:
+                raise
+            return self._http_fallback(volume_server_url, fid, "DELETE")
+
+    def _http_fallback(self, url: str, fid: str, method: str,
+                       body: Optional[bytes] = None, jwt: str = "") -> bytes:
+        from ..rpc.http_rpc import RpcError, call
+
+        headers = {"Authorization": "BEARER " + jwt} if jwt else {}
+        try:
+            result = call(url, f"/{fid}", method=method, raw=body,
+                          headers=headers, timeout=30)
+        except RpcError as e:
+            raise VolumeTcpError(str(e), e.status) from None
+        if isinstance(result, (bytes, bytearray)):
+            return bytes(result)
+        import json as _json
+
+        return _json.dumps(result).encode()
 
     def close(self):
         with self._lock:

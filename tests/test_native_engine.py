@@ -1,0 +1,331 @@
+"""Native volume engine (native/vol_native.cpp): the C++ data plane.
+
+Covers the three coupling surfaces:
+  * NativeNeedleMap vs the pure-Python map kinds — differential test of
+    semantics, counters and the .idx append log byte stream;
+  * the framed-TCP server (G/W/D) against real volumes, including the
+    fallback ladder (307), cookie checks, dedup, deletes and the vacuum
+    write barrier;
+  * the volume-server integration — one index shared by the Python HTTP
+    handlers and the native port, bindings resynced across vacuum.
+"""
+
+import json
+import random
+import socket
+import struct
+
+import pytest
+
+from seaweedfs_tpu.master.server import MasterServer
+from seaweedfs_tpu.rpc.http_rpc import call
+from seaweedfs_tpu.storage import native_engine as ne
+from seaweedfs_tpu.storage.needle import Needle
+from seaweedfs_tpu.storage.needle_map import NeedleMap
+from seaweedfs_tpu.storage.volume import Volume
+from seaweedfs_tpu.volume_server.server import VolumeServer
+from seaweedfs_tpu.wdclient.volume_tcp_client import (VolumeTcpClient,
+                                                      VolumeTcpError)
+
+pytestmark = pytest.mark.skipif(not ne.available(),
+                                reason="native engine unavailable")
+
+
+def raw_request(port: int, frame: bytes) -> tuple[int, bytes]:
+    s = socket.create_connection(("127.0.0.1", port), timeout=10)
+    try:
+        s.sendall(frame)
+        hdr = b""
+        while len(hdr) < 8:
+            chunk = s.recv(8 - len(hdr))
+            assert chunk, "connection closed mid-header"
+            hdr += chunk
+        status, ln = struct.unpack(">II", hdr)
+        body = b""
+        while len(body) < ln:
+            body += s.recv(ln - len(body))
+        return status, body
+    finally:
+        s.close()
+
+
+@pytest.fixture
+def native_server():
+    port = ne.server_start("127.0.0.1", 0)
+    yield port
+    ne.server_stop()
+
+
+class TestNativeNeedleMap:
+    def test_volume_auto_upgrades_to_native(self, tmp_path):
+        v = Volume(str(tmp_path), "", 1)
+        assert isinstance(v.nm, ne.NativeNeedleMap)
+        v.close()
+
+    def test_differential_vs_python_map(self, tmp_path):
+        """Random op sequence: the native map must agree with the Python
+        map on lookups, counters, ascending visit order AND the .idx
+        bytes it appends."""
+        (tmp_path / "n").mkdir()
+        (tmp_path / "p").mkdir()
+        vn = Volume(str(tmp_path / "n"), "", 1)
+        assert isinstance(vn.nm, ne.NativeNeedleMap)
+        py = NeedleMap(str(tmp_path / "p" / "1.idx"))
+        rng = random.Random(42)
+        ids = [rng.randrange(1, 500) for _ in range(400)]
+        off = 8
+        for nid in ids:
+            roll = rng.random()
+            if roll < 0.7:
+                size = rng.randrange(1, 1000)
+                vn.nm.put(nid, off, size)
+                py.put(nid, off, size)
+                off += (size + 36 + 7) // 8 * 8
+            else:
+                nv = py.get(nid)
+                tomb = off
+                vn.nm.delete(nid, tomb)
+                py.delete(nid, tomb)
+        for nid in set(ids) | {99999}:
+            a, b = vn.nm.get(nid), py.get(nid)
+            if b is None:
+                assert a is None
+            else:
+                assert a is not None and (a.offset, a.size) == (
+                    b.offset, b.size)
+        assert vn.nm.file_count == py.file_count
+        assert vn.nm.deleted_count == py.deleted_count
+        assert vn.nm.content_size() == py.content_size()
+        assert vn.nm.deleted_size() == py.deleted_size()
+        assert vn.nm.max_file_key() == py.max_file_key()
+        assert ([(nid, nv.offset, nv.size)
+                 for nid, nv in vn.nm.items_ascending()] ==
+                [(nid, nv.offset, nv.size)
+                 for nid, nv in py.items_ascending()])
+        vn.nm.flush()
+        py.flush()
+        py._index_file.flush()
+        assert ((tmp_path / "n" / "1.idx").read_bytes() ==
+                (tmp_path / "p" / "1.idx").read_bytes())
+        vn.close()
+        py.close()
+
+    def test_reload_replays_idx(self, tmp_path):
+        v = Volume(str(tmp_path), "", 1)
+        for i in range(1, 20):
+            n = Needle.create(b"x" * i)
+            n.id, n.cookie = i, 7
+            v.write_needle(n)
+        v.delete_needle(Needle(id=5, cookie=7))
+        fc, dc = v.file_count(), v.deleted_count()
+        v.close()
+        v2 = Volume(str(tmp_path), "", 1)
+        assert (v2.file_count(), v2.deleted_count()) == (fc, dc)
+        assert v2.read_needle(6).data == b"x" * 6
+        with pytest.raises(Exception):
+            v2.read_needle(5)
+        v2.close()
+
+
+class TestNativeServer:
+    def test_read_write_delete_protocol(self, tmp_path, native_server):
+        v = Volume(str(tmp_path), "", 3)
+        n = Needle.create(b"python wrote this")
+        n.id, n.cookie = 0x10, 0xABCD0001
+        v.write_needle(n)
+        assert ne.serve_volume(3, v.nm)
+
+        st, body = raw_request(native_server, b"G 3,10abcd0001\n")
+        assert (st, body) == (0, b"python wrote this")
+        # missing / deleted / cookie mismatch -> 404
+        st, _ = raw_request(native_server, b"G 3,77abcd0001\n")
+        assert st == 404
+        st, _ = raw_request(native_server, b"G 3,10abcd0002\n")
+        assert st == 404
+        # unknown volume -> 307 fallback
+        st, _ = raw_request(native_server, b"G 9,10abcd0001\n")
+        assert st == 307
+
+        # native write is visible to the Python read path (shared index)
+        payload = b"native engine wrote this"
+        st, body = raw_request(
+            native_server,
+            b"W 3,20abcd0002 %d\n" % len(payload) + payload)
+        assert st == 0
+        rep = json.loads(body)
+        assert rep["eTag"]
+        assert v.read_needle(0x20, cookie=0xABCD0002).data == payload
+        st, body = raw_request(native_server, b"G 3,20abcd0002\n")
+        assert (st, body) == (0, payload)
+
+        # identical rewrite dedups (no .dat growth)
+        size_before = v.data.size()
+        st, _ = raw_request(
+            native_server,
+            b"W 3,20abcd0002 %d\n" % len(payload) + payload)
+        assert st == 0 and v.data.size() == size_before
+        # overwrite with the wrong cookie -> 403
+        st, _ = raw_request(native_server, b"W 3,20abcd0003 3\nxyz")
+        assert st == 403
+
+        # native delete propagates to Python
+        st, body = raw_request(native_server, b"D 3,20abcd0002\n")
+        assert st == 0 and json.loads(body)["size"] > 0
+        with pytest.raises(Exception):
+            v.read_needle(0x20)
+        # idempotent delete reports size 0
+        st, body = raw_request(native_server, b"D 3,20abcd0002\n")
+        assert st == 0 and json.loads(body)["size"] == 0
+        v.close()
+
+    def test_fid_delta_suffix(self, tmp_path, native_server):
+        v = Volume(str(tmp_path), "", 4)
+        ne.serve_volume(4, v.nm)
+        st, _ = raw_request(native_server, b"W 4,10aabbccdd 2\nhi")
+        assert st == 0
+        # "_2" delta addresses id 0x12 (types.py parse_file_id)
+        st, _ = raw_request(native_server, b"W 4,10aabbccdd_2 3\nhey")
+        assert st == 0
+        assert v.read_needle(0x12, cookie=0xAABBCCDD).data == b"hey"
+        v.close()
+
+    def test_vacuum_write_barrier_and_rebind(self, tmp_path, native_server):
+        v = Volume(str(tmp_path), "", 5)
+        ne.serve_volume(5, v.nm)
+        st, _ = raw_request(native_server, b"W 5,1aabbccdd 4\nkeep")
+        assert st == 0
+        st, _ = raw_request(native_server, b"W 5,2aabbccdd 4\nkill")
+        assert st == 0
+        st, _ = raw_request(native_server, b"D 5,2aabbccdd\n")
+        assert st == 0
+        v.compact()
+        v.commit_compact()
+        # old handle is gone: the server answers 307 until rebound
+        st, _ = raw_request(native_server, b"G 5,1aabbccdd\n")
+        assert st == 307
+        ne.serve_volume(5, v.nm)
+        st, body = raw_request(native_server, b"G 5,1aabbccdd\n")
+        assert (st, body) == (0, b"keep")
+        st, _ = raw_request(native_server, b"W 5,3aabbccdd 5\nfresh")
+        assert st == 0
+        assert v.read_needle(0x3, cookie=0xAABBCCDD).data == b"fresh"
+        assert v.file_count() == 2
+        v.close()
+
+    def test_bad_fid_write_keeps_framing(self, tmp_path, native_server):
+        """A W with an unparseable fid must drain its body so the next
+        request on the same connection still parses."""
+        v = Volume(str(tmp_path), "", 8)
+        ne.serve_volume(8, v.nm)
+        s = socket.create_connection(("127.0.0.1", native_server),
+                                     timeout=10)
+        try:
+            s.sendall(b"W badfid 11\nhello\nworld"
+                      b"W 8,1aabbccdd 2\nok")
+
+            def read_reply():
+                hdr = b""
+                while len(hdr) < 8:
+                    chunk = s.recv(8 - len(hdr))
+                    assert chunk
+                    hdr += chunk
+                status, ln = struct.unpack(">II", hdr)
+                body = b""
+                while len(body) < ln:
+                    body += s.recv(ln - len(body))
+                return status, body
+
+            st, _ = read_reply()
+            assert st == 400
+            st, _ = read_reply()
+            assert st == 0
+        finally:
+            s.close()
+        assert v.read_needle(0x1, cookie=0xAABBCCDD).data == b"ok"
+        v.close()
+
+    def test_replicated_volume_rejects_native_writes(self, tmp_path,
+                                                     native_server):
+        from seaweedfs_tpu.storage.super_block import ReplicaPlacement
+
+        v = Volume(str(tmp_path), "", 6,
+                   replica_placement=ReplicaPlacement.parse("001"))
+        ne.serve_volume(6, v.nm)
+        st, _ = raw_request(native_server, b"W 6,1aabbccdd 2\nno")
+        assert st == 307  # fan-out must go through the HTTP handler
+        # reads are still served natively
+        n = Needle.create(b"replica read")
+        n.id, n.cookie = 0x9, 0xAABBCCDD
+        v.write_needle(n)
+        st, body = raw_request(native_server, b"G 6,9aabbccdd\n")
+        assert (st, body) == (0, b"replica read")
+        v.close()
+
+
+class TestVolumeServerIntegration:
+    @pytest.fixture
+    def cluster(self, tmp_path):
+        master = MasterServer(port=0, pulse_seconds=0.2)
+        master.start()
+        vs = VolumeServer([str(tmp_path)], master.address, port=0,
+                          pulse_seconds=0.2, enable_tcp=True)
+        vs.start()
+        vs.heartbeat_once()
+        yield master, vs
+        vs.stop()
+        master.stop()
+
+    def test_http_and_native_paths_share_state(self, cluster):
+        master, vs = cluster
+        if not getattr(vs, "_native_owner", False):
+            pytest.skip("another test holds the process-wide native port")
+        a = call(master.address, "/dir/assign")
+        call(a["url"], f"/{a['fid']}", raw=b"via http", method="POST")
+        client = VolumeTcpClient()
+        try:
+            assert client.read_needle(a["url"], a["fid"]) == b"via http"
+            b = call(master.address, "/dir/assign")
+            rep = json.loads(
+                client.write_needle(b["url"], b["fid"], b"via native"))
+            assert rep["eTag"]
+            got = call(b["url"], f"/{b['fid']}")
+            assert got == b"via native"
+            client.delete_needle(b["url"], b["fid"])
+            from seaweedfs_tpu.rpc.http_rpc import RpcError
+
+            with pytest.raises(RpcError):
+                call(b["url"], f"/{b['fid']}")
+        finally:
+            client.close()
+
+    def test_ttl_volume_not_served_natively(self, cluster):
+        """TTL volumes must 307 off the native port (its read path has
+        no expiry check); the TCP client transparently falls back to the
+        HTTP handler, which enforces expiry."""
+        master, vs = cluster
+        if not getattr(vs, "_native_owner", False):
+            pytest.skip("another test holds the process-wide native port")
+        a = call(master.address, "/dir/assign?ttl=5m")
+        call(a["url"], f"/{a['fid']}", raw=b"expiring", method="POST")
+        vs.heartbeat_once()  # resync bindings: TTL vid must be excluded
+        vid = int(a["fid"].split(",")[0])
+        assert vid not in getattr(vs, "_native_bound", set())
+        client = VolumeTcpClient()
+        try:
+            # served via the 307 -> HTTP fallback, not the native path
+            assert client.read_needle(a["url"], a["fid"]) == b"expiring"
+        finally:
+            client.close()
+
+    def test_bench_driver_smoke(self, cluster):
+        master, vs = cluster
+        if not getattr(vs, "_native_owner", False):
+            pytest.skip("another test holds the process-wide native port")
+        from seaweedfs_tpu.benchmark import run_benchmark
+
+        w, r = run_benchmark(master.address, num_files=300, file_size=256,
+                             concurrency=4, use_native=True,
+                             assign_batch=100, quiet=True)
+        assert w.requests == 300 and w.errors == 0
+        assert r.requests == 300 and r.errors == 0
+        assert len(w.latencies_ms) == 300

@@ -561,7 +561,8 @@ def cmd_benchmark(args):
 
     run_benchmark(args.master, num_files=args.n, file_size=args.size,
                   concurrency=args.c, delete_percent=args.deletePercent,
-                  replication=args.replication, use_tcp=args.useTcp)
+                  replication=args.replication, use_tcp=args.useTcp,
+                  use_native=args.useNative, assign_batch=args.assignBatch)
 
 
 def cmd_upload(args):
@@ -1167,6 +1168,12 @@ def main(argv=None):
     p.add_argument("-replication", default="000")
     p.add_argument("-useTcp", action="store_true",
                    help="read over the TCP fast path")
+    p.add_argument("-useNative", action="store_true",
+                   help="drive the native engine's fast-path port with "
+                        "the C++ load generator (batched assigns)")
+    p.add_argument("-assignBatch", type=int, default=256,
+                   help="fids per /dir/assign?count= call in -useNative "
+                        "mode")
     p.set_defaults(fn=cmd_benchmark)
 
     p = sub.add_parser("upload", help="upload one file")
