@@ -64,6 +64,8 @@
 #include <nmmintrin.h>
 #endif
 
+#include <zlib.h>
+
 namespace {
 
 // ---------------------------------------------------------------------------
@@ -649,6 +651,32 @@ bool parse_fid(const std::string& fid, uint32_t* vid, uint64_t* nid,
     return true;
 }
 
+// gunzip a stored-compressed needle payload (the HTTP handler without
+// Accept-Encoding: gzip decompresses; the fast path must agree —
+// volume_server_handlers_read.go:180-199 semantics)
+bool gunzip(const std::string& in, std::string* out) {
+    z_stream zs{};
+    if (inflateInit2(&zs, 15 + 16) != Z_OK) return false;  // gzip wrapper
+    out->clear();
+    out->reserve(in.size() * 3);
+    char buf[1 << 16];
+    zs.next_in = (Bytef*)in.data();
+    zs.avail_in = (uInt)in.size();
+    int rc;
+    do {
+        zs.next_out = (Bytef*)buf;
+        zs.avail_out = sizeof(buf);
+        rc = inflate(&zs, Z_NO_FLUSH);
+        if (rc != Z_OK && rc != Z_STREAM_END) {
+            inflateEnd(&zs);
+            return false;
+        }
+        out->append(buf, sizeof(buf) - zs.avail_out);
+    } while (rc != Z_STREAM_END && zs.avail_in > 0);
+    inflateEnd(&zs);
+    return rc == Z_STREAM_END;
+}
+
 Reply handle_read(uint32_t vid, uint64_t nid, uint32_t cookie) {
     auto v = serving_vol(vid);
     if (!v) return {307, "volume not served natively"};
@@ -679,7 +707,17 @@ Reply handle_read(uint32_t vid, uint64_t nid, uint32_t cookie) {
         if (stored != got && stored != crc_legacy_value(got))
             return {500, "CRC error! Data On Disk Corrupted"};
     }
-    return {0, blob.substr((size_t)data_off, (size_t)data_len)};
+    std::string data = blob.substr((size_t)data_off, (size_t)data_len);
+    if (v->version != 1 && data_len > 0 &&
+        data_off + data_len < kHeaderSize + size) {
+        uint8_t flags = b[data_off + data_len];
+        if (flags & 0x01) {  // IS_COMPRESSED: stored gzip, serve plain
+            std::string plain;
+            if (!gunzip(data, &plain)) return {500, "bad gzip needle"};
+            data.swap(plain);
+        }
+    }
+    return {0, std::move(data)};
 }
 
 std::string json_write_reply(int64_t size, uint32_t crc) {

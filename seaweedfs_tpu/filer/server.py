@@ -90,6 +90,12 @@ class FilerServer:
         self._conf_cache: tuple[float, FilerConf] = (0.0, FilerConf())
         self._prefetch_lock = threading.Lock()
         self._prefetching: set[str] = set()
+        # chunk fetches prefer the volume servers' TCP fast path (native
+        # engine); servers without one are negative-cached per URL
+        from ..wdclient.volume_tcp_client import VolumeTcpClient
+
+        self._tcp_client = VolumeTcpClient()
+        self._tcp_bad: dict[str, float] = {}
         self.server = RpcServer(host, port)
         self.server.add("GET", "/metadata/subscribe", self._h_subscribe)
         self.server.add("GET", "/metadata/aggregate", self._h_aggregate)
@@ -128,6 +134,7 @@ class FilerServer:
         self.filer.close()  # flush buffered change-log events
         self.filer.store.close()
         self.chunk_cache.close()  # tiered cache drops its disk segments
+        self._tcp_client.close()
 
     # -- per-path configuration (filer_conf.go, 1s refresh) ------------------
     def filer_conf(self) -> FilerConf:
@@ -492,16 +499,41 @@ class FilerServer:
             return cached
         FilerChunkCacheCounter.inc(labels=("miss",))
         url = self._lookup_url(fid)
-        headers = {}
-        if self.guard.read_signing:
-            headers["Authorization"] = "BEARER " + gen_read_jwt(
-                self.guard.read_signing, fid)
-        data = call(url, f"/{fid}", headers=headers, timeout=60)
-        if isinstance(data, dict):
-            raise RpcError(f"chunk {fid} fetch failed", 500)
-        data = bytes(data)
+        jwt = (gen_read_jwt(self.guard.read_signing, fid)
+               if self.guard.read_signing else "")
+        data = self._fetch_chunk_tcp(url, fid, jwt)
+        if data is None:
+            headers = {"Authorization": "BEARER " + jwt} if jwt else {}
+            data = call(url, f"/{fid}", headers=headers, timeout=60)
+            if isinstance(data, dict):
+                raise RpcError(f"chunk {fid} fetch failed", 500)
+            data = bytes(data)
         self.chunk_cache.put(fid, data)
         return data
+
+    def _fetch_chunk_tcp(self, url: str, fid: str, jwt: str):
+        """Try the volume server's TCP fast path for the chunk fetch
+        (served off-GIL by the native engine when built).  Servers
+        without a fast-path port — or answering 307 for this volume —
+        are negative-cached so the filer pays one probe per minute, not
+        two RPCs per chunk.  Returns None to fall back to HTTP; raises
+        for a real miss (the chunk is gone either way)."""
+        from ..wdclient.volume_tcp_client import VolumeTcpError
+
+        now = time.time()
+        if now < self._tcp_bad.get(url, 0.0):
+            return None
+        try:
+            return self._tcp_client.read_needle(url, fid, jwt=jwt,
+                                                http_fallback=False)
+        except VolumeTcpError as e:
+            if e.status == 404:
+                raise RpcError(f"chunk {fid} not found", 404) from None
+            self._tcp_bad[url] = now + 60.0
+            return None
+        except Exception:
+            self._tcp_bad[url] = now + 60.0
+            return None
 
     def read_bytes(self, entry: Entry, start: int = 0,
                    length: Optional[int] = None) -> bytes:

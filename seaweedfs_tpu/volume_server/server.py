@@ -330,7 +330,14 @@ class VolumeServer:
                         vid, nid, cookie = t.parse_file_id(fid)
                         n = self.store.read_needle(vid, nid,
                                                    cookie=cookie)
-                        reply(conn, 0, n.data)
+                        data = n.data
+                        if n.is_compressed:
+                            # fast path has no Accept-Encoding: agree
+                            # with the HTTP handler and serve plain
+                            import gzip as _gzip
+
+                            data = _gzip.decompress(data)
+                        reply(conn, 0, data)
                     except (NotFoundError, EcNotFoundError,
                             DeletedError, EcDeletedError,
                             CookieMismatchError):

@@ -99,14 +99,15 @@ class VolumeTcpClient:
             return payload
 
     def read_needle(self, volume_server_url: str, fid: str,
-                    jwt: str = "") -> bytes:
+                    jwt: str = "", http_fallback: bool = True) -> bytes:
         """Fast-path read; a 307 (volume not served natively: EC volume,
-        sqlite index, TTL volume, vacuum window) falls back to HTTP."""
+        sqlite index, TTL volume, vacuum window) falls back to HTTP
+        unless the caller wants to see the 307 and route itself."""
         line = f"G {fid} {jwt}\n" if jwt else f"G {fid}\n"
         try:
             return self._request(volume_server_url, line.encode())
         except VolumeTcpError as e:
-            if e.status != 307:
+            if e.status != 307 or not http_fallback:
                 raise
             return self._http_fallback(volume_server_url, fid, "GET",
                                        jwt=jwt)

@@ -317,6 +317,49 @@ class TestVolumeServerIntegration:
         finally:
             client.close()
 
+    def test_compressed_needle_served_plain(self, cluster):
+        """Store-side gzipped needles (gzippable name, HTTP write) must
+        come back decompressed on the fast path, matching an HTTP GET
+        without Accept-Encoding."""
+        master, vs = cluster
+        if not getattr(vs, "_native_owner", False):
+            pytest.skip("another test holds the process-wide native port")
+        a = call(master.address, "/dir/assign")
+        body = b"compress me " * 200  # > 128 B and compressible
+        call(a["url"], f"/{a['fid']}", raw=body, method="POST",
+             headers={"X-File-Name": "report.txt"})
+        # confirm it was stored compressed (otherwise this tests nothing)
+        vid, nid, _ = __import__(
+            "seaweedfs_tpu.storage.types", fromlist=["parse_file_id"]
+        ).parse_file_id(a["fid"])
+        n = vs.store.read_needle(vid, nid)
+        assert n.is_compressed
+        client = VolumeTcpClient()
+        try:
+            assert client.read_needle(a["url"], a["fid"]) == body
+        finally:
+            client.close()
+
+    def test_filer_chunk_fetch_rides_fast_path(self, cluster, tmp_path):
+        master, vs = cluster
+        if not getattr(vs, "_native_owner", False):
+            pytest.skip("another test holds the process-wide native port")
+        from seaweedfs_tpu.filer.server import FilerServer
+
+        fs = FilerServer(master.address, port=0, chunk_size=4096)
+        fs.start()
+        try:
+            body = bytes(range(256)) * 64  # 4 chunks
+            call(fs.address, "/f/blob.bin", raw=body, method="POST")
+            fs.chunk_cache.clear() if hasattr(fs.chunk_cache, "clear") \
+                else None
+            got = call(fs.address, "/f/blob.bin")
+            assert got == body
+            # the volume server was reachable over TCP: no negative cache
+            assert vs.store.url not in fs._tcp_bad
+        finally:
+            fs.stop()
+
     def test_bench_driver_smoke(self, cluster):
         master, vs = cluster
         if not getattr(vs, "_native_owner", False):
