@@ -301,9 +301,34 @@ struct NVolume {
 
 using VolPtr = std::shared_ptr<NVolume>;
 
+// EC volume handle: sorted .ecx + local shard files.  Serves reads whose
+// intervals all hit local shards; anything else answers 307 and the
+// client falls back to the HTTP ladder (local -> remote -> reconstruct,
+// store_ec.go:125-163).  Writes/deletes to EC volumes stay in Python.
+struct NEcVolume {
+    int ecx_fd = -1;
+    int64_t ecx_entries = 0;
+    int version = 3;
+    int64_t large_block = 0, small_block = 0;
+    int64_t shard_size = 0;  // any local shard's file size (ec_volume.py)
+    int shard_fds[14];
+    NEcVolume() {
+        for (int i = 0; i < 14; i++) shard_fds[i] = -1;
+    }
+    ~NEcVolume() {
+        if (ecx_fd >= 0) close(ecx_fd);
+        for (int i = 0; i < 14; i++)
+            if (shard_fds[i] >= 0) close(shard_fds[i]);
+    }
+};
+
+using EcPtr = std::shared_ptr<NEcVolume>;
+
 std::shared_mutex g_reg_mu;
 std::unordered_map<int64_t, VolPtr> g_handles;     // handle -> volume
 std::unordered_map<uint32_t, int64_t> g_serving;   // vid -> handle
+std::unordered_map<int64_t, EcPtr> g_ec_handles;   // handle -> EC volume
+std::unordered_map<uint32_t, int64_t> g_ec_serving;  // vid -> EC handle
 std::atomic<int64_t> g_next_handle{1};
 
 VolPtr handle_vol(int64_t h) {
@@ -318,6 +343,14 @@ VolPtr serving_vol(uint32_t vid) {
     if (it == g_serving.end()) return nullptr;
     auto hit = g_handles.find(it->second);
     return hit == g_handles.end() ? nullptr : hit->second;
+}
+
+EcPtr serving_ec(uint32_t vid) {
+    std::shared_lock<std::shared_mutex> lk(g_reg_mu);
+    auto it = g_ec_serving.find(vid);
+    if (it == g_ec_serving.end()) return nullptr;
+    auto hit = g_ec_handles.find(it->second);
+    return hit == g_ec_handles.end() ? nullptr : hit->second;
 }
 
 bool append_idx_entry(NVolume* v, uint64_t nid, uint64_t off, int32_t size) {
@@ -608,6 +641,91 @@ int svn_quiesce(int64_t handle) {
     return 0;
 }
 
+// ---------------------------------------------------------------------------
+// EC volume API
+// ---------------------------------------------------------------------------
+
+int64_t svn_ec_register(const char* ecx_path, int version,
+                        int64_t large_block, int64_t small_block) {
+    auto ev = std::make_shared<NEcVolume>();
+    ev->version = version;
+    ev->large_block = large_block;
+    ev->small_block = small_block;
+    ev->ecx_fd = open(ecx_path, O_RDONLY);
+    if (ev->ecx_fd < 0) return -errno;
+    struct stat st;
+    if (fstat(ev->ecx_fd, &st) != 0) return -errno;
+    ev->ecx_entries = st.st_size / 16;
+    int64_t h = g_next_handle.fetch_add(1);
+    std::unique_lock<std::shared_mutex> lk(g_reg_mu);
+    g_ec_handles[h] = std::move(ev);
+    return h;
+}
+
+int svn_ec_add_shard(int64_t handle, int shard_id, const char* path) {
+    if (shard_id < 0 || shard_id >= 14) return -1;
+    std::shared_lock<std::shared_mutex> lk(g_reg_mu);
+    auto it = g_ec_handles.find(handle);
+    if (it == g_ec_handles.end()) return -1;
+    auto& ev = it->second;
+    int fd = open(path, O_RDONLY);
+    if (fd < 0) return -errno;
+    struct stat st;
+    if (fstat(fd, &st) != 0) {
+        close(fd);
+        return -errno;
+    }
+    if (ev->shard_fds[shard_id] >= 0) close(ev->shard_fds[shard_id]);
+    ev->shard_fds[shard_id] = fd;
+    ev->shard_size = st.st_size;
+    return 0;
+}
+
+int svn_ec_remove_shard(int64_t handle, int shard_id) {
+    if (shard_id < 0 || shard_id >= 14) return -1;
+    std::shared_lock<std::shared_mutex> lk(g_reg_mu);
+    auto it = g_ec_handles.find(handle);
+    if (it == g_ec_handles.end()) return -1;
+    auto& ev = it->second;
+    if (ev->shard_fds[shard_id] >= 0) {
+        close(ev->shard_fds[shard_id]);
+        ev->shard_fds[shard_id] = -1;
+    }
+    return 0;
+}
+
+int svn_ec_serve(uint32_t vid, int64_t handle) {
+    std::unique_lock<std::shared_mutex> lk(g_reg_mu);
+    if (handle <= 0) {
+        g_ec_serving.erase(vid);
+        return 0;
+    }
+    if (!g_ec_handles.count(handle)) return -1;
+    g_ec_serving[vid] = handle;
+    return 0;
+}
+
+int svn_ec_unregister(int64_t handle) {
+    std::unique_lock<std::shared_mutex> lk(g_reg_mu);
+    for (auto it = g_ec_serving.begin(); it != g_ec_serving.end();) {
+        if (it->second == handle) it = g_ec_serving.erase(it);
+        else ++it;
+    }
+    return g_ec_handles.erase(handle) ? 0 : -1;
+}
+
+// Refresh the cached .ecx entry count (the file grows only on rebuild;
+// deletes rewrite size fields in place, which preads observe directly)
+int svn_ec_refresh(int64_t handle) {
+    std::shared_lock<std::shared_mutex> lk(g_reg_mu);
+    auto it = g_ec_handles.find(handle);
+    if (it == g_ec_handles.end()) return -1;
+    struct stat st;
+    if (fstat(it->second->ecx_fd, &st) != 0) return -errno;
+    it->second->ecx_entries = st.st_size / 16;
+    return 0;
+}
+
 }  // extern "C"
 
 // ---------------------------------------------------------------------------
@@ -677,9 +795,124 @@ bool gunzip(const std::string& in, std::string* out) {
     return rc == Z_STREAM_END;
 }
 
+// Verify + extract the payload from a full needle record blob: size and
+// cookie checks, CRC over data, store-side-gzip decompression
+// (needle_read.go ReadBytes:52-95 + the HTTP handler's encoding rules)
+Reply finish_needle_read(const std::string& blob, int32_t size, int version,
+                         uint32_t cookie) {
+    const uint8_t* b = (const uint8_t*)blob.data();
+    int64_t actual = (int64_t)blob.size();
+    uint32_t rec_cookie = get_be32(b);
+    int32_t rec_size = (int32_t)get_be32(b + 12);
+    if (rec_size != size) return {500, "size mismatch"};
+    if (rec_cookie != cookie) return {404, "cookie mismatch"};
+    int64_t data_off, data_len;
+    if (!parse_needle_data(b, actual, size, version, &data_off, &data_len))
+        return {500, "bad needle"};
+    if (size > 0) {
+        uint32_t stored = get_be32(b + kHeaderSize + size);
+        uint32_t got = crc32c(b + data_off, (size_t)data_len);
+        if (stored != got && stored != crc_legacy_value(got))
+            return {500, "CRC error! Data On Disk Corrupted"};
+    }
+    std::string data = blob.substr((size_t)data_off, (size_t)data_len);
+    if (version != 1 && data_len > 0 &&
+        data_off + data_len < kHeaderSize + size) {
+        uint8_t flags = b[data_off + data_len];
+        if (flags & 0x01) {  // IS_COMPRESSED: stored gzip, serve plain
+            std::string plain;
+            if (!gunzip(data, &plain)) return {500, "bad gzip needle"};
+            data.swap(plain);
+        }
+    }
+    return {0, std::move(data)};
+}
+
+// EC read: .ecx binary search -> interval math -> local shard preads.
+// Exactly ec_volume.py locate_needle/read_needle (themselves the
+// bit-for-bit port of ec_locate.go + SearchNeedleFromSortedIndex,
+// ec_volume.go:206-255); any non-local interval answers 307 so the
+// Python ladder (remote fetch / reconstruct) takes over.
+Reply handle_ec_read(const EcPtr& ev, uint64_t nid, uint32_t cookie) {
+    int64_t lo = 0, hi = ev->ecx_entries - 1;
+    uint64_t off = 0;
+    int32_t size = 0;
+    bool found = false;
+    uint8_t e[16];
+    while (lo <= hi) {
+        int64_t mid = lo + (hi - lo) / 2;
+        if (!pread_full(ev->ecx_fd, e, 16, mid * 16))
+            return {500, "ecx read failed"};
+        uint64_t k = get_be64(e);
+        if (k == nid) {
+            off = (uint64_t)get_be32(e + 8) * kPaddingSize;
+            size = (int32_t)get_be32(e + 12);
+            found = true;
+            break;
+        }
+        if (k < nid) lo = mid + 1;
+        else hi = mid - 1;
+    }
+    if (!found) return {404, "not found"};
+    if (size < 0) return {404, "already deleted"};
+    if (ev->shard_size <= 0) return {307, "no local shards"};
+
+    const int64_t lb = ev->large_block, sb = ev->small_block;
+    const int64_t dat_size = 10 * ev->shard_size;
+    int64_t actual = get_actual_size(size, ev->version);
+    // _locate_offset (ec_locate.go:55-75)
+    int64_t large_row_size = lb * 10;
+    int64_t rows_by_size = dat_size / large_row_size;
+    int64_t block_index, inner;
+    bool is_large;
+    int64_t pos = (int64_t)off;
+    if (pos < rows_by_size * large_row_size) {
+        block_index = pos / lb;
+        is_large = true;
+        inner = pos % lb;
+    } else {
+        pos -= rows_by_size * large_row_size;
+        block_index = pos / sb;
+        is_large = false;
+        inner = pos % sb;
+    }
+    // large-row count derivable from shard size (ec_locate.go:18-19)
+    int64_t n_large_rows = (dat_size + 10 * sb) / (lb * 10);
+
+    std::string blob((size_t)actual, '\0');
+    int64_t want = actual, wrote = 0;
+    while (want > 0) {
+        int64_t block_len = is_large ? lb : sb;
+        int64_t take = std::min(want, block_len - inner);
+        // ToShardIdAndOffset (ec_locate.go:77-87)
+        int64_t row = block_index / 10;
+        int64_t ec_off = inner +
+                         (is_large ? row * lb : n_large_rows * lb + row * sb);
+        int sid = (int)(block_index % 10);
+        int fd = ev->shard_fds[sid];
+        if (fd < 0) return {307, "shard not local"};
+        if (!pread_full(fd, (uint8_t*)blob.data() + wrote, (size_t)take,
+                        ec_off))
+            return {500, "short shard read"};
+        wrote += take;
+        want -= take;
+        block_index++;
+        if (is_large && block_index == n_large_rows * 10) {
+            is_large = false;
+            block_index = 0;
+        }
+        inner = 0;
+    }
+    return finish_needle_read(blob, size, ev->version, cookie);
+}
+
 Reply handle_read(uint32_t vid, uint64_t nid, uint32_t cookie) {
     auto v = serving_vol(vid);
-    if (!v) return {307, "volume not served natively"};
+    if (!v) {
+        auto ev = serving_ec(vid);
+        if (ev) return handle_ec_read(ev, nid, cookie);
+        return {307, "volume not served natively"};
+    }
     uint64_t off;
     int32_t size;
     {
@@ -693,31 +926,7 @@ Reply handle_read(uint32_t vid, uint64_t nid, uint32_t cookie) {
     if (!pread_full(v->dat_fd, (uint8_t*)blob.data(), (size_t)actual,
                     (int64_t)off))
         return {500, "short read"};
-    const uint8_t* b = (const uint8_t*)blob.data();
-    uint32_t rec_cookie = get_be32(b);
-    int32_t rec_size = (int32_t)get_be32(b + 12);
-    if (rec_size != size) return {500, "size mismatch"};
-    if (rec_cookie != cookie) return {404, "cookie mismatch"};
-    int64_t data_off, data_len;
-    if (!parse_needle_data(b, actual, size, v->version, &data_off, &data_len))
-        return {500, "bad needle"};
-    if (size > 0) {
-        uint32_t stored = get_be32(b + kHeaderSize + size);
-        uint32_t got = crc32c(b + data_off, (size_t)data_len);
-        if (stored != got && stored != crc_legacy_value(got))
-            return {500, "CRC error! Data On Disk Corrupted"};
-    }
-    std::string data = blob.substr((size_t)data_off, (size_t)data_len);
-    if (v->version != 1 && data_len > 0 &&
-        data_off + data_len < kHeaderSize + size) {
-        uint8_t flags = b[data_off + data_len];
-        if (flags & 0x01) {  // IS_COMPRESSED: stored gzip, serve plain
-            std::string plain;
-            if (!gunzip(data, &plain)) return {500, "bad gzip needle"};
-            data.swap(plain);
-        }
-    }
-    return {0, std::move(data)};
+    return finish_needle_read(blob, size, v->version, cookie);
 }
 
 std::string json_write_reply(int64_t size, uint32_t crc) {

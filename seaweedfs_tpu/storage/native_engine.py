@@ -77,6 +77,14 @@ def lib() -> Optional[ctypes.CDLL]:
     cdll.svn_quiesce.argtypes = [_i64]
     cdll.svn_last_modified.restype = _i64
     cdll.svn_last_modified.argtypes = [_i64]
+    cdll.svn_ec_register.restype = _i64
+    cdll.svn_ec_register.argtypes = [ctypes.c_char_p, ctypes.c_int, _i64,
+                                     _i64]
+    cdll.svn_ec_add_shard.argtypes = [_i64, ctypes.c_int, ctypes.c_char_p]
+    cdll.svn_ec_remove_shard.argtypes = [_i64, ctypes.c_int]
+    cdll.svn_ec_serve.argtypes = [_u32, _i64]
+    cdll.svn_ec_unregister.argtypes = [_i64]
+    cdll.svn_ec_refresh.argtypes = [_i64]
     cdll.svn_server_start.restype = ctypes.c_int
     cdll.svn_server_start.argtypes = [ctypes.c_char_p, ctypes.c_int]
     cdll.svn_server_stop.restype = ctypes.c_int
@@ -238,6 +246,58 @@ class NativeNeedleMap:
 
     def bytes_per_entry(self) -> float:
         return 25.0  # 16B slot + state byte + vector overhead
+
+
+class NativeEcBinding:
+    """Native serving of an EcVolume's local-shard reads: the .ecx and
+    every local `.ecNN` open in C++, bound to the vid for the TCP server.
+    Reads whose intervals touch a non-local shard answer 307 and fall
+    back to the Python ladder (remote fetch / on-the-fly reconstruct)."""
+
+    def __init__(self, ec_volume):
+        self._lib = lib()
+        if self._lib is None:
+            raise RuntimeError("native engine unavailable")
+        base = ec_volume.base_file_name()
+        h = self._lib.svn_ec_register(
+            (base + ".ecx").encode(), ec_volume.version,
+            ec_volume.large_block_size, ec_volume.small_block_size)
+        if h <= 0:
+            raise OSError(-h, f"svn_ec_register({base!r}) failed")
+        self.handle = h
+        self.shard_ids: frozenset = frozenset()
+        self.sync_shards(ec_volume)
+
+    def sync_shards(self, ec_volume):
+        current = frozenset(ec_volume.shards)
+        for sid in sorted(current - self.shard_ids):
+            shard = ec_volume.shards[sid]
+            self._lib.svn_ec_add_shard(
+                self.handle, sid, shard.file_name().encode())
+        for sid in sorted(self.shard_ids - current):
+            # unmounted shards must stop serving (and release the fd:
+            # ec.balance deletes the file after moving it)
+            self._lib.svn_ec_remove_shard(self.handle, sid)
+        self.shard_ids = current
+        self._lib.svn_ec_refresh(self.handle)
+
+    def close(self):
+        if self.handle:
+            self._lib.svn_ec_unregister(self.handle)
+            self.handle = 0
+
+
+def serve_ec_volume(vid: int, binding: NativeEcBinding) -> bool:
+    cdll = lib()
+    if cdll is None:
+        return False
+    return cdll.svn_ec_serve(vid, binding.handle) == 0
+
+
+def unserve_ec_volume(vid: int):
+    cdll = lib()
+    if cdll is not None:
+        cdll.svn_ec_serve(vid, 0)
 
 
 # -- server / serving registry ----------------------------------------------

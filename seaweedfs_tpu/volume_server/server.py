@@ -48,6 +48,17 @@ EC_SHARD_CACHE_TTL_INCOMPLETE = 7 * 60.0
 EC_SHARD_CACHE_TTL_HEALTHY = 37 * 60.0
 
 
+class _EcBindingEntry:
+    """One EC volume's native serving state (binding + the EcVolume
+    instance it was built from, to detect remounts)."""
+
+    __slots__ = ("ev", "binding")
+
+    def __init__(self, ev, binding):
+        self.ev = ev
+        self.binding = binding
+
+
 class _InflightGate:
     """In-flight byte throttle (volume_server.go:21-50 cond-var limits).
 
@@ -215,6 +226,9 @@ class VolumeServer:
 
             for vid in getattr(self, "_native_bound", set()):
                 native_engine.unserve_volume(vid)
+            for vid, entry in getattr(self, "_native_ec", {}).items():
+                native_engine.unserve_ec_volume(vid)
+                entry.binding.close()
             native_engine.server_stop()
             self._native_owner = False
         if self._tcp_sock is not None:
@@ -235,6 +249,7 @@ class VolumeServer:
         from ..storage import native_engine
 
         current = {}
+        ec_current = {}
         for loc in self.store.locations:
             for vid, v in list(loc.volumes.items()):
                 # TTL volumes stay off the native port: its read path has
@@ -243,12 +258,37 @@ class VolumeServer:
                 if (isinstance(v.nm, native_engine.NativeNeedleMap)
                         and not v.ttl):
                     current[vid] = v.nm
+            for vid, ev in list(loc.ec_volumes.items()):
+                ec_current[vid] = ev
         bound = getattr(self, "_native_bound", set())
         for vid in bound - current.keys():
             native_engine.unserve_volume(vid)
         for vid, nm in current.items():
             native_engine.serve_volume(vid, nm)
         self._native_bound = set(current)
+        # EC volumes: bind local-shard read serving; rebind when the
+        # EcVolume instance or its shard set changed (mount/copy/rebuild)
+        ec_bound = getattr(self, "_native_ec", {})
+        for vid in set(ec_bound) - ec_current.keys():
+            native_engine.unserve_ec_volume(vid)
+            ec_bound.pop(vid).binding.close()
+        for vid, ev in ec_current.items():
+            entry = ec_bound.get(vid)
+            if entry is not None and entry.ev is not ev:
+                native_engine.unserve_ec_volume(vid)
+                entry.binding.close()
+                entry = None
+            if entry is None:
+                try:
+                    binding = native_engine.NativeEcBinding(ev)
+                except (OSError, RuntimeError):
+                    continue  # e.g. .ecx missing mid-copy: retry next sync
+                entry = _EcBindingEntry(ev, binding)
+                ec_bound[vid] = entry
+            else:
+                entry.binding.sync_shards(ev)
+            native_engine.serve_ec_volume(vid, entry.binding)
+        self._native_ec = ec_bound
 
     # -- TCP fast path (volume_server_tcp, port+20000) -----------------------
     def _start_tcp(self):

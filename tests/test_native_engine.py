@@ -360,6 +360,172 @@ class TestVolumeServerIntegration:
         finally:
             fs.stop()
 
+    def test_mixed_path_soak(self, cluster):
+        """Writers/readers/deleters split across the HTTP handlers and
+        the native port, with vacuum racing — every read returns the
+        exact bytes or a clean 404 after delete, on either path (the
+        shared-index + shared-append-mutex contract)."""
+        import random
+        import threading
+
+        master, vs = cluster
+        if not getattr(vs, "_native_owner", False):
+            pytest.skip("another test holds the process-wide native port")
+        from seaweedfs_tpu.rpc.http_rpc import RpcError
+
+        client = VolumeTcpClient(max_conns_per_server=8)
+        written: dict[str, bytes] = {}
+        deleted: set[str] = set()
+        lock = threading.Lock()
+        failures: list[str] = []
+        stop = threading.Event()
+
+        def writer(seed: int):
+            rng = random.Random(seed)
+            for i in range(80):
+                if stop.is_set():
+                    return
+                body = bytes(rng.randrange(256)
+                             for _ in range(rng.randrange(10, 1500)))
+                try:
+                    a = call(master.address, "/dir/assign")
+                    if i % 2:
+                        client.write_needle(a["url"], a["fid"], body)
+                    else:
+                        call(a["url"], f"/{a['fid']}", raw=body,
+                             method="POST")
+                except (RpcError, VolumeTcpError, OSError) as e:
+                    failures.append(f"write: {e}")
+                    continue
+                with lock:
+                    written[f"{a['url']}/{a['fid']}"] = body
+
+        def deleter():
+            rng = random.Random(7)
+            while not stop.is_set():
+                with lock:
+                    candidates = [k for k in written if k not in deleted]
+                if len(candidates) > 20:
+                    key = rng.choice(candidates)
+                    url, fid = key.rsplit("/", 1)
+                    with lock:
+                        deleted.add(key)
+                    try:
+                        if rng.random() < 0.5:
+                            client.delete_needle(url, fid)
+                        else:
+                            call(url, f"/{fid}", method="DELETE")
+                    except (RpcError, VolumeTcpError, OSError):
+                        pass
+                stop.wait(0.01)
+
+        def reader(seed: int):
+            rng = random.Random(seed)
+            while not stop.is_set():
+                with lock:
+                    if not written:
+                        continue
+                    key, body = rng.choice(list(written.items()))
+                    was_deleted = key in deleted
+                url, fid = key.rsplit("/", 1)
+                try:
+                    if rng.random() < 0.5:
+                        got = client.read_needle(url, fid)
+                    else:
+                        got = call(url, f"/{fid}", parse=False,
+                                   timeout=10)
+                    if bytes(got) != body and not was_deleted:
+                        with lock:
+                            still_live = key not in deleted
+                        if still_live:
+                            failures.append(f"corrupt read {fid}")
+                except (RpcError, VolumeTcpError) as e:
+                    status = getattr(e, "status", 500)
+                    if status != 404:
+                        failures.append(f"read {fid}: {e}")
+                    elif not was_deleted:
+                        with lock:
+                            still_live = key not in deleted
+                        if still_live:
+                            failures.append(f"missing live {fid}")
+                except OSError as e:
+                    failures.append(f"read {fid}: {e}")
+
+        def vacuumer():
+            while not stop.is_set():
+                try:
+                    call(master.address,
+                         "/vol/vacuum?garbageThreshold=0.01", {},
+                         timeout=30)
+                except RpcError:
+                    pass
+                stop.wait(0.3)
+
+        threads = ([threading.Thread(target=writer, args=(i,))
+                    for i in range(4)]
+                   + [threading.Thread(target=reader, args=(50 + i,))
+                      for i in range(4)]
+                   + [threading.Thread(target=deleter),
+                      threading.Thread(target=vacuumer)])
+        for t in threads:
+            t.start()
+        for t in threads[:4]:
+            t.join(timeout=120)
+        stop.set()
+        for t in threads[4:]:
+            t.join(timeout=30)
+        client.close()
+        assert not failures, failures[:10]
+        assert len(written) >= 300
+        live = [(k, v) for k, v in written.items() if k not in deleted]
+        for key, body in random.sample(live, min(40, len(live))):
+            url, fid = key.rsplit("/", 1)
+            assert bytes(call(url, f"/{fid}", parse=False)) == body
+
+    def test_ec_reads_served_natively(self, cluster):
+        """After ec.encode on a single-server cluster (all 14 shards
+        local), fast-path reads are answered by the C++ EC path — raw
+        status 0, not 307 — byte-identical to the pre-encode payloads;
+        EC deletes are observed (ecx rewrites are read in place)."""
+        import os as _os
+
+        master, vs = cluster
+        if not getattr(vs, "_native_owner", False):
+            pytest.skip("another test holds the process-wide native port")
+        from seaweedfs_tpu.shell import commands as sh
+
+        stored = {}
+        vid = None
+        for i in range(25):
+            a = call(master.address, "/dir/assign")
+            if vid is None:
+                vid = int(a["fid"].split(",")[0])
+            payload = _os.urandom(400 + 37 * i)
+            call(a["url"], f"/{a['fid']}", raw=payload, method="POST")
+            stored[a["fid"]] = payload
+        env = sh.CommandEnv(master.address)
+        sh.ec_encode(env, vid)
+        vs.heartbeat_once()  # binds the EC volume natively
+        assert vid in getattr(vs, "_native_ec", {})
+
+        checked = 0
+        victim = None
+        for fid, payload in stored.items():
+            if int(fid.split(",")[0]) != vid:
+                continue
+            st, body = raw_request(
+                vs.tcp_port, f"G {fid}\n".encode())
+            assert st == 0, f"expected native EC read, got {st} {body!r}"
+            assert body == payload
+            checked += 1
+            victim = fid
+        assert checked > 0
+        # EC delete rewrites the .ecx size in place: the native path
+        # observes it without a rebind
+        call(vs.store.url, f"/{victim}", method="DELETE")
+        st, _ = raw_request(vs.tcp_port, f"G {victim}\n".encode())
+        assert st == 404
+
     def test_bench_driver_smoke(self, cluster):
         master, vs = cluster
         if not getattr(vs, "_native_owner", False):
