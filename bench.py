@@ -386,18 +386,18 @@ def _pick_workdir(need_bytes: int) -> str:
     return tempfile.mkdtemp(prefix="swbench")
 
 
-def bench_small_file(num_files: int) -> tuple[float, float]:
+def bench_small_file(num_files: int) -> tuple[float, float, float]:
     """Small-file data plane (weed benchmark, 1 KB c=16) through the
     native engine's fast-path port — the reference README's headline
     load test (command/benchmark.go; README.md:342-391).  Returns
-    (writes/s, reads/s); (0, 0) when the native library is missing."""
+    (writes/s, framed reads/s, plain-HTTP reads/s); zeros when the
+    native library is missing."""
     from seaweedfs_tpu.storage import native_engine
 
     if not native_engine.available():
-        return 0.0, 0.0
+        return 0.0, 0.0, 0.0
     import tempfile
 
-    from seaweedfs_tpu.benchmark import run_benchmark
     from seaweedfs_tpu.master.server import MasterServer
     from seaweedfs_tpu.volume_server.server import VolumeServer
 
@@ -411,13 +411,13 @@ def bench_small_file(num_files: int) -> tuple[float, float]:
     vs.start()
     vs.heartbeat_once()
     try:
-        w, r = run_benchmark(master.address, num_files=num_files,
-                             file_size=1024, concurrency=16,
-                             use_native=True, assign_batch=1000,
-                             quiet=True)
+        from seaweedfs_tpu.benchmark import _run_native
+
+        w, r = _run_native(master.address, num_files, 1024, 16, 0, "000",
+                           True, True, 1000, http_phase=True)
         write_rps = w.requests / w.seconds if w.seconds else 0.0
         read_rps = r.requests / r.seconds if r.seconds else 0.0
-        return write_rps, read_rps
+        return write_rps, read_rps, getattr(r, "http_rps", 0.0)
     finally:
         vs.stop()
         master.stop()
@@ -552,9 +552,10 @@ def main():
     # 1M x 1 KB c=16 published numbers: 15,708 writes/s / 47,019 reads/s
     # (reference README.md:342-391).  Scaled-down here to keep bench.py's
     # wall-clock bounded; rates are steady within ~10% of the 1M run.
-    sf_write_rps = sf_read_rps = 0.0
+    sf_write_rps = sf_read_rps = sf_http_read_rps = 0.0
     try:
-        sf_write_rps, sf_read_rps = bench_small_file(200_000)
+        sf_write_rps, sf_read_rps, sf_http_read_rps = \
+            bench_small_file(200_000)
     except Exception as e:
         print(f"note: small-file bench failed: {e}", file=sys.stderr)
 
@@ -591,8 +592,11 @@ def main():
         "link_d2h_mbps": round(d2h_mbps, 1),
         "smallfile_write_rps": round(sf_write_rps, 1),
         "smallfile_read_rps": round(sf_read_rps, 1),
+        "smallfile_http_read_rps": round(sf_http_read_rps, 1),
         "smallfile_vs_ref_write": round(sf_write_rps / 15708.23, 2),
         "smallfile_vs_ref_read": round(sf_read_rps / 47019.38, 2),
+        "smallfile_http_vs_ref_read": round(
+            sf_http_read_rps / 47019.38, 2),
         "note": ("value = HBM-resident batched parity+CRC word-layout "
                  "step (BASELINE config 4/5); e2e_default is the "
                  "link-throughput auto-selected ec.encode path (must "

@@ -172,6 +172,19 @@ constexpr int64_t kMaxVolumeSize = 32LL * 1024 * 1024 * 1024;
 constexpr uint8_t kFlagHasLastModified = 0x08;
 constexpr int kLastModifiedBytes = 5;
 
+// Cumulative request counters (exposed to Prometheus via
+// svn_server_stats; native requests never enter Python, so the
+// observability surface must be fed from here)
+std::atomic<int64_t> g_stat_reads{0}, g_stat_ec_reads{0};
+std::atomic<int64_t> g_stat_writes{0}, g_stat_deletes{0};
+std::atomic<int64_t> g_stat_http_reads{0}, g_stat_fallbacks{0};
+std::atomic<int64_t> g_stat_errors{0};
+
+void count_reply(uint32_t status) {
+    if (status == 307) g_stat_fallbacks.fetch_add(1);
+    else if (status >= 400) g_stat_errors.fetch_add(1);
+}
+
 int padding_length(int64_t needle_size, int version) {
     int64_t base = kHeaderSize + needle_size + kChecksumSize;
     if (version == 3) base += kTimestampSize;
@@ -834,6 +847,7 @@ Reply finish_needle_read(const std::string& blob, int32_t size, int version,
 // ec_volume.go:206-255); any non-local interval answers 307 so the
 // Python ladder (remote fetch / reconstruct) takes over.
 Reply handle_ec_read(const EcPtr& ev, uint64_t nid, uint32_t cookie) {
+    g_stat_ec_reads.fetch_add(1);
     int64_t lo = 0, hi = ev->ecx_entries - 1;
     uint64_t off = 0;
     int32_t size = 0;
@@ -1105,6 +1119,88 @@ struct Server {
 
 Server* g_server = nullptr;
 std::mutex g_server_mu;
+std::string g_http_redirect;  // "host:port" of the full HTTP handler
+
+bool recv_some(int fd, std::string& buf);
+
+// Minimal HTTP/1.1 reply on the fast-path port (keep-alive).  Only
+// plain needle GET/HEADs are answered here; anything else 302s to the
+// full Python handler (g_http_redirect).
+bool send_http_reply(int fd, int status, const char* reason,
+                     const std::string& body, bool head,
+                     const std::string& extra_headers) {
+    char hdr[512];
+    int n = snprintf(hdr, sizeof(hdr),
+                     "HTTP/1.1 %d %s\r\n"
+                     "Content-Length: %zu\r\n"
+                     "Content-Type: application/octet-stream\r\n"
+                     "%s"
+                     "Connection: keep-alive\r\n\r\n",
+                     status, reason, body.size(), extra_headers.c_str());
+    std::string out(hdr, (size_t)n);
+    if (!head) out += body;
+    size_t sent = 0;
+    while (sent < out.size()) {
+        ssize_t r = send(fd, out.data() + sent, out.size() - sent, 0);
+        if (r <= 0) return false;
+        sent += (size_t)r;
+    }
+    return true;
+}
+
+// Handle one HTTP request whose request line is already parsed off
+// `buf` (headers still pending).  Returns false to drop the connection.
+bool serve_http_request(Server* srv, int fd, const std::string& method,
+                        const std::string& target, std::string& buf) {
+    // drain headers until the blank line
+    for (;;) {
+        size_t nl;
+        while ((nl = buf.find('\n')) == std::string::npos) {
+            if (!recv_some(fd, buf)) return false;
+            if (srv->stop.load()) return false;
+        }
+        std::string line = buf.substr(0, nl);
+        buf.erase(0, nl + 1);
+        if (!line.empty() && line.back() == '\r') line.pop_back();
+        if (line.empty()) break;
+    }
+    bool head = (method == "HEAD");
+    std::string path = target;
+    size_t q = path.find('?');
+    bool has_query = q != std::string::npos;
+    if (has_query) path = path.substr(0, q);
+    uint32_t vid;
+    uint64_t nid;
+    uint32_t cookie;
+    std::string fid = path.substr(path.find('/') == 0 ? 1 : 0);
+    // volume-server fid paths may use "vid/fid" form; normalize to comma
+    size_t slash = fid.find('/');
+    if (slash != std::string::npos) fid[slash] = ',';
+    if (has_query || !parse_fid(fid, &vid, &nid, &cookie)) {
+        if (g_http_redirect.empty())
+            return send_http_reply(fd, 404, "Not Found", "not found",
+                                   head, "");
+        return send_http_reply(
+            fd, 302, "Found", "", head,
+            "Location: http://" + g_http_redirect + target + "\r\n");
+    }
+    Reply r = handle_read(vid, nid, cookie);
+    if (r.status == 0)
+        return send_http_reply(fd, 200, "OK", r.payload, head,
+                               "Accept-Ranges: bytes\r\n");
+    if (r.status == 307) {
+        if (g_http_redirect.empty())
+            return send_http_reply(fd, 404, "Not Found", r.payload, head,
+                                   "");
+        return send_http_reply(
+            fd, 302, "Found", "", head,
+            "Location: http://" + g_http_redirect + target + "\r\n");
+    }
+    if (r.status == 404)
+        return send_http_reply(fd, 404, "Not Found", r.payload, head, "");
+    return send_http_reply(fd, 500, "Internal Server Error", r.payload,
+                           head, "");
+}
 
 bool send_reply(int fd, uint32_t status, const std::string& payload) {
     uint8_t hdr[8];
@@ -1175,12 +1271,20 @@ void serve_conn(Server* srv, int fd) {
             uint32_t vid;
             uint64_t nid;
             uint32_t cookie;
-            if (op == "G" && (parts.size() == 2 || parts.size() == 3)) {
+            if ((op == "GET" || op == "HEAD") && parts.size() == 3) {
+                // plain HTTP clients may hit the fast-path port too
+                g_stat_http_reads.fetch_add(1);
+                if (!serve_http_request(srv, fd, op, parts[1], buf))
+                    goto done;
+            } else if (op == "G"
+                       && (parts.size() == 2 || parts.size() == 3)) {
+                g_stat_reads.fetch_add(1);
                 if (!parse_fid(parts[1], &vid, &nid, &cookie)) {
                     if (!send_reply(fd, 400, "bad fid")) goto done;
                     continue;
                 }
                 Reply r = handle_read(vid, nid, cookie);
+                count_reply(r.status);
                 if (!send_reply(fd, r.status, r.payload)) goto done;
             } else if (op == "W" && parts.size() == 3) {
                 errno = 0;
@@ -1201,14 +1305,18 @@ void serve_conn(Server* srv, int fd) {
                     if (!send_reply(fd, 400, "bad fid")) goto done;
                     continue;
                 }
+                g_stat_writes.fetch_add(1);
                 Reply r = handle_write(vid, nid, cookie, body);
+                count_reply(r.status);
                 if (!send_reply(fd, r.status, r.payload)) goto done;
             } else if (op == "D" && parts.size() == 2) {
+                g_stat_deletes.fetch_add(1);
                 if (!parse_fid(parts[1], &vid, &nid, &cookie)) {
                     if (!send_reply(fd, 400, "bad fid")) goto done;
                     continue;
                 }
                 Reply r = handle_delete(vid, nid, cookie);
+                count_reply(r.status);
                 if (!send_reply(fd, r.status, r.payload)) goto done;
             } else {
                 if (!send_reply(fd, 400, "bad request")) goto done;
@@ -1233,6 +1341,14 @@ done:
 }  // namespace
 
 extern "C" {
+
+// Where the fast-path port 302s HTTP requests it cannot serve (the
+// volume server's full handler).  Set before svn_server_start.
+int svn_server_set_redirect(const char* addr) {
+    std::lock_guard<std::mutex> lk(g_server_mu);
+    g_http_redirect = addr ? addr : "";
+    return 0;
+}
 
 // Start the native fast-path server; returns the bound port or -errno.
 int svn_server_start(const char* host, int port) {
@@ -1284,6 +1400,19 @@ int svn_server_start(const char* host, int port) {
     });
     g_server = srv;
     return bound;
+}
+
+// out[0..6] = framed reads, ec reads, writes, deletes, http reads,
+//             307 fallbacks, errors
+int svn_server_stats(int64_t* out) {
+    out[0] = g_stat_reads.load();
+    out[1] = g_stat_ec_reads.load();
+    out[2] = g_stat_writes.load();
+    out[3] = g_stat_deletes.load();
+    out[4] = g_stat_http_reads.load();
+    out[5] = g_stat_fallbacks.load();
+    out[6] = g_stat_errors.load();
+    return 0;
 }
 
 int svn_server_stop() {
@@ -1372,6 +1501,8 @@ double svn_bench(const char* host, int port, int op, const char* fids,
                       "\n" + payload;
             } else if (op == 'D') {
                 req = "D " + fid + "\n";
+            } else if (op == 'H') {  // HTTP GET against the same port
+                req = "GET /" + fid + " HTTP/1.1\r\nHost: bench\r\n\r\n";
             } else {
                 req = "G " + fid + "\n";
             }
@@ -1386,7 +1517,33 @@ double svn_bench(const char* host, int port, int op, const char* fids,
                 sent += (size_t)r;
             }
             uint32_t status = 500, plen = 0;
-            if (ok) {
+            if (ok && op == 'H') {
+                // parse an HTTP/1.1 keep-alive response
+                size_t hdr_end;
+                while ((hdr_end = rxbuf.find("\r\n\r\n"))
+                       == std::string::npos) {
+                    if (!recv_some(fd, rxbuf)) {
+                        ok = false;
+                        break;
+                    }
+                }
+                if (ok) {
+                    status = (uint32_t)atoi(rxbuf.c_str() + 9);
+                    if (status == 200) status = 0;
+                    size_t clpos = rxbuf.find("Content-Length: ");
+                    size_t body_len = 0;
+                    if (clpos != std::string::npos && clpos < hdr_end)
+                        body_len = (size_t)atoll(rxbuf.c_str() + clpos + 16);
+                    size_t total = hdr_end + 4 + body_len;
+                    while (rxbuf.size() < total) {
+                        if (!recv_some(fd, rxbuf)) {
+                            ok = false;
+                            break;
+                        }
+                    }
+                    if (ok) rxbuf.erase(0, total);
+                }
+            } else if (ok) {
                 while (rxbuf.size() < 8) {
                     if (!recv_some(fd, rxbuf)) {
                         ok = false;

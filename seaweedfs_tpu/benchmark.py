@@ -160,7 +160,8 @@ def run_benchmark(master_address: str, num_files: int = 1000,
 
 def _run_native(master_address: str, num_files: int, file_size: int,
                 concurrency: int, delete_percent: int, replication: str,
-                do_read: bool, quiet: bool, assign_batch: int):
+                do_read: bool, quiet: bool, assign_batch: int,
+                http_phase: bool = False):
     """Native-engine benchmark: the load generator is the C++ driver in
     native/vol_native.cpp (like the reference's compiled Go benchmark
     client), hitting the volume server's native fast-path port.  File ids
@@ -213,6 +214,18 @@ def _run_native(master_address: str, num_files: int, file_size: int,
             read.bytes += (len(fids) - errs) * file_size
             read.seconds += secs
             read.latencies_ms.extend(lat.tolist())
+    read.http_rps = 0.0
+    if http_phase:
+        # the native port also answers plain HTTP GETs: measure the
+        # reference benchmark's own modality (README.md:372-381)
+        http_reqs = http_secs = 0.0
+        for url, fids in by_server.items():
+            host, port = tcp_endpoint(url)
+            secs, errs, _ = native_engine.bench(
+                host, port, "H", fids, len(fids), 0, concurrency)
+            http_reqs += len(fids) - errs
+            http_secs += secs
+        read.http_rps = http_reqs / http_secs if http_secs else 0.0
 
     if delete_percent > 0:
         for url, fids in by_server.items():

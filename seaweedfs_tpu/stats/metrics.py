@@ -265,6 +265,11 @@ VolumeServerRequestCounter = REGISTRY.counter(
 VolumeServerRequestHistogram = REGISTRY.histogram(
     "SeaweedFS_volumeServer_request_seconds", "volume server request latency",
     ("type",))
+# requests served entirely by the native engine (off-GIL; fed from the
+# C++ counters right before each exposition)
+VolumeServerNativeRequestGauge = REGISTRY.gauge(
+    "SeaweedFS_volumeServer_native_request_total",
+    "native fast-path requests", ("type",))
 VolumeServerVolumeCounter = REGISTRY.gauge(
     "SeaweedFS_volumeServer_volumes", "volumes managed", ("collection", "type"))
 VolumeServerReadOnlyVolumeGauge = REGISTRY.gauge(

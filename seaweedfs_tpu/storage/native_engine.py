@@ -87,7 +87,9 @@ def lib() -> Optional[ctypes.CDLL]:
     cdll.svn_ec_refresh.argtypes = [_i64]
     cdll.svn_server_start.restype = ctypes.c_int
     cdll.svn_server_start.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    cdll.svn_server_set_redirect.argtypes = [ctypes.c_char_p]
     cdll.svn_server_stop.restype = ctypes.c_int
+    cdll.svn_server_stats.argtypes = [ctypes.POINTER(_i64)]
     cdll.svn_bench.restype = ctypes.c_double
     cdll.svn_bench.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
                                ctypes.c_char_p, _i64, _i64, ctypes.c_int,
@@ -316,11 +318,14 @@ def unserve_volume(vid: int):
         cdll.svn_serve(vid, 0)
 
 
-def server_start(host: str, port: int) -> int:
-    """Start the native fast-path server; returns the bound port."""
+def server_start(host: str, port: int, http_redirect: str = "") -> int:
+    """Start the native fast-path server; returns the bound port.
+    `http_redirect` is the volume server's full HTTP address — plain
+    HTTP requests the native port cannot serve 302 there."""
     cdll = lib()
     if cdll is None:
         raise RuntimeError("native engine unavailable")
+    cdll.svn_server_set_redirect(http_redirect.encode())
     bound = cdll.svn_server_start(host.encode(), port)
     if bound < 0:
         raise OSError(-bound, "native server start failed")
@@ -331,6 +336,18 @@ def server_stop():
     cdll = lib()
     if cdll is not None:
         cdll.svn_server_stop()
+
+
+def server_stats() -> dict:
+    """Cumulative native-server request counters (process-wide)."""
+    cdll = lib()
+    if cdll is None:
+        return {}
+    out = (ctypes.c_int64 * 7)()
+    cdll.svn_server_stats(out)
+    keys = ("read", "ec_read", "write", "delete", "http_read",
+            "fallback", "error")
+    return dict(zip(keys, (int(v) for v in out)))
 
 
 def bench(host: str, port: int, op: str, fids: list[str], nreqs: int,

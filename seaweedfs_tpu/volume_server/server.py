@@ -305,7 +305,8 @@ class VolumeServer:
             wanted = int(port) + TCP_PORT_OFFSET
             try:
                 bound = native_engine.server_start(
-                    host, wanted if wanted <= 65535 else 0)
+                    host, wanted if wanted <= 65535 else 0,
+                    http_redirect=self.server.address)
             except OSError:
                 bound = 0
             if bound > 0:
@@ -398,6 +399,16 @@ class VolumeServer:
 
         threading.Thread(target=accept_loop, daemon=True).start()
 
+    def _h_metrics(self, req: Request):
+        """Prometheus exposition, with the native engine's off-GIL
+        request counters folded in at scrape time."""
+        if getattr(self, "_native_owner", False):
+            from ..storage import native_engine
+
+            for op, n in native_engine.server_stats().items():
+                stats.VolumeServerNativeRequestGauge.labels(op).set(n)
+        return stats.metrics_handler(req)
+
     def heartbeat_once(self):
         # keep native fast-path bindings fresh (handles change across
         # vacuum commits and volume add/delete)
@@ -477,7 +488,7 @@ class VolumeServer:
               g(self._h_tier_download))
         s.add("POST", "/admin/leave", g(self._h_leave))
         s.add("POST", "/query", self._h_query)
-        s.add("GET", "/metrics", stats.metrics_handler)
+        s.add("GET", "/metrics", self._h_metrics)
         s.add("GET", "/ui", self._h_ui)
         s.default_route = self._handle_object
 

@@ -526,6 +526,34 @@ class TestVolumeServerIntegration:
         st, _ = raw_request(vs.tcp_port, f"G {victim}\n".encode())
         assert st == 404
 
+    def test_plain_http_on_native_port(self, cluster):
+        """The fast-path port answers plain HTTP/1.1 GET/HEAD for
+        needle reads, and 302s anything it cannot serve (query strings,
+        non-fid paths) to the full Python handler."""
+        import urllib.error
+        import urllib.request
+
+        master, vs = cluster
+        if not getattr(vs, "_native_owner", False):
+            pytest.skip("another test holds the process-wide native port")
+        a = call(master.address, "/dir/assign")
+        call(a["url"], f"/{a['fid']}", raw=b"plain http", method="POST")
+        base = f"http://127.0.0.1:{vs.tcp_port}"
+        with urllib.request.urlopen(f"{base}/{a['fid']}",
+                                    timeout=10) as r:
+            assert r.status == 200 and r.read() == b"plain http"
+        head = urllib.request.Request(f"{base}/{a['fid']}", method="HEAD")
+        with urllib.request.urlopen(head, timeout=10) as r:
+            assert r.headers["Content-Length"] == "10"
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(f"{base}/{a['fid'][:-4]}beef",
+                                   timeout=10)
+        assert e.value.code == 404
+        # query strings 302 to the full handler (urllib follows)
+        with urllib.request.urlopen(f"{base}/{a['fid']}?x=1",
+                                    timeout=10) as r:
+            assert r.read() == b"plain http"
+
     def test_bench_driver_smoke(self, cluster):
         master, vs = cluster
         if not getattr(vs, "_native_owner", False):
