@@ -36,6 +36,7 @@
 #include <atomic>
 #include <cerrno>
 #include <chrono>
+#include <condition_variable>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
@@ -305,6 +306,45 @@ struct NVolume {
     std::atomic<bool> writable{false};   // native W/D allowed
     std::atomic<bool> read_only{false};
     std::atomic<bool> do_fsync{false};
+
+    // group commit for -fsync volumes (volume_write.go:233-306 /
+    // _FsyncBatcher semantics): tickets issued under wmu; one leader
+    // fsyncs for every ticket issued so far, the rest wait.  A failed
+    // leader fsync fails EVERY ticket it covered (volume.py
+    // _FsyncBatcher "_failed_upto = target" — an acknowledged write
+    // must never ride a sync whose pages the kernel dropped).
+    std::mutex fs_mu;
+    std::condition_variable fs_cv;
+    std::atomic<uint64_t> fs_seq{0};  // tickets issued (under wmu, but
+                                      // read concurrently by leaders)
+    uint64_t fs_done = 0;    // durable through this ticket
+    uint64_t fs_failed = 0;  // failed-batch watermark
+    bool fs_running = false;
+
+    // Wait until `ticket` is covered by a group fsync; false when the
+    // commit covering it failed (the write must be answered 500).
+    bool fsync_ticket(uint64_t ticket) {
+        std::unique_lock<std::mutex> lk(fs_mu);
+        while (fs_done < ticket && fs_failed < ticket) {
+            if (!fs_running) {
+                fs_running = true;
+                uint64_t target = fs_seq.load();
+                lk.unlock();
+                bool ok = fdatasync(dat_fd) == 0 && fdatasync(idx_fd) == 0;
+                lk.lock();
+                if (ok) {
+                    if (target > fs_done) fs_done = target;
+                } else if (target > fs_failed) {
+                    fs_failed = target;
+                }
+                fs_running = false;
+                fs_cv.notify_all();
+            } else {
+                fs_cv.wait(lk);
+            }
+        }
+        return fs_done >= ticket;
+    }
 
     ~NVolume() {
         if (dat_fd >= 0) close(dat_fd);
@@ -1029,6 +1069,7 @@ Reply handle_write(uint32_t vid, uint64_t nid, uint32_t cookie,
     w += 4;
     put_be64(p + w, append_ns);
 
+    uint64_t ticket = 0;
     {
         std::lock_guard<std::mutex> lk(v->wmu);
         // re-check under the mutex: svn_quiesce (vacuum commit) flips
@@ -1044,15 +1085,14 @@ Reply handle_write(uint32_t vid, uint64_t nid, uint32_t cookie,
         v->nm.apply(nid, (uint64_t)end, (int32_t)size);
         if (!append_idx_entry(v.get(), nid, (uint64_t)end, (int32_t)size))
             return {500, "idx append failed"};
+        ticket = ++v->fs_seq;
     }
     if (append_ns > v->last_append_ns.load())
         v->last_append_ns.store(append_ns);
     if (lastmod > v->last_modified_ts.load())
         v->last_modified_ts.store(lastmod);
-    if (v->do_fsync.load()) {
-        fdatasync(v->dat_fd);
-        fdatasync(v->idx_fd);
-    }
+    if (v->do_fsync.load() && !v->fsync_ticket(ticket))
+        return {500, "fsync failed"};
     return {0, json_write_reply(size, crc)};
 }
 
@@ -1078,6 +1118,7 @@ Reply handle_delete(uint32_t vid, uint64_t nid, uint32_t cookie) {
     put_be64(p + 4, nid);
     put_be32(p + 12, 0);
     put_be64(p + kHeaderSize + kChecksumSize, append_ns);
+    uint64_t ticket = 0;
     {
         std::lock_guard<std::mutex> lk(v->wmu);
         if (!v->writable.load() || v->read_only.load())
@@ -1091,13 +1132,12 @@ Reply handle_delete(uint32_t vid, uint64_t nid, uint32_t cookie) {
         v->nm.apply(nid, 0, kTombstone);
         if (!append_idx_entry(v.get(), nid, (uint64_t)end, kTombstone))
             return {500, "idx append failed"};
+        ticket = ++v->fs_seq;
     }
     if (append_ns > v->last_append_ns.load())
         v->last_append_ns.store(append_ns);
-    if (v->do_fsync.load()) {
-        fdatasync(v->dat_fd);
-        fdatasync(v->idx_fd);
-    }
+    if (v->do_fsync.load() && !v->fsync_ticket(ticket))
+        return {500, "fsync failed"};
     char out[48];
     snprintf(out, sizeof(out), "{\"size\": %d}", old_size);
     return {0, out};

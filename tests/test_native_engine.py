@@ -244,6 +244,36 @@ class TestNativeServer:
         assert v.read_needle(0x1, cookie=0xAABBCCDD).data == b"ok"
         v.close()
 
+    def test_fsync_volume_group_commit(self, tmp_path, native_server):
+        """-fsync volumes group-commit native writes (one leader fsyncs
+        for the batch); acknowledged writes survive a reload."""
+        import threading
+
+        v = Volume(str(tmp_path), "", 11, fsync=True)
+        ne.serve_volume(11, v.nm)
+        errs = []
+
+        def w(i):
+            st, _ = raw_request(
+                native_server,
+                b"W 11,%xaabbccdd 6\nbody%02d" % (i, i))
+            if st != 0:
+                errs.append(st)
+
+        threads = [threading.Thread(target=w, args=(i,))
+                   for i in range(1, 17)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs
+        v.close()
+        v2 = Volume(str(tmp_path), "", 11)
+        for i in range(1, 17):
+            assert v2.read_needle(i, cookie=0xAABBCCDD).data \
+                == b"body%02d" % i
+        v2.close()
+
     def test_replicated_volume_rejects_native_writes(self, tmp_path,
                                                      native_server):
         from seaweedfs_tpu.storage.super_block import ReplicaPlacement
