@@ -193,39 +193,40 @@ def _run_native(master_address: str, num_files: int, file_size: int,
         host, port = resolver.tcp_address(url).rsplit(":", 1)
         return host, int(port)
 
-    for url, fids in by_server.items():
-        host, port = tcp_endpoint(url)
-        secs, errs, lat = native_engine.bench(
-            host, port, "W", fids, len(fids), file_size, concurrency)
-        write.requests += len(fids) - errs
-        write.errors += errs
-        write.bytes += (len(fids) - errs) * file_size
-        write.seconds += secs
-        write.latencies_ms.extend(lat.tolist())
+    def run_phase(op: str, result: BenchResult, payload: int):
+        """Drive every server concurrently (svn_bench releases the GIL);
+        wall-clock is the slowest server, so multi-server runs report
+        true aggregate throughput."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        def one(item):
+            url, fids = item
+            host, port = tcp_endpoint(url)
+            return native_engine.bench(host, port, op, fids, len(fids),
+                                       payload, concurrency)
+
+        with ThreadPoolExecutor(max_workers=len(by_server)) as pool:
+            outs = list(pool.map(one, by_server.items()))
+        for (url, fids), (secs, errs, lat) in zip(by_server.items(), outs):
+            result.requests += len(fids) - errs
+            result.errors += errs
+            result.bytes += (len(fids) - errs) * file_size
+            result.seconds = max(result.seconds, secs)
+            result.latencies_ms.extend(lat.tolist())
+
+    run_phase("W", write, file_size)
 
     read = BenchResult()
     if do_read:
-        for url, fids in by_server.items():
-            host, port = tcp_endpoint(url)
-            secs, errs, lat = native_engine.bench(
-                host, port, "R", fids, len(fids), 0, concurrency)
-            read.requests += len(fids) - errs
-            read.errors += errs
-            read.bytes += (len(fids) - errs) * file_size
-            read.seconds += secs
-            read.latencies_ms.extend(lat.tolist())
+        run_phase("R", read, 0)
     read.http_rps = 0.0
     if http_phase:
         # the native port also answers plain HTTP GETs: measure the
         # reference benchmark's own modality (README.md:372-381)
-        http_reqs = http_secs = 0.0
-        for url, fids in by_server.items():
-            host, port = tcp_endpoint(url)
-            secs, errs, _ = native_engine.bench(
-                host, port, "H", fids, len(fids), 0, concurrency)
-            http_reqs += len(fids) - errs
-            http_secs += secs
-        read.http_rps = http_reqs / http_secs if http_secs else 0.0
+        http = BenchResult()
+        run_phase("H", http, 0)
+        read.http_rps = (http.requests / http.seconds
+                         if http.seconds else 0.0)
 
     if delete_percent > 0:
         for url, fids in by_server.items():
