@@ -385,8 +385,14 @@ class TestVolumeServerIntegration:
                 else None
             got = call(fs.address, "/f/blob.bin")
             assert got == body
-            # the volume server was reachable over TCP: no negative cache
+            # both chunk uploads and fetches went over TCP: the volume
+            # server never entered the negative cache
             assert vs.store.url not in fs._tcp_bad
+            # the chunks are real needles on the volume server
+            entry = fs.filer.find_entry("/f/blob.bin")
+            assert len(entry.chunks) == 4
+            for c in entry.chunks:
+                assert c.etag
         finally:
             fs.stop()
 
