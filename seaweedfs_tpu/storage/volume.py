@@ -479,10 +479,15 @@ class Volume:
         return self.deleted_size() / self.content_size()
 
     def file_stat(self) -> tuple[int, int]:
-        """(dat size, idx size)"""
-        idx_path = self.file_name(".idx")
-        return (self.data.size(),
-                os.path.getsize(idx_path) if os.path.exists(idx_path) else 0)
+        """(dat size, idx size).  Takes the volume lock: a vacuum commit
+        closes and swaps self.data under it, and an unlocked fstat on the
+        closed handle races to a TypeError (found by the mixed-path
+        soak: the dying heartbeat thread then strands the whole node)."""
+        with self.lock:
+            idx_path = self.file_name(".idx")
+            return (self.data.size(),
+                    os.path.getsize(idx_path)
+                    if os.path.exists(idx_path) else 0)
 
     def index_file_size(self) -> int:
         return self.file_stat()[1]

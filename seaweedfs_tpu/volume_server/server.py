@@ -439,6 +439,14 @@ class VolumeServer:
                 self.heartbeat_once()
             except RpcError:
                 pass
+            except Exception:
+                # the heartbeat thread must never die: a missed beat is
+                # recoverable, a dead loop gets the node reaped by the
+                # master and strands every volume it holds
+                import logging
+
+                logging.getLogger(__name__).exception(
+                    "heartbeat iteration failed")
             self._stop.wait(self.pulse_seconds)
 
     # -- routing -------------------------------------------------------------
