@@ -360,18 +360,32 @@ using VolPtr = std::shared_ptr<NVolume>;
 // store_ec.go:125-163).  Writes/deletes to EC volumes stay in Python.
 struct NEcVolume {
     int ecx_fd = -1;
-    int64_t ecx_entries = 0;
+    std::atomic<int64_t> ecx_entries{0};
     int version = 3;
     int64_t large_block = 0, small_block = 0;
-    int64_t shard_size = 0;  // any local shard's file size (ec_volume.py)
-    int shard_fds[14];
+    std::atomic<int64_t> shard_size{0};  // any local shard's file size
+    // atomic slots: server threads read them lock-free mid-request.
+    // Replaced/removed fds are RETIRED, not closed — an in-flight pread
+    // must never hit EBADF or a reused descriptor; the handful of fds a
+    // remount churn leaves open are released in the destructor.
+    std::atomic<int> shard_fds[14];
+    std::mutex retired_mu;
+    std::vector<int> retired;
     NEcVolume() {
-        for (int i = 0; i < 14; i++) shard_fds[i] = -1;
+        for (int i = 0; i < 14; i++) shard_fds[i].store(-1);
+    }
+    void retire(int fd) {
+        if (fd < 0) return;
+        std::lock_guard<std::mutex> lk(retired_mu);
+        retired.push_back(fd);
     }
     ~NEcVolume() {
         if (ecx_fd >= 0) close(ecx_fd);
-        for (int i = 0; i < 14; i++)
-            if (shard_fds[i] >= 0) close(shard_fds[i]);
+        for (int i = 0; i < 14; i++) {
+            int fd = shard_fds[i].load();
+            if (fd >= 0) close(fd);
+        }
+        for (int fd : retired) close(fd);
     }
 };
 
@@ -708,7 +722,7 @@ int64_t svn_ec_register(const char* ecx_path, int version,
     if (ev->ecx_fd < 0) return -errno;
     struct stat st;
     if (fstat(ev->ecx_fd, &st) != 0) return -errno;
-    ev->ecx_entries = st.st_size / 16;
+    ev->ecx_entries.store(st.st_size / 16);
     int64_t h = g_next_handle.fetch_add(1);
     std::unique_lock<std::shared_mutex> lk(g_reg_mu);
     g_ec_handles[h] = std::move(ev);
@@ -728,9 +742,8 @@ int svn_ec_add_shard(int64_t handle, int shard_id, const char* path) {
         close(fd);
         return -errno;
     }
-    if (ev->shard_fds[shard_id] >= 0) close(ev->shard_fds[shard_id]);
-    ev->shard_fds[shard_id] = fd;
-    ev->shard_size = st.st_size;
+    ev->retire(ev->shard_fds[shard_id].exchange(fd));
+    ev->shard_size.store(st.st_size);
     return 0;
 }
 
@@ -740,10 +753,7 @@ int svn_ec_remove_shard(int64_t handle, int shard_id) {
     auto it = g_ec_handles.find(handle);
     if (it == g_ec_handles.end()) return -1;
     auto& ev = it->second;
-    if (ev->shard_fds[shard_id] >= 0) {
-        close(ev->shard_fds[shard_id]);
-        ev->shard_fds[shard_id] = -1;
-    }
+    ev->retire(ev->shard_fds[shard_id].exchange(-1));
     return 0;
 }
 
@@ -775,7 +785,7 @@ int svn_ec_refresh(int64_t handle) {
     if (it == g_ec_handles.end()) return -1;
     struct stat st;
     if (fstat(it->second->ecx_fd, &st) != 0) return -errno;
-    it->second->ecx_entries = st.st_size / 16;
+    it->second->ecx_entries.store(st.st_size / 16);
     return 0;
 }
 
@@ -833,8 +843,11 @@ bool gunzip(const std::string& in, std::string* out) {
     char buf[1 << 16];
     zs.next_in = (Bytef*)in.data();
     zs.avail_in = (uInt)in.size();
-    int rc;
-    do {
+    // loop on Z_OK, not on remaining input: inflate may still hold
+    // window output after the last input byte (long back-references);
+    // a truncated/non-progressing stream surfaces as Z_BUF_ERROR
+    int rc = Z_OK;
+    while (rc == Z_OK) {
         zs.next_out = (Bytef*)buf;
         zs.avail_out = sizeof(buf);
         rc = inflate(&zs, Z_NO_FLUSH);
@@ -843,7 +856,7 @@ bool gunzip(const std::string& in, std::string* out) {
             return false;
         }
         out->append(buf, sizeof(buf) - zs.avail_out);
-    } while (rc != Z_STREAM_END && zs.avail_in > 0);
+    }
     inflateEnd(&zs);
     return rc == Z_STREAM_END;
 }
@@ -887,8 +900,7 @@ Reply finish_needle_read(const std::string& blob, int32_t size, int version,
 // ec_volume.go:206-255); any non-local interval answers 307 so the
 // Python ladder (remote fetch / reconstruct) takes over.
 Reply handle_ec_read(const EcPtr& ev, uint64_t nid, uint32_t cookie) {
-    g_stat_ec_reads.fetch_add(1);
-    int64_t lo = 0, hi = ev->ecx_entries - 1;
+    int64_t lo = 0, hi = ev->ecx_entries.load() - 1;
     uint64_t off = 0;
     int32_t size = 0;
     bool found = false;
@@ -909,10 +921,11 @@ Reply handle_ec_read(const EcPtr& ev, uint64_t nid, uint32_t cookie) {
     }
     if (!found) return {404, "not found"};
     if (size < 0) return {404, "already deleted"};
-    if (ev->shard_size <= 0) return {307, "no local shards"};
+    int64_t shard_size = ev->shard_size.load();
+    if (shard_size <= 0) return {307, "no local shards"};
 
     const int64_t lb = ev->large_block, sb = ev->small_block;
-    const int64_t dat_size = 10 * ev->shard_size;
+    const int64_t dat_size = 10 * shard_size;
     int64_t actual = get_actual_size(size, ev->version);
     // _locate_offset (ec_locate.go:55-75)
     int64_t large_row_size = lb * 10;
@@ -943,7 +956,7 @@ Reply handle_ec_read(const EcPtr& ev, uint64_t nid, uint32_t cookie) {
         int64_t ec_off = inner +
                          (is_large ? row * lb : n_large_rows * lb + row * sb);
         int sid = (int)(block_index % 10);
-        int fd = ev->shard_fds[sid];
+        int fd = ev->shard_fds[sid].load();
         if (fd < 0) return {307, "shard not local"};
         if (!pread_full(fd, (uint8_t*)blob.data() + wrote, (size_t)take,
                         ec_off))
@@ -960,11 +973,15 @@ Reply handle_ec_read(const EcPtr& ev, uint64_t nid, uint32_t cookie) {
     return finish_needle_read(blob, size, ev->version, cookie);
 }
 
-Reply handle_read(uint32_t vid, uint64_t nid, uint32_t cookie) {
+Reply handle_read(uint32_t vid, uint64_t nid, uint32_t cookie,
+                  bool* was_ec = nullptr) {
     auto v = serving_vol(vid);
     if (!v) {
         auto ev = serving_ec(vid);
-        if (ev) return handle_ec_read(ev, nid, cookie);
+        if (ev) {
+            if (was_ec) *was_ec = true;
+            return handle_ec_read(ev, nid, cookie);
+        }
         return {307, "volume not served natively"};
     }
     uint64_t off;
@@ -1169,15 +1186,12 @@ bool recv_some(int fd, std::string& buf);
 bool send_http_reply(int fd, int status, const char* reason,
                      const std::string& body, bool head,
                      const std::string& extra_headers) {
-    char hdr[512];
-    int n = snprintf(hdr, sizeof(hdr),
-                     "HTTP/1.1 %d %s\r\n"
-                     "Content-Length: %zu\r\n"
-                     "Content-Type: application/octet-stream\r\n"
-                     "%s"
-                     "Connection: keep-alive\r\n\r\n",
-                     status, reason, body.size(), extra_headers.c_str());
-    std::string out(hdr, (size_t)n);
+    // compose in std::string: extra_headers carries a client-chosen
+    // request target (302 Location), so no fixed-size buffer is safe
+    std::string out = "HTTP/1.1 " + std::to_string(status) + " " + reason +
+                      "\r\nContent-Length: " + std::to_string(body.size()) +
+                      "\r\nContent-Type: application/octet-stream\r\n" +
+                      extra_headers + "Connection: keep-alive\r\n\r\n";
     if (!head) out += body;
     size_t sent = 0;
     while (sent < out.size()) {
@@ -1217,6 +1231,7 @@ bool serve_http_request(Server* srv, int fd, const std::string& method,
     size_t slash = fid.find('/');
     if (slash != std::string::npos) fid[slash] = ',';
     if (has_query || !parse_fid(fid, &vid, &nid, &cookie)) {
+        g_stat_fallbacks.fetch_add(1);  // 302 = the HTTP-shaped 307
         if (g_http_redirect.empty())
             return send_http_reply(fd, 404, "Not Found", "not found",
                                    head, "");
@@ -1225,6 +1240,7 @@ bool serve_http_request(Server* srv, int fd, const std::string& method,
             "Location: http://" + g_http_redirect + target + "\r\n");
     }
     Reply r = handle_read(vid, nid, cookie);
+    count_reply(r.status);
     if (r.status == 0)
         return send_http_reply(fd, 200, "OK", r.payload, head,
                                "Accept-Ranges: bytes\r\n");
@@ -1318,12 +1334,17 @@ void serve_conn(Server* srv, int fd) {
                     goto done;
             } else if (op == "G"
                        && (parts.size() == 2 || parts.size() == 3)) {
-                g_stat_reads.fetch_add(1);
                 if (!parse_fid(parts[1], &vid, &nid, &cookie)) {
+                    g_stat_reads.fetch_add(1);
+                    g_stat_errors.fetch_add(1);
                     if (!send_reply(fd, 400, "bad fid")) goto done;
                     continue;
                 }
-                Reply r = handle_read(vid, nid, cookie);
+                bool was_ec = false;
+                Reply r = handle_read(vid, nid, cookie, &was_ec);
+                // exactly one type per request: framed reads split into
+                // read/ec_read by the path that served them
+                (was_ec ? g_stat_ec_reads : g_stat_reads).fetch_add(1);
                 count_reply(r.status);
                 if (!send_reply(fd, r.status, r.payload)) goto done;
             } else if (op == "W" && parts.size() == 3) {
