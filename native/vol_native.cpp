@@ -39,6 +39,7 @@
 #include <condition_variable>
 #include <cstdint>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <map>
 #include <memory>
@@ -1161,6 +1162,61 @@ Reply handle_delete(uint32_t vid, uint64_t nid, uint32_t cookie) {
 }
 
 // ---------------------------------------------------------------------------
+// Assign-lease pool: the master leases contiguous fid key ranges to the
+// engine, which answers per-file assigns ("A [count]\n") off the GIL.
+// The reference master serves /dir/assign from compiled Go
+// (master_server_handlers.go:102-165); a GIL-bound Python handler caps
+// per-file-assign workloads, so the Python master keeps authority
+// (placement, growth, sequencing) and refills bounded leases here.
+// ---------------------------------------------------------------------------
+
+struct AssignLease {
+    uint32_t vid;
+    std::string url, public_url;
+    std::atomic<uint64_t> next;
+    uint64_t end;
+};
+
+std::shared_mutex g_lease_mu;
+std::vector<std::shared_ptr<AssignLease>> g_leases;
+std::atomic<size_t> g_lease_rr{0};
+std::atomic<uint64_t> g_assign_rng{0x9E3779B97F4A7C15ull};
+
+uint64_t assign_rand() {
+    // xorshift* — cookies need uniqueness pressure, not crypto (the
+    // Python master uses random.getrandbits(32))
+    uint64_t x = g_assign_rng.fetch_add(0x9E3779B97F4A7C15ull);
+    x ^= x >> 30;
+    x *= 0xBF58476D1CE4E5B9ull;
+    x ^= x >> 27;
+    x *= 0x94D049BB133111EBull;
+    return x ^ (x >> 31);
+}
+
+// -> JSON assign reply or empty when no lease can cover `count`
+std::string assign_take(int64_t count) {
+    std::shared_lock<std::shared_mutex> lk(g_lease_mu);
+    size_t n = g_leases.size();
+    for (size_t attempt = 0; attempt < n; attempt++) {
+        auto& lease = g_leases[g_lease_rr.fetch_add(1) % n];
+        uint64_t key = lease->next.fetch_add((uint64_t)count);
+        if (key + (uint64_t)count > lease->end + 1 || key > lease->end)
+            continue;  // exhausted: the refiller prunes it
+        uint32_t cookie = (uint32_t)assign_rand();
+        char fid[64];
+        snprintf(fid, sizeof(fid), "%u,%llx%08x", lease->vid,
+                 (unsigned long long)key, cookie);
+        std::string out = "{\"fid\": \"";
+        out += fid;
+        out += "\", \"url\": \"" + lease->url + "\", \"publicUrl\": \"" +
+               lease->public_url + "\", \"count\": " +
+               std::to_string(count) + "}";
+        return out;
+    }
+    return "";
+}
+
+// ---------------------------------------------------------------------------
 // Framed-TCP server (same wire protocol as the Python TCP fast path:
 // text command line, ">II"-framed replies)
 // ---------------------------------------------------------------------------
@@ -1177,6 +1233,7 @@ struct Server {
 Server* g_server = nullptr;
 std::mutex g_server_mu;
 std::string g_http_redirect;  // "host:port" of the full HTTP handler
+std::atomic<int> g_server_port{0};  // bound port (0 = not running)
 
 bool recv_some(int fd, std::string& buf);
 
@@ -1370,6 +1427,24 @@ void serve_conn(Server* srv, int fd) {
                 Reply r = handle_write(vid, nid, cookie, body);
                 count_reply(r.status);
                 if (!send_reply(fd, r.status, r.payload)) goto done;
+            } else if (op == "A" && parts.size() <= 2) {
+                long long count = 1;
+                if (parts.size() == 2) {
+                    errno = 0;
+                    count = strtoll(parts[1].c_str(), nullptr, 10);
+                    if (errno || count <= 0 || count > 1000000) {
+                        if (!send_reply(fd, 400, "bad count")) goto done;
+                        continue;
+                    }
+                }
+                std::string out = assign_take(count);
+                if (out.empty()) {
+                    // no live lease: the client retries /dir/assign
+                    if (!send_reply(fd, 503, "no assign lease"))
+                        goto done;
+                    continue;
+                }
+                if (!send_reply(fd, 0, out)) goto done;
             } else if (op == "D" && parts.size() == 2) {
                 g_stat_deletes.fetch_add(1);
                 if (!parse_fid(parts[1], &vid, &nid, &cookie)) {
@@ -1402,6 +1477,44 @@ done:
 }  // namespace
 
 extern "C" {
+
+// -- assign leases ----------------------------------------------------------
+
+int svn_assign_add_lease(uint32_t vid, const char* url,
+                         const char* public_url, uint64_t key_start,
+                         uint64_t key_end) {
+    auto lease = std::make_shared<AssignLease>();
+    lease->vid = vid;
+    lease->url = url;
+    lease->public_url = public_url && *public_url ? public_url : url;
+    lease->next.store(key_start);
+    lease->end = key_end;
+    std::unique_lock<std::shared_mutex> lk(g_lease_mu);
+    g_leases.push_back(std::move(lease));
+    return 0;
+}
+
+// Remaining assignable keys across live leases; prunes exhausted ones.
+int64_t svn_assign_remaining() {
+    std::unique_lock<std::shared_mutex> lk(g_lease_mu);
+    int64_t total = 0;
+    for (auto it = g_leases.begin(); it != g_leases.end();) {
+        uint64_t next = (*it)->next.load();
+        if (next > (*it)->end) {
+            it = g_leases.erase(it);
+        } else {
+            total += (int64_t)((*it)->end - next + 1);
+            ++it;
+        }
+    }
+    return total;
+}
+
+int svn_assign_clear() {
+    std::unique_lock<std::shared_mutex> lk(g_lease_mu);
+    g_leases.clear();
+    return 0;
+}
 
 // Where the fast-path port 302s HTTP requests it cannot serve (the
 // volume server's full handler).  Set before svn_server_start.
@@ -1460,8 +1573,14 @@ int svn_server_start(const char* host, int port) {
         }
     });
     g_server = srv;
+    g_server_port.store(bound);
     return bound;
 }
+
+// Bound port of the process-wide native listener (0 = none).  In
+// combined master+volume processes the registry is shared, so whichever
+// daemon started the listener serves every command (incl. assigns).
+int svn_server_port() { return g_server_port.load(); }
 
 // out[0..6] = framed reads, ec reads, writes, deletes, http reads,
 //             307 fallbacks, errors
@@ -1481,6 +1600,7 @@ int svn_server_stop() {
     if (!g_server) return 0;
     Server* srv = g_server;
     g_server = nullptr;
+    g_server_port.store(0);
     srv->stop.store(true);
     shutdown(srv->listen_fd, SHUT_RDWR);
     close(srv->listen_fd);
@@ -1531,27 +1651,118 @@ double svn_bench(const char* host, int port, int op, const char* fids,
     std::atomic<int64_t> errors{0};
     std::atomic<int64_t> completed{0};
 
-    auto worker = [&](int widx) {
+    auto dial = [](const std::string& h, int p) -> int {
         int fd = socket(AF_INET, SOCK_STREAM, 0);
-        if (fd < 0) return;
+        if (fd < 0) return -1;
         sockaddr_in addr{};
         addr.sin_family = AF_INET;
-        addr.sin_port = htons((uint16_t)port);
-        if (inet_pton(AF_INET, host, &addr.sin_addr) != 1)
+        addr.sin_port = htons((uint16_t)p);
+        if (inet_pton(AF_INET, h.c_str(), &addr.sin_addr) != 1)
             addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
         if (connect(fd, (sockaddr*)&addr, sizeof(addr)) != 0) {
             close(fd);
-            return;  // surviving workers drain the slots; unclaimed
-                     // slots are charged as errors at the end
+            return -1;
         }
         int one = 1;
         setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        return fd;
+    };
+
+    auto worker = [&](int widx) {
+        int fd = dial(host, port);
+        if (fd < 0) return;  // surviving workers drain the slots;
+                             // unclaimed slots are charged as errors
         std::mt19937_64 rng(0x5EEDu + (unsigned)widx);
         std::string rxbuf;
         std::string req;
+
+        // framed request/response on an arbitrary conn (F mode talks to
+        // the master AND per-volume-server conns)
+        auto framed = [&](int cfd, std::string& rbuf,
+                          const std::string& frame, uint32_t* st,
+                          std::string* payload) -> bool {
+            size_t sent = 0;
+            while (sent < frame.size()) {
+                ssize_t r = send(cfd, frame.data() + sent,
+                                 frame.size() - sent, 0);
+                if (r <= 0) return false;
+                sent += (size_t)r;
+            }
+            while (rbuf.size() < 8)
+                if (!recv_some(cfd, rbuf)) return false;
+            *st = get_be32((const uint8_t*)rbuf.data());
+            uint32_t plen = get_be32((const uint8_t*)rbuf.data() + 4);
+            while (rbuf.size() < 8 + (size_t)plen)
+                if (!recv_some(cfd, rbuf)) return false;
+            if (payload) *payload = rbuf.substr(8, plen);
+            rbuf.erase(0, 8 + (size_t)plen);
+            return true;
+        };
+        std::unordered_map<std::string, int> vol_conns;
+        std::unordered_map<std::string, std::string> vol_bufs;
+
+        auto json_field = [](const std::string& j,
+                             const char* key) -> std::string {
+            std::string pat = std::string("\"") + key + "\": \"";
+            size_t p = j.find(pat);
+            if (p == std::string::npos) return "";
+            p += pat.size();
+            size_t e = j.find('"', p);
+            return e == std::string::npos ? "" : j.substr(p, e - p);
+        };
+
         while (true) {
             int64_t slot = next.fetch_add(1);
             if (slot >= nreqs) break;
+            if (op == 'F') {
+                // full per-file cycle: native assign -> native write
+                // (the reference benchmark's per-file flow,
+                // command/benchmark.go writeFiles)
+                auto t0 = std::chrono::steady_clock::now();
+                uint32_t st = 500;
+                std::string assign;
+                bool ok = framed(fd, rxbuf, "A\n", &st, &assign) &&
+                          st == 0;
+                if (ok) {
+                    std::string fid = json_field(assign, "fid");
+                    std::string url = json_field(assign, "url");
+                    size_t colon = url.rfind(':');
+                    if (fid.empty() || colon == std::string::npos) {
+                        ok = false;
+                    } else {
+                        auto it = vol_conns.find(url);
+                        if (it == vol_conns.end()) {
+                            int vport =
+                                atoi(url.c_str() + colon + 1) + 20000;
+                            int vfd =
+                                dial(url.substr(0, colon), vport);
+                            it = vol_conns.emplace(url, vfd).first;
+                            vol_bufs.emplace(url, std::string());
+                        }
+                        if (it->second < 0) {
+                            ok = false;
+                        } else {
+                            std::string wreq =
+                                "W " + fid + " " +
+                                std::to_string(payload.size()) + "\n" +
+                                payload;
+                            ok = framed(it->second, vol_bufs[url], wreq,
+                                        &st, nullptr) &&
+                                 st == 0;
+                        }
+                    }
+                }
+                auto t1 = std::chrono::steady_clock::now();
+                if (lat_us_out)
+                    lat_us_out[slot] =
+                        (float)std::chrono::duration_cast<
+                            std::chrono::nanoseconds>(t1 - t0)
+                            .count() /
+                        1000.0f;
+                completed.fetch_add(1);
+                if (!ok) errors.fetch_add(1);
+                continue;
+            }
             const std::string& fid =
                 (op == 'W') ? fid_list[(size_t)(slot % nfids)]
                             : fid_list[rng() % fid_list.size()];
@@ -1634,6 +1845,8 @@ double svn_bench(const char* host, int port, int op, const char* fids,
             if (!ok || status != 0) errors.fetch_add(1);
             if (!ok) break;  // connection dead
         }
+        for (auto& kv : vol_conns)
+            if (kv.second >= 0) close(kv.second);
         close(fd);
     };
 

@@ -52,7 +52,10 @@ def run_benchmark(master_address: str, num_files: int = 1000,
                   delete_percent: int = 0, replication: str = "000",
                   do_read: bool = True, quiet: bool = False,
                   use_tcp: bool = False, use_native: bool = False,
-                  assign_batch: int = 256):
+                  assign_batch: int = 256, per_file_assign: bool = False):
+    if per_file_assign:
+        return _run_full_native(master_address, num_files, file_size,
+                                concurrency, quiet)
     if use_native:
         return _run_native(master_address, num_files, file_size,
                            concurrency, delete_percent, replication,
@@ -156,6 +159,39 @@ def run_benchmark(master_address: str, num_files: int = 1000,
         if do_read:
             print(read.report("read"))
     return write, read
+
+
+def _run_full_native(master_address: str, num_files: int, file_size: int,
+                     concurrency: int, quiet: bool):
+    """Per-file assign + write, both off the GIL: each request fetches a
+    fresh fid from the master's native 'A' handler (lease-fed by the
+    Python master) and writes it to the assigned volume server — the
+    reference benchmark's exact per-file flow (command/benchmark.go
+    writeFiles).  Requires master AND volume servers started with -tcp
+    on conventional ports (native port = http port + 20000).  Reads are
+    not run (fids/cookies are minted inside the C++ driver); use the
+    batched mode for read rates."""
+    from .storage import native_engine
+
+    if not native_engine.available():
+        raise RuntimeError("native engine unavailable (build native/)")
+    status = call(master_address, "/dir/status")
+    nport = status.get("native_assign_port", 0)
+    if not nport:
+        raise RuntimeError(
+            "master native assign not enabled (start master with -tcp)")
+    host = master_address.rsplit(":", 1)[0]
+    write = BenchResult()
+    secs, errs, lat = native_engine.bench(
+        host, int(nport), "F", ["-"], num_files, file_size, concurrency)
+    write.requests = num_files - errs
+    write.errors = errs
+    write.bytes = (num_files - errs) * file_size
+    write.seconds = secs
+    write.latencies_ms = lat.tolist()
+    if not quiet:
+        print(write.report("write (per-file native assign)"))
+    return write, BenchResult()
 
 
 def _run_native(master_address: str, num_files: int, file_size: int,

@@ -39,7 +39,8 @@ class MasterServer:
                  peers: Optional[list[str]] = None,
                  raft_dir: str = "",
                  raft_election_timeout: float = 0.8,
-                 auto_vacuum_interval: float = 15 * 60.0):
+                 auto_vacuum_interval: float = 15 * 60.0,
+                 enable_native_assign: bool = False):
         self.topo = Topology(
             volume_size_limit=volume_size_limit_mb * 1024 * 1024,
             pulse_seconds=pulse_seconds)
@@ -70,6 +71,9 @@ class MasterServer:
         self._stop = threading.Event()
         self._grow_lock = threading.Lock()
         self.auto_vacuum_interval = auto_vacuum_interval
+        self.enable_native_assign = enable_native_assign
+        self._native_assign = False
+        self._native_assign_owner = False
 
     @property
     def address(self) -> str:
@@ -81,13 +85,94 @@ class MasterServer:
         self.raft.start()
         self._reaper = threading.Thread(target=self._reap_loop, daemon=True)
         self._reaper.start()
+        if self.enable_native_assign:
+            self._start_native_assign()
 
     def stop(self):
         self._stop.set()
         self.raft.stop()
         with self._change_cond:
             self._change_cond.notify_all()
+        if self._native_assign:
+            from ..storage import native_engine
+
+            native_engine.assign_clear()
+            if self._native_assign_owner:
+                native_engine.server_stop()
+            self._native_assign = False
         self.server.stop()
+
+    # -- native assign leases -------------------------------------------------
+    def _start_native_assign(self):
+        """Serve per-file assigns off the GIL: lease contiguous fid key
+        ranges for default-parameter (replication 000, no TTL) assigns
+        to the native engine's 'A' handler.  Placement, growth and
+        sequencing stay here; the engine only hands out pre-planned
+        ranges.  Opt-in (-tcp), like the volume fast path."""
+        from ..storage import native_engine
+
+        if (not native_engine.available() or self.guard.signing
+                or self.default_replication != "000"):
+            return
+        host, port = self.server.address.rsplit(":", 1)
+        wanted = int(port) + 20000
+        if native_engine.server_port() <= 0:
+            try:
+                native_engine.server_start(
+                    host, wanted if wanted <= 65535 else 0)
+                self._native_assign_owner = True
+            except OSError:
+                pass  # combined process: another daemon's listener
+                # serves 'A' (the lease registry is process-global)
+        if native_engine.server_port() <= 0:
+            return
+        self._native_assign = True
+        threading.Thread(target=self._assign_lease_loop,
+                         daemon=True).start()
+
+    def _assign_lease_loop(self):
+        """Keep >= one lease's worth of keys outstanding; periodically
+        drop all leases so placement staleness (a leased volume going
+        readonly/oversized/away) is bounded to the refresh window."""
+        from ..storage import native_engine
+        from ..storage.ttl import TTL
+
+        LEASE, LOW, REFRESH = 8192, 8192, 10.0
+        rp = ReplicaPlacement.parse("000")
+        rp_byte = rp.to_byte()
+        last_clear = time.monotonic()
+        while not self._stop.wait(0.2):
+            if not self.raft.is_leader:
+                native_engine.assign_clear()
+                continue
+            now = time.monotonic()
+            if now - last_clear >= REFRESH:
+                native_engine.assign_clear()
+                last_clear = now
+            if native_engine.assign_remaining() >= LOW:
+                continue
+            try:
+                if self.topo.writable_count("", rp_byte, 0) == 0:
+                    self._grow("", rp, TTL.parse(""), only_if_needed=True)
+                picked = self.topo.pick_for_write("", rp_byte, 0)
+                if picked is None:
+                    continue
+                vid, locations = picked
+                key, _ = self.topo.assign_file_id(LEASE)
+                native_engine.assign_add_lease(
+                    vid, locations[0]["url"],
+                    locations[0].get("publicUrl", ""), key,
+                    key + LEASE - 1)
+            except Exception:
+                continue  # lease refill must never die; retry next tick
+
+    def _handle_dir_status(self, req):
+        d = self.topo.to_dict()
+        if self._native_assign:
+            from ..storage import native_engine
+
+            d["native_assign_port"] = native_engine.server_port()
+        return d
 
     def _reap_loop(self):
         # periodic garbage vacuum rides the same loop (topology_vacuum.go:
@@ -120,7 +205,7 @@ class MasterServer:
         s.add("GET", "/dir/assign", self._handle_assign)
         s.add("POST", "/dir/assign", self._handle_assign)
         s.add("GET", "/dir/lookup", self._handle_lookup)
-        s.add("GET", "/dir/status", g(lambda r: self.topo.to_dict()))
+        s.add("GET", "/dir/status", g(self._handle_dir_status))
         s.add("GET", "/cluster/status", self._handle_cluster_status)
         s.add("POST", "/vol/grow", g(self._handle_grow))
         s.add("POST", "/vol/vacuum", g(self._handle_vacuum))

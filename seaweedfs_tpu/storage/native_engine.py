@@ -21,6 +21,7 @@ import ctypes
 import functools
 import os
 import subprocess
+import threading
 from typing import Callable, Iterator, Optional
 
 import numpy as np
@@ -88,6 +89,11 @@ def lib() -> Optional[ctypes.CDLL]:
     cdll.svn_server_start.restype = ctypes.c_int
     cdll.svn_server_start.argtypes = [ctypes.c_char_p, ctypes.c_int]
     cdll.svn_server_set_redirect.argtypes = [ctypes.c_char_p]
+    cdll.svn_server_port.restype = ctypes.c_int
+    cdll.svn_assign_add_lease.argtypes = [_u32, ctypes.c_char_p,
+                                          ctypes.c_char_p, _u64, _u64]
+    cdll.svn_assign_remaining.restype = _i64
+    cdll.svn_assign_clear.argtypes = []
     cdll.svn_server_stop.restype = ctypes.c_int
     cdll.svn_server_stats.argtypes = [ctypes.POINTER(_i64)]
     cdll.svn_bench.restype = ctypes.c_double
@@ -336,6 +342,57 @@ def server_stop():
     cdll = lib()
     if cdll is not None:
         cdll.svn_server_stop()
+
+
+def server_port() -> int:
+    """Bound port of the process-wide native listener (0 = none)."""
+    cdll = lib()
+    return cdll.svn_server_port() if cdll is not None else 0
+
+
+# one volume server per process may own the vid->handle serving registry
+# (the listener itself may have been started by the master for assign
+# leases in a combined process — serving is a separate claim)
+_serving_lock = threading.Lock()
+_serving_claimed = False
+
+
+def claim_serving() -> bool:
+    global _serving_claimed
+    with _serving_lock:
+        if _serving_claimed:
+            return False
+        _serving_claimed = True
+        return True
+
+
+def release_serving():
+    global _serving_claimed
+    with _serving_lock:
+        _serving_claimed = False
+
+
+def assign_add_lease(vid: int, url: str, public_url: str,
+                     key_start: int, key_end: int) -> bool:
+    """Lease [key_start, key_end] (inclusive) of volume vid's key space
+    to the native 'A' assign handler."""
+    cdll = lib()
+    if cdll is None:
+        return False
+    return cdll.svn_assign_add_lease(
+        vid, url.encode(), (public_url or "").encode(),
+        key_start, key_end) == 0
+
+
+def assign_remaining() -> int:
+    cdll = lib()
+    return int(cdll.svn_assign_remaining()) if cdll is not None else 0
+
+
+def assign_clear():
+    cdll = lib()
+    if cdll is not None:
+        cdll.svn_assign_clear()
 
 
 def server_stats() -> dict:

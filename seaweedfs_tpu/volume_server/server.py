@@ -221,16 +221,21 @@ class VolumeServer:
 
     def stop(self):
         self._stop.set()
-        if getattr(self, "_native_owner", False):
+        if getattr(self, "_native_owner", False) or \
+                getattr(self, "_native_listener_owner", False):
             from ..storage import native_engine
 
-            for vid in getattr(self, "_native_bound", set()):
-                native_engine.unserve_volume(vid)
-            for vid, entry in getattr(self, "_native_ec", {}).items():
-                native_engine.unserve_ec_volume(vid)
-                entry.binding.close()
-            native_engine.server_stop()
-            self._native_owner = False
+            if getattr(self, "_native_owner", False):
+                for vid in getattr(self, "_native_bound", set()):
+                    native_engine.unserve_volume(vid)
+                for vid, entry in getattr(self, "_native_ec", {}).items():
+                    native_engine.unserve_ec_volume(vid)
+                    entry.binding.close()
+                native_engine.release_serving()
+                self._native_owner = False
+            if getattr(self, "_native_listener_owner", False):
+                native_engine.server_stop()
+                self._native_listener_owner = False
         if self._tcp_sock is not None:
             try:
                 self._tcp_sock.close()
@@ -303,13 +308,19 @@ class VolumeServer:
                 and not self.guard.signing):
             host, port = self.server.address.rsplit(":", 1)
             wanted = int(port) + TCP_PORT_OFFSET
-            try:
-                bound = native_engine.server_start(
-                    host, wanted if wanted <= 65535 else 0,
-                    http_redirect=self.server.address)
-            except OSError:
-                bound = 0
-            if bound > 0:
+            bound = native_engine.server_port()
+            if bound <= 0:
+                try:
+                    bound = native_engine.server_start(
+                        host, wanted if wanted <= 65535 else 0,
+                        http_redirect=self.server.address)
+                    self._native_listener_owner = True
+                except OSError:
+                    bound = 0
+            # the listener may already exist (combined process: the
+            # master starts it for assign leases); SERVING vids is a
+            # separate, single-claim role per process
+            if bound > 0 and native_engine.claim_serving():
                 self.tcp_port = bound
                 self._native_owner = True
                 self._native_bound = set()

@@ -63,24 +63,34 @@ class VolumeTcpClient:
             return pool
 
     def tcp_address(self, http_url: str) -> str:
-        """port+20000 by convention; when that overflows (ephemeral test
-        ports) ask the server's /admin/status for its actual tcp_port."""
-        host, port = http_url.rsplit(":", 1)
-        wanted = int(port) + TCP_PORT_OFFSET
-        if wanted <= 65535:
-            return f"{host}:{wanted}"
+        """port+20000 by convention, verified with one cheap probe; when
+        the convention port is not listening (ephemeral test ports, or a
+        combined process whose single native listener rides the master's
+        port) ask the server's /admin/status for its actual tcp_port."""
         with self._lock:
             cached = self._resolved.get(http_url)
         if cached:
             return cached
-        from ..rpc.http_rpc import call
+        host, port = http_url.rsplit(":", 1)
+        wanted = int(port) + TCP_PORT_OFFSET
+        resolved = ""
+        if wanted <= 65535:
+            try:
+                probe = socket.create_connection((host, wanted),
+                                                 timeout=0.5)
+                probe.close()
+                resolved = f"{host}:{wanted}"
+            except OSError:
+                pass
+        if not resolved:
+            from ..rpc.http_rpc import call
 
-        status = call(http_url, "/admin/status", timeout=10)
-        tcp_port = status.get("tcp_port", 0)
-        if not tcp_port:
-            raise VolumeTcpError(
-                f"{http_url} does not serve the TCP fast path", 503)
-        resolved = f"{host}:{tcp_port}"
+            status = call(http_url, "/admin/status", timeout=10)
+            tcp_port = status.get("tcp_port", 0)
+            if not tcp_port:
+                raise VolumeTcpError(
+                    f"{http_url} does not serve the TCP fast path", 503)
+            resolved = f"{host}:{tcp_port}"
         with self._lock:
             self._resolved[http_url] = resolved
         return resolved

@@ -14,6 +14,7 @@ import json
 import random
 import socket
 import struct
+import time
 
 import pytest
 
@@ -290,6 +291,75 @@ class TestNativeServer:
         st, body = raw_request(native_server, b"G 6,9aabbccdd\n")
         assert (st, body) == (0, b"replica read")
         v.close()
+
+
+class TestNativeAssign:
+    def test_lease_fed_assigns(self, tmp_path):
+        """The master leases fid key ranges to the engine; raw 'A'
+        requests mint unique fids for writable volumes, interleaved
+        HTTP assigns never collide (shared sequencer), and exhausted
+        leases fall back with 503."""
+        from seaweedfs_tpu.storage import types as t
+
+        master = MasterServer(port=0, pulse_seconds=0.2,
+                              enable_native_assign=True)
+        master.start()
+        vs = VolumeServer([str(tmp_path)], master.address, port=0,
+                          pulse_seconds=0.2, enable_tcp=True)
+        vs.start()
+        vs.heartbeat_once()
+        try:
+            if not master._native_assign:
+                pytest.skip("another test holds the native port")
+            port = ne.server_port()
+            # wait for the refiller to plant a lease
+            deadline = time.time() + 10
+            st, body = 503, b""
+            while time.time() < deadline:
+                st, body = raw_request(port, b"A\n")
+                if st == 0:
+                    break
+                time.sleep(0.1)
+            assert st == 0, body
+            seen = set()
+            vids = set()
+            for _ in range(500):
+                st, body = raw_request(port, b"A\n")
+                assert st == 0
+                fid = json.loads(body)["fid"]
+                vid, nid, cookie = t.parse_file_id(fid)
+                assert fid not in seen
+                seen.add(fid)
+                vids.add(vid)
+            # interleaved HTTP assigns draw from the same sequencer
+            http_keys = set()
+            for _ in range(50):
+                a = call(master.address, "/dir/assign")
+                _, nid, _ = t.parse_file_id(a["fid"])
+                http_keys.add(nid)
+            native_keys = {t.parse_file_id(f)[1] for f in seen}
+            assert not (http_keys & native_keys)
+            # a minted fid is writable end-to-end
+            st, body = raw_request(port, b"A\n")
+            fid = json.loads(body)["fid"]
+            st, _ = raw_request(port, f"W {fid} 5\nhello".encode())
+            assert st == 0
+        finally:
+            vs.stop()
+            master.stop()
+
+    def test_assigns_stop_without_leases(self):
+        """No master lease loop -> 'A' answers 503 (clients fall back
+        to /dir/assign)."""
+        from seaweedfs_tpu.storage import native_engine as ne2
+
+        ne2.assign_clear()
+        port = ne2.server_start("127.0.0.1", 0)
+        try:
+            st, _ = raw_request(port, b"A\n")
+            assert st == 503
+        finally:
+            ne2.server_stop()
 
 
 class TestVolumeServerIntegration:

@@ -79,7 +79,8 @@ def cmd_master(args):
                      default_replication=args.defaultReplication,
                      pulse_seconds=args.pulseSeconds,
                      guard=_load_guard(),
-                     peers=peers, raft_dir=args.mdir)
+                     peers=peers, raft_dir=args.mdir,
+                     enable_native_assign=args.tcp)
     m.start()
     print(f"master listening on {m.address}" +
           (f", raft peers {m.raft.peers}" if peers else ""))
@@ -292,7 +293,8 @@ def cmd_server(args):
     guard = _load_guard()
     master = MasterServer(host=args.ip, port=args.masterPort,
                           volume_size_limit_mb=args.volumeSizeLimitMB,
-                          pulse_seconds=args.pulseSeconds, guard=guard)
+                          pulse_seconds=args.pulseSeconds, guard=guard,
+                          enable_native_assign=args.tcp)
     master.start()
     stoppables.append(master)
     print(f"master on {master.address}")
@@ -562,7 +564,8 @@ def cmd_benchmark(args):
     run_benchmark(args.master, num_files=args.n, file_size=args.size,
                   concurrency=args.c, delete_percent=args.deletePercent,
                   replication=args.replication, use_tcp=args.useTcp,
-                  use_native=args.useNative, assign_batch=args.assignBatch)
+                  use_native=args.useNative, assign_batch=args.assignBatch,
+                  per_file_assign=args.perFileAssign)
 
 
 def cmd_upload(args):
@@ -1018,6 +1021,9 @@ def main(argv=None):
     p.add_argument("-peers", default="",
                    help="comma-separated other master addresses (raft)")
     p.add_argument("-mdir", default="", help="raft state directory")
+    p.add_argument("-tcp", action="store_true",
+                   help="serve per-file assigns on the native fast-path "
+                        "port (port+20000) via leased fid ranges")
     p.set_defaults(fn=cmd_master)
 
     p = sub.add_parser("master.follower",
@@ -1174,6 +1180,9 @@ def main(argv=None):
     p.add_argument("-assignBatch", type=int, default=256,
                    help="fids per /dir/assign?count= call in -useNative "
                         "mode")
+    p.add_argument("-perFileAssign", action="store_true",
+                   help="per-file native assigns (master -tcp lease "
+                        "service) + native writes; write phase only")
     p.set_defaults(fn=cmd_benchmark)
 
     p = sub.add_parser("upload", help="upload one file")
