@@ -1199,9 +1199,19 @@ std::string assign_take(int64_t count) {
     size_t n = g_leases.size();
     for (size_t attempt = 0; attempt < n; attempt++) {
         auto& lease = g_leases[g_lease_rr.fetch_add(1) % n];
-        uint64_t key = lease->next.fetch_add((uint64_t)count);
-        if (key + (uint64_t)count > lease->end + 1 || key > lease->end)
-            continue;  // exhausted: the refiller prunes it
+        // CAS, not fetch_add: an oversized request must not burn the
+        // lease's remaining keys on its way to failing
+        uint64_t key = lease->next.load();
+        bool got = false;
+        while (key + (uint64_t)count <= lease->end + 1 &&
+               key <= lease->end) {
+            if (lease->next.compare_exchange_weak(
+                    key, key + (uint64_t)count)) {
+                got = true;
+                break;
+            }
+        }
+        if (!got) continue;  // exhausted or count doesn't fit: next lease
         uint32_t cookie = (uint32_t)assign_rand();
         char fid[64];
         snprintf(fid, sizeof(fid), "%u,%llx%08x", lease->vid,
@@ -1721,8 +1731,8 @@ double svn_bench(const char* host, int port, int op, const char* fids,
                 auto t0 = std::chrono::steady_clock::now();
                 uint32_t st = 500;
                 std::string assign;
-                bool ok = framed(fd, rxbuf, "A\n", &st, &assign) &&
-                          st == 0;
+                bool master_ok = framed(fd, rxbuf, "A\n", &st, &assign);
+                bool ok = master_ok && st == 0;
                 if (ok) {
                     std::string fid = json_field(assign, "fid");
                     std::string url = json_field(assign, "url");
@@ -1736,19 +1746,31 @@ double svn_bench(const char* host, int port, int op, const char* fids,
                                 atoi(url.c_str() + colon + 1) + 20000;
                             int vfd =
                                 dial(url.substr(0, colon), vport);
-                            it = vol_conns.emplace(url, vfd).first;
-                            vol_bufs.emplace(url, std::string());
+                            if (vfd >= 0) {
+                                it = vol_conns.emplace(url, vfd).first;
+                                vol_bufs.emplace(url, std::string());
+                            }
+                            // a failed dial is NOT cached: the server
+                            // may just not be listening yet
                         }
-                        if (it->second < 0) {
+                        if (it == vol_conns.end()) {
                             ok = false;
                         } else {
                             std::string wreq =
                                 "W " + fid + " " +
                                 std::to_string(payload.size()) + "\n" +
                                 payload;
-                            ok = framed(it->second, vol_bufs[url], wreq,
-                                        &st, nullptr) &&
-                                 st == 0;
+                            if (!framed(it->second, vol_bufs[url], wreq,
+                                        &st, nullptr)) {
+                                // dead volume conn: drop it so the next
+                                // slot re-dials
+                                close(it->second);
+                                vol_conns.erase(it);
+                                vol_bufs.erase(url);
+                                ok = false;
+                            } else {
+                                ok = st == 0;
+                            }
                         }
                     }
                 }
@@ -1761,6 +1783,8 @@ double svn_bench(const char* host, int port, int op, const char* fids,
                         1000.0f;
                 completed.fetch_add(1);
                 if (!ok) errors.fetch_add(1);
+                if (!master_ok) break;  // master conn dead: surviving
+                                        // workers drain the slots
                 continue;
             }
             const std::string& fid =

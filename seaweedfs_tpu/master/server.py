@@ -96,6 +96,12 @@ class MasterServer:
         if self._native_assign:
             from ..storage import native_engine
 
+            # join the refiller BEFORE clearing: a tick mid-refill could
+            # otherwise plant a lease that outlives this master in the
+            # process-global registry
+            t = getattr(self, "_lease_thread", None)
+            if t is not None:
+                t.join(timeout=5)
             native_engine.assign_clear()
             if self._native_assign_owner:
                 native_engine.server_stop()
@@ -127,8 +133,9 @@ class MasterServer:
         if native_engine.server_port() <= 0:
             return
         self._native_assign = True
-        threading.Thread(target=self._assign_lease_loop,
-                         daemon=True).start()
+        self._lease_thread = threading.Thread(
+            target=self._assign_lease_loop, daemon=True)
+        self._lease_thread.start()
 
     def _assign_lease_loop(self):
         """Keep >= one lease's worth of keys outstanding; periodically

@@ -324,6 +324,16 @@ def unserve_volume(vid: int):
         cdll.svn_serve(vid, 0)
 
 
+def server_set_redirect(addr: str):
+    """Point the native port's HTTP 302 fallback at the full handler
+    (the listener may have been started by a daemon that didn't know
+    the volume server's address, e.g. the master in a combined
+    process)."""
+    cdll = lib()
+    if cdll is not None:
+        cdll.svn_server_set_redirect(addr.encode())
+
+
 def server_start(host: str, port: int, http_redirect: str = "") -> int:
     """Start the native fast-path server; returns the bound port.
     `http_redirect` is the volume server's full HTTP address — plain

@@ -321,6 +321,10 @@ class VolumeServer:
             # master starts it for assign leases); SERVING vids is a
             # separate, single-claim role per process
             if bound > 0 and native_engine.claim_serving():
+                # the listener may predate this volume server (combined
+                # process: the master starts it for assign leases) —
+                # the HTTP 302 fallback must point at OUR full handler
+                native_engine.server_set_redirect(self.server.address)
                 self.tcp_port = bound
                 self._native_owner = True
                 self._native_bound = set()
