@@ -1175,6 +1175,7 @@ struct AssignLease {
     std::string url, public_url;
     std::atomic<uint64_t> next;
     uint64_t end;
+    std::chrono::steady_clock::time_point born;
 };
 
 std::shared_mutex g_lease_mu;
@@ -1499,18 +1500,28 @@ int svn_assign_add_lease(uint32_t vid, const char* url,
     lease->public_url = public_url && *public_url ? public_url : url;
     lease->next.store(key_start);
     lease->end = key_end;
+    lease->born = std::chrono::steady_clock::now();
     std::unique_lock<std::shared_mutex> lk(g_lease_mu);
     g_leases.push_back(std::move(lease));
     return 0;
 }
 
-// Remaining assignable keys across live leases; prunes exhausted ones.
-int64_t svn_assign_remaining() {
+// Remaining assignable keys across live leases; prunes exhausted ones
+// and (when max_age_ms > 0) leases older than max_age_ms, so placement
+// staleness expires per-lease instead of via a global clear that would
+// stall every assigner at once.
+int64_t svn_assign_remaining(int64_t max_age_ms) {
+    auto now = std::chrono::steady_clock::now();
     std::unique_lock<std::shared_mutex> lk(g_lease_mu);
     int64_t total = 0;
     for (auto it = g_leases.begin(); it != g_leases.end();) {
         uint64_t next = (*it)->next.load();
-        if (next > (*it)->end) {
+        bool expired =
+            max_age_ms > 0 &&
+            std::chrono::duration_cast<std::chrono::milliseconds>(
+                now - (*it)->born)
+                    .count() > max_age_ms;
+        if (next > (*it)->end || expired) {
             it = g_leases.erase(it);
         } else {
             total += (int64_t)((*it)->end - next + 1);
@@ -1732,6 +1743,15 @@ double svn_bench(const char* host, int port, int op, const char* fids,
                 uint32_t st = 500;
                 std::string assign;
                 bool master_ok = framed(fd, rxbuf, "A\n", &st, &assign);
+                // a 503 is a transient lease drought (refill ticks every
+                // 0.2 s): wait briefly like a real client would fall
+                // back, instead of charging an instant error
+                for (int retry = 0; master_ok && st == 503 && retry < 50;
+                     retry++) {
+                    std::this_thread::sleep_for(
+                        std::chrono::milliseconds(5));
+                    master_ok = framed(fd, rxbuf, "A\n", &st, &assign);
+                }
                 bool ok = master_ok && st == 0;
                 if (ok) {
                     std::string fid = json_field(assign, "fid");

@@ -138,38 +138,40 @@ class MasterServer:
         self._lease_thread.start()
 
     def _assign_lease_loop(self):
-        """Keep >= one lease's worth of keys outstanding; periodically
-        drop all leases so placement staleness (a leased volume going
-        readonly/oversized/away) is bounded to the refresh window."""
+        """Keep several leases' worth of keys outstanding; leases expire
+        individually after REFRESH seconds so placement staleness (a
+        leased volume going readonly/oversized/away) is bounded without
+        a global clear stalling every assigner at once."""
         from ..storage import native_engine
         from ..storage.ttl import TTL
 
-        LEASE, LOW, REFRESH = 8192, 8192, 10.0
+        # LOW keeps several leases outstanding so a burst cannot drain
+        # the pool between 0.2 s refill ticks (a drought answers 503)
+        LEASE, LOW, REFRESH_MS = 8192, 32768, 10_000
         rp = ReplicaPlacement.parse("000")
         rp_byte = rp.to_byte()
-        last_clear = time.monotonic()
         while not self._stop.wait(0.2):
             if not self.raft.is_leader:
                 native_engine.assign_clear()
                 continue
-            now = time.monotonic()
-            if now - last_clear >= REFRESH:
-                native_engine.assign_clear()
-                last_clear = now
-            if native_engine.assign_remaining() >= LOW:
-                continue
             try:
-                if self.topo.writable_count("", rp_byte, 0) == 0:
-                    self._grow("", rp, TTL.parse(""), only_if_needed=True)
-                picked = self.topo.pick_for_write("", rp_byte, 0)
-                if picked is None:
-                    continue
-                vid, locations = picked
-                key, _ = self.topo.assign_file_id(LEASE)
-                native_engine.assign_add_lease(
-                    vid, locations[0]["url"],
-                    locations[0].get("publicUrl", ""), key,
-                    key + LEASE - 1)
+                # refill up to a few leases per tick: a single lease per
+                # 0.2 s would cap sustained assigns at LEASE/0.2 ≈ 40k/s
+                for _ in range(8):
+                    if native_engine.assign_remaining(REFRESH_MS) >= LOW:
+                        break
+                    if self.topo.writable_count("", rp_byte, 0) == 0:
+                        self._grow("", rp, TTL.parse(""),
+                                   only_if_needed=True)
+                    picked = self.topo.pick_for_write("", rp_byte, 0)
+                    if picked is None:
+                        break
+                    vid, locations = picked
+                    key, _ = self.topo.assign_file_id(LEASE)
+                    native_engine.assign_add_lease(
+                        vid, locations[0]["url"],
+                        locations[0].get("publicUrl", ""), key,
+                        key + LEASE - 1)
             except Exception:
                 continue  # lease refill must never die; retry next tick
 

@@ -93,6 +93,7 @@ def lib() -> Optional[ctypes.CDLL]:
     cdll.svn_assign_add_lease.argtypes = [_u32, ctypes.c_char_p,
                                           ctypes.c_char_p, _u64, _u64]
     cdll.svn_assign_remaining.restype = _i64
+    cdll.svn_assign_remaining.argtypes = [_i64]
     cdll.svn_assign_clear.argtypes = []
     cdll.svn_server_stop.restype = ctypes.c_int
     cdll.svn_server_stats.argtypes = [ctypes.POINTER(_i64)]
@@ -394,9 +395,12 @@ def assign_add_lease(vid: int, url: str, public_url: str,
         key_start, key_end) == 0
 
 
-def assign_remaining() -> int:
+def assign_remaining(max_age_ms: int = 0) -> int:
+    """Remaining leased keys; prunes exhausted leases and, when
+    max_age_ms > 0, leases older than that (per-lease staleness bound)."""
     cdll = lib()
-    return int(cdll.svn_assign_remaining()) if cdll is not None else 0
+    return (int(cdll.svn_assign_remaining(max_age_ms))
+            if cdll is not None else 0)
 
 
 def assign_clear():
