@@ -23,11 +23,18 @@ from .rs_numpy import NumpyEncoder, ReconstructError, RSCodecBase  # noqa: F401
 
 
 class NativeEncoder(RSCodecBase):
-    """CPU codec backed by the AVX2 C++ kernels in native/ec_native.cpp."""
+    """CPU codec backed by the C++ kernel ladder in native/ec_native.cpp
+    (GFNI+AVX-512 > GFNI+AVX2 > AVX2-PSHUFB > scalar, runtime-dispatched).
 
-    def __init__(self, data_shards: int = 10, parity_shards: int = 4):
+    `level` pins a specific kernel (bench baselines): 1 = the AVX2 PSHUFB
+    nibble-table kernel, the same algorithm class as the klauspost codec
+    the reference vendors; -1 (default) = best available."""
+
+    def __init__(self, data_shards: int = 10, parity_shards: int = 4,
+                 level: int = -1):
         super().__init__(data_shards, parity_shards)
         self._lib = native.lib()
+        self._level = level
         if self._lib is None:
             raise RuntimeError("native library unavailable")
 
@@ -37,12 +44,27 @@ class NativeEncoder(RSCodecBase):
         matrix = np.ascontiguousarray(matrix, dtype=np.uint8)
         inputs = np.ascontiguousarray(inputs, dtype=np.uint8)
         out = np.zeros((p, length), dtype=np.uint8)
-        self._lib.sw_gf_apply_matrix(
+        self._lib.sw_gf_apply_matrix_force(
             matrix.ctypes.data_as(ctypes.c_char_p), p, d,
             inputs.ctypes.data_as(ctypes.c_char_p), length,
-            out.ctypes.data_as(ctypes.c_char_p),
+            out.ctypes.data_as(ctypes.c_char_p), self._level,
         )
         return out
+
+    def encode_rows(self, parity_matrix: np.ndarray, data: np.ndarray,
+                    parity_out: np.ndarray) -> list[int]:
+        """Fused span encode: data (R, d, L) -> parity_out (R, p, L), one
+        ctypes call; returns per-shard CRC32Cs chained across the R rows
+        (= the rolling file CRC of the span's L*R-byte shard slice)."""
+        p, d = parity_matrix.shape
+        rows, _, length = data.shape
+        crcs = (ctypes.c_uint32 * (d + p))()
+        self._lib.sw_encode_rows(
+            parity_matrix.ctypes.data_as(ctypes.c_char_p), p, d,
+            data.ctypes.data_as(ctypes.c_char_p), length, rows,
+            parity_out.ctypes.data_as(ctypes.c_char_p), crcs,
+        )
+        return list(crcs)
 
 
 def new_host_encoder(data_shards: int = 10, parity_shards: int = 4):

@@ -46,9 +46,29 @@ def lib() -> ctypes.CDLL | None:
     ]
     cdll.sw_has_avx2.restype = ctypes.c_int
     cdll.sw_has_avx2.argtypes = []
+    cdll.sw_cpu_level.restype = ctypes.c_int
+    cdll.sw_cpu_level.argtypes = []
+    cdll.sw_gf_apply_matrix_force.restype = None
+    cdll.sw_gf_apply_matrix_force.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+        ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p, ctypes.c_int,
+    ]
+    cdll.sw_encode_rows.restype = None
+    cdll.sw_encode_rows.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+        ctypes.c_char_p, ctypes.c_size_t, ctypes.c_int, ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_uint32),
+    ]
     return cdll
 
 
 def has_avx2() -> bool:
     cdll = lib()
     return bool(cdll and cdll.sw_has_avx2())
+
+
+def cpu_level() -> int:
+    """Best GF kernel level: 0 scalar, 1 AVX2-PSHUFB, 2 GFNI+AVX2,
+    3 GFNI+AVX-512 (see native/ec_native.cpp kernel ladder)."""
+    cdll = lib()
+    return int(cdll.sw_cpu_level()) if cdll else 0

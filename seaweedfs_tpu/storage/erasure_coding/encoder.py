@@ -75,14 +75,12 @@ def write_ec_files(base_file_name: str, encoder=None,
                               large_block=large_block_size,
                               small_block=small_block_size)
         return crcs[base_file_name]
-    if auto_host and (os.cpu_count() or 1) >= 4:
-        # auto-selection rejected the (link-capped) device path: on a
-        # multi-core host run the PIPELINED host mode — reader/writer
-        # threads overlap with the native codec (which releases the
-        # GIL), and fused shard CRCs come along for the .vif.  On a
-        # 1-2 core host threads only add switching, so fall through to
-        # the synchronous loop (the reference architecture, and the
-        # floor on a purely CPU-bound box).
+    if auto_host:
+        # auto-selection rejected the (link-capped) device path: run the
+        # host pipeline — fused GFNI parity+CRC spans with preallocated
+        # unbuffered shard writes; inline on a single core (no thread
+        # convoy), reader thread + a codec worker per core otherwise —
+        # and fused shard CRCs come along for the .vif.
         from ...parallel.batched_encode import encode_volumes
 
         crcs = encode_volumes([base_file_name],
