@@ -307,6 +307,13 @@ struct NVolume {
     std::atomic<bool> writable{false};   // native W/D allowed
     std::atomic<bool> read_only{false};
     std::atomic<bool> do_fsync{false};
+    // TTL volumes: reads 404 expired needles (volume_read.go:27-35);
+    // the daemon's vacuum still reclaims them
+    std::atomic<int64_t> ttl_sec{0};
+    // replicated volumes: native writes must fan out to this many other
+    // locations (store_replicate.go:24-141); when the replica address
+    // set is smaller, writes 307 to the Python handler instead
+    std::atomic<int> extra_copies{0};
 
     // group commit for -fsync volumes (volume_write.go:233-306 /
     // _FsyncBatcher semantics): tickets issued under wmu; one leader
@@ -399,6 +406,16 @@ std::unordered_map<int64_t, EcPtr> g_ec_handles;   // handle -> EC volume
 std::unordered_map<uint32_t, int64_t> g_ec_serving;  // vid -> EC handle
 std::atomic<int64_t> g_next_handle{1};
 
+// JWT keys for the fast-path port; set before svn_server_start (the
+// Python daemon configures them from security.toml at startup).
+std::mutex g_jwt_mu;
+std::string g_jwt_write_key, g_jwt_read_key;
+int g_jwt_expire_s = 10;
+
+// Replica fan-out registry: vid -> peer fast-path addresses.
+std::shared_mutex g_replica_mu;
+std::unordered_map<uint32_t, std::vector<std::string>> g_replicas;
+
 VolPtr handle_vol(int64_t h) {
     std::shared_lock<std::shared_mutex> lk(g_reg_mu);
     auto it = g_handles.find(h);
@@ -480,6 +497,33 @@ bool parse_needle_data(const uint8_t* blob, int64_t blob_len, int32_t size,
     return true;
 }
 
+// Walk the needle body's optional fields to the 5-byte lastModified
+// (needle layout: Data, Flags, [Name], [Mime], [LastModified], ... —
+// needle_read.go:114-177).  0 when absent/unparseable.
+int64_t needle_last_modified(const uint8_t* b, int64_t blob_len,
+                             int32_t size, int version) {
+    if (version == 1 || size <= 0) return 0;
+    if (kHeaderSize + 4 > blob_len) return 0;
+    uint32_t dsize = get_be32(b + kHeaderSize);
+    int64_t p = kHeaderSize + 4 + (int64_t)dsize;
+    int64_t end = std::min<int64_t>(kHeaderSize + size, blob_len);
+    if (p >= end) return 0;
+    uint8_t flags = b[p++];
+    if (flags & 0x02) {  // HAS_NAME
+        if (p >= end) return 0;
+        p += 1 + b[p];
+    }
+    if (flags & 0x04) {  // HAS_MIME
+        if (p >= end) return 0;
+        p += 1 + b[p];
+    }
+    if (!(flags & kFlagHasLastModified)) return 0;
+    if (p + kLastModifiedBytes > end) return 0;
+    int64_t v = 0;
+    for (int i = 0; i < kLastModifiedBytes; i++) v = (v << 8) | b[p + i];
+    return v;
+}
+
 }  // namespace
 
 extern "C" {
@@ -546,6 +590,53 @@ int svn_set_flags(int64_t handle, int writable, int read_only) {
     return 0;
 }
 
+// TTL volumes: native reads 404 needles older than ttl_sec (0 = none).
+int svn_set_ttl(int64_t handle, int64_t ttl_sec) {
+    auto v = handle_vol(handle);
+    if (!v) return -1;
+    v->ttl_sec.store(ttl_sec);
+    return 0;
+}
+
+// Replicated volumes: native writes fan out to `extra_copies` other
+// locations (or 307 when the replica set is not configured).
+int svn_set_replication(int64_t handle, int extra_copies) {
+    auto v = handle_vol(handle);
+    if (!v) return -1;
+    v->extra_copies.store(extra_copies);
+    return 0;
+}
+
+// Replace vid's peer fast-path addresses ("host:port,host:port"; empty
+// or NULL clears).  The daemon refreshes these from master lookups.
+int svn_set_replicas(uint32_t vid, const char* csv) {
+    std::vector<std::string> addrs;
+    if (csv) {
+        const char* p = csv;
+        while (*p) {
+            const char* comma = strchr(p, ',');
+            size_t n = comma ? (size_t)(comma - p) : strlen(p);
+            if (n) addrs.emplace_back(p, n);
+            p += n + (comma ? 1 : 0);
+        }
+    }
+    std::unique_lock<std::shared_mutex> lk(g_replica_mu);
+    if (addrs.empty()) g_replicas.erase(vid);
+    else g_replicas[vid] = std::move(addrs);
+    return 0;
+}
+
+// HS256 signing keys for the fast-path port (security.toml jwt.signing
+// / jwt.signing.read — guard.go:18-50).  Empty string disables a key.
+int svn_server_set_jwt(const char* write_key, const char* read_key,
+                       int expire_s) {
+    std::lock_guard<std::mutex> lk(g_jwt_mu);
+    g_jwt_write_key = write_key ? write_key : "";
+    g_jwt_read_key = read_key ? read_key : "";
+    if (expire_s > 0) g_jwt_expire_s = expire_s;
+    return 0;
+}
+
 // Bind/unbind a volume id to a handle for the TCP server
 int svn_serve(uint32_t vid, int64_t handle) {
     std::unique_lock<std::shared_mutex> lk(g_reg_mu);
@@ -570,8 +661,13 @@ int svn_nm_delete(int64_t handle, uint64_t nid, uint64_t tomb_off) {
     auto v = handle_vol(handle);
     if (!v) return -1;
     std::unique_lock<std::shared_mutex> lk(v->nm.mu);
+    // idx log FIRST: an ENOSPC/EIO append must fail the request before
+    // the in-RAM map records a state the log never will (the Python
+    // caller raises on a negative return)
+    if (!append_idx_entry(v.get(), nid, tomb_off, kTombstone))
+        return -(errno ? errno : EIO);
     v->nm.apply(nid, 0, kTombstone);
-    return append_idx_entry(v.get(), nid, tomb_off, kTombstone) ? 0 : -errno;
+    return 0;
 }
 
 // Apply + log the entry only when it is newer than the current mapping
@@ -587,8 +683,10 @@ int svn_nm_put_if_newer(int64_t handle, uint64_t nid, uint64_t off,
     uint64_t cur_off;
     int32_t cur_size;
     if (v->nm.get(nid, &cur_off, &cur_size) && cur_off >= off) return 0;
+    if (!append_idx_entry(v.get(), nid, off, (int32_t)size))
+        return -(errno ? errno : EIO);
     v->nm.apply(nid, off, (int32_t)size);
-    return append_idx_entry(v.get(), nid, off, (int32_t)size) ? 1 : -errno;
+    return 1;
 }
 
 int svn_nm_set_memory(int64_t handle, uint64_t nid, uint64_t off,
@@ -862,6 +960,276 @@ bool gunzip(const std::string& in, std::string* out) {
     return rc == Z_STREAM_END;
 }
 
+// ---------------------------------------------------------------------------
+// SHA-256 / HMAC-SHA256 / base64url — self-contained (no OpenSSL), for
+// HS256 JWT verification and minting on the fast-path port.  Semantics
+// mirror security/jwt_auth.py (itself weed/security/jwt.go + guard.go:
+// fid-scoped claims, exp checked, HS256 only — and because verification
+// recomputes HMAC-SHA256 unconditionally, alg-confusion tokens like
+// "alg":"none" can never pass).
+// ---------------------------------------------------------------------------
+
+struct Sha256 {
+    uint32_t h[8];
+    uint64_t len = 0;
+    uint8_t buf[64];
+    size_t fill = 0;
+    Sha256() {
+        static const uint32_t init[8] = {
+            0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+            0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19};
+        memcpy(h, init, sizeof(h));
+    }
+    static uint32_t rotr(uint32_t x, int n) {
+        return (x >> n) | (x << (32 - n));
+    }
+    void block(const uint8_t* p) {
+        static const uint32_t k[64] = {
+            0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b,
+            0x59f111f1, 0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01,
+            0x243185be, 0x550c7dc3, 0x72be5d74, 0x80deb1fe, 0x9bdc06a7,
+            0xc19bf174, 0xe49b69c1, 0xefbe4786, 0x0fc19dc6, 0x240ca1cc,
+            0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da, 0x983e5152,
+            0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+            0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc,
+            0x53380d13, 0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85,
+            0xa2bfe8a1, 0xa81a664b, 0xc24b8b70, 0xc76c51a3, 0xd192e819,
+            0xd6990624, 0xf40e3585, 0x106aa070, 0x19a4c116, 0x1e376c08,
+            0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a, 0x5b9cca4f,
+            0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+            0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2};
+        uint32_t w[64];
+        for (int i = 0; i < 16; i++)
+            w[i] = ((uint32_t)p[4 * i] << 24) | ((uint32_t)p[4 * i + 1] << 16) |
+                   ((uint32_t)p[4 * i + 2] << 8) | p[4 * i + 3];
+        for (int i = 16; i < 64; i++) {
+            uint32_t s0 = rotr(w[i - 15], 7) ^ rotr(w[i - 15], 18) ^
+                          (w[i - 15] >> 3);
+            uint32_t s1 = rotr(w[i - 2], 17) ^ rotr(w[i - 2], 19) ^
+                          (w[i - 2] >> 10);
+            w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+        }
+        uint32_t a = h[0], b = h[1], c = h[2], d = h[3], e = h[4],
+                 f = h[5], g = h[6], hh = h[7];
+        for (int i = 0; i < 64; i++) {
+            uint32_t s1 = rotr(e, 6) ^ rotr(e, 11) ^ rotr(e, 25);
+            uint32_t ch = (e & f) ^ (~e & g);
+            uint32_t t1 = hh + s1 + ch + k[i] + w[i];
+            uint32_t s0 = rotr(a, 2) ^ rotr(a, 13) ^ rotr(a, 22);
+            uint32_t mj = (a & b) ^ (a & c) ^ (b & c);
+            uint32_t t2 = s0 + mj;
+            hh = g; g = f; f = e; e = d + t1;
+            d = c; c = b; b = a; a = t1 + t2;
+        }
+        h[0] += a; h[1] += b; h[2] += c; h[3] += d;
+        h[4] += e; h[5] += f; h[6] += g; h[7] += hh;
+    }
+    void update(const void* data, size_t n) {
+        const uint8_t* p = (const uint8_t*)data;
+        len += n;
+        if (fill) {
+            size_t take = std::min(n, 64 - fill);
+            memcpy(buf + fill, p, take);
+            fill += take;
+            p += take;
+            n -= take;
+            if (fill == 64) {
+                block(buf);
+                fill = 0;
+            }
+        }
+        while (n >= 64) {
+            block(p);
+            p += 64;
+            n -= 64;
+        }
+        if (n) {
+            memcpy(buf, p, n);
+            fill = n;
+        }
+    }
+    void final(uint8_t out[32]) {
+        uint64_t bits = len * 8;
+        uint8_t pad = 0x80;
+        update(&pad, 1);
+        uint8_t zero = 0;
+        while (fill != 56) update(&zero, 1);
+        uint8_t lenb[8];
+        for (int i = 0; i < 8; i++)
+            lenb[i] = (uint8_t)(bits >> (8 * (7 - i)));
+        update(lenb, 8);
+        for (int i = 0; i < 8; i++) {
+            out[4 * i] = (uint8_t)(h[i] >> 24);
+            out[4 * i + 1] = (uint8_t)(h[i] >> 16);
+            out[4 * i + 2] = (uint8_t)(h[i] >> 8);
+            out[4 * i + 3] = (uint8_t)h[i];
+        }
+    }
+};
+
+void hmac_sha256(const std::string& key, const std::string& msg,
+                 uint8_t out[32]) {
+    uint8_t k[64] = {0};
+    if (key.size() > 64) {
+        Sha256 kh;
+        kh.update(key.data(), key.size());
+        kh.final(k);
+    } else {
+        memcpy(k, key.data(), key.size());
+    }
+    uint8_t ipad[64], opad[64];
+    for (int i = 0; i < 64; i++) {
+        ipad[i] = k[i] ^ 0x36;
+        opad[i] = k[i] ^ 0x5c;
+    }
+    uint8_t inner[32];
+    Sha256 si;
+    si.update(ipad, 64);
+    si.update(msg.data(), msg.size());
+    si.final(inner);
+    Sha256 so;
+    so.update(opad, 64);
+    so.update(inner, 32);
+    so.final(out);
+}
+
+const char* kB64Url =
+    "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789-_";
+
+std::string b64url_encode(const uint8_t* data, size_t n) {
+    std::string out;
+    out.reserve((n + 2) / 3 * 4);
+    for (size_t i = 0; i < n; i += 3) {
+        uint32_t v = (uint32_t)data[i] << 16;
+        if (i + 1 < n) v |= (uint32_t)data[i + 1] << 8;
+        if (i + 2 < n) v |= data[i + 2];
+        out += kB64Url[(v >> 18) & 63];
+        out += kB64Url[(v >> 12) & 63];
+        if (i + 1 < n) out += kB64Url[(v >> 6) & 63];
+        if (i + 2 < n) out += kB64Url[v & 63];
+    }
+    return out;  // unpadded, like jwt_auth.py _b64url
+}
+
+bool b64url_decode(const std::string& in, std::string* out) {
+    static int8_t rev[256];
+    static bool init = false;
+    if (!init) {
+        memset(rev, -1, sizeof(rev));
+        for (int i = 0; i < 64; i++) rev[(uint8_t)kB64Url[i]] = (int8_t)i;
+        rev[(uint8_t)'+'] = 62;  // accept standard alphabet too
+        rev[(uint8_t)'/'] = 63;
+        init = true;
+    }
+    out->clear();
+    uint32_t acc = 0;
+    int bits = 0;
+    for (char c : in) {
+        if (c == '=') break;
+        int8_t v = rev[(uint8_t)c];
+        if (v < 0) return false;
+        acc = (acc << 6) | (uint32_t)v;
+        bits += 6;
+        if (bits >= 8) {
+            bits -= 8;
+            out->push_back((char)((acc >> bits) & 0xFF));
+        }
+    }
+    return true;
+}
+
+std::string jwt_key(bool write) {
+    std::lock_guard<std::mutex> lk(g_jwt_mu);
+    return write ? g_jwt_write_key : g_jwt_read_key;
+}
+
+// Extract a string claim ("fid") from a JSON payload minted by the
+// framework/reference (flat object, no escapes inside fids).
+bool json_str_claim(const std::string& json, const char* name,
+                    std::string* out) {
+    std::string pat = std::string("\"") + name + "\":";
+    size_t p = json.find(pat);
+    if (p == std::string::npos) return false;
+    p += pat.size();
+    while (p < json.size() && json[p] == ' ') p++;
+    if (p >= json.size() || json[p] != '"') return false;
+    size_t e = json.find('"', p + 1);
+    if (e == std::string::npos) return false;
+    *out = json.substr(p + 1, e - p - 1);
+    return true;
+}
+
+bool json_num_claim(const std::string& json, const char* name,
+                    int64_t* out) {
+    std::string pat = std::string("\"") + name + "\":";
+    size_t p = json.find(pat);
+    if (p == std::string::npos) return false;
+    p += pat.size();
+    while (p < json.size() && json[p] == ' ') p++;
+    errno = 0;
+    char* endp = nullptr;
+    long long v = strtoll(json.c_str() + p, &endp, 10);
+    if (errno || endp == json.c_str() + p) return false;
+    *out = (int64_t)v;
+    return true;
+}
+
+// Verify an HS256 write/read token scoped to `fid` (guard.go:18-50 /
+// jwt_auth.py decode_jwt + the fid-claim checks).  Write semantics
+// accept the base fid of a count>1 assign ("fid_3" matches claim "fid",
+// the file-id delta convention) and volume-level tokens ("3," claims
+// authorize any fid in volume 3) — jwt_auth.py verify_write:134-140;
+// read tokens compare exactly (verify_read:151).
+bool jwt_verify(const std::string& key, const std::string& token,
+                const std::string& fid, bool write_semantics) {
+    size_t d1 = token.find('.');
+    if (d1 == std::string::npos) return false;
+    size_t d2 = token.find('.', d1 + 1);
+    if (d2 == std::string::npos) return false;
+    uint8_t mac[32];
+    hmac_sha256(key, token.substr(0, d2), mac);
+    std::string sig;
+    if (!b64url_decode(token.substr(d2 + 1), &sig) || sig.size() != 32)
+        return false;
+    // constant-time compare
+    uint8_t diff = 0;
+    for (int i = 0; i < 32; i++) diff |= mac[i] ^ (uint8_t)sig[i];
+    if (diff) return false;
+    std::string payload;
+    if (!b64url_decode(token.substr(d1 + 1, d2 - d1 - 1), &payload))
+        return false;
+    int64_t exp;
+    if (json_num_claim(payload, "exp", &exp)) {
+        int64_t now = (int64_t)(now_unix_ns() / 1000000000ull);
+        if (now > exp) return false;
+    }
+    std::string claim_fid;
+    if (!json_str_claim(payload, "fid", &claim_fid)) return false;
+    if (!write_semantics) return claim_fid == fid;
+    if (claim_fid == fid.substr(0, fid.find('_'))) return true;
+    return !claim_fid.empty() && claim_fid.back() == ',' &&
+           fid.rfind(claim_fid, 0) == 0;
+}
+
+// Mint a write token for an assign reply (jwt.go GenJwtForVolumeServer).
+std::string jwt_mint(const std::string& key, const std::string& fid,
+                     int expire_s) {
+    static const char* header_b64 =
+        "eyJhbGciOiJIUzI1NiIsInR5cCI6IkpXVCJ9";  // {"alg":"HS256","typ":"JWT"}
+    std::string claims = "{\"fid\":\"" + fid + "\"";
+    if (expire_s > 0) {
+        int64_t now = (int64_t)(now_unix_ns() / 1000000000ull);
+        claims += ",\"exp\":" + std::to_string(now + expire_s);
+    }
+    claims += "}";
+    std::string signing = std::string(header_b64) + "." +
+                          b64url_encode((const uint8_t*)claims.data(),
+                                        claims.size());
+    uint8_t mac[32];
+    hmac_sha256(key, signing, mac);
+    return signing + "." + b64url_encode(mac, 32);
+}
+
 // Verify + extract the payload from a full needle record blob: size and
 // cookie checks, CRC over data, store-side-gzip decompression
 // (needle_read.go ReadBytes:52-95 + the HTTP handler's encoding rules)
@@ -998,7 +1366,160 @@ Reply handle_read(uint32_t vid, uint64_t nid, uint32_t cookie,
     if (!pread_full(v->dat_fd, (uint8_t*)blob.data(), (size_t)actual,
                     (int64_t)off))
         return {500, "short read"};
+    int64_t ttl = v->ttl_sec.load();
+    if (ttl > 0) {
+        // TTL volumes serve natively too; expired needles answer 404
+        // exactly like the HTTP handler (volume_read.go:27-35)
+        int64_t lm = needle_last_modified(
+            (const uint8_t*)blob.data(), actual, size, v->version);
+        int64_t now_s = (int64_t)(now_unix_ns() / 1000000000ull);
+        if (lm > 0 && now_s >= lm + ttl) return {404, "expired"};
+    }
     return finish_needle_read(blob, size, v->version, cookie);
+}
+
+// ---------------------------------------------------------------------------
+// Replica fan-out: native->native framed forwarding for writes/deletes
+// on replicated volumes (store_replicate.go:24-141: write locally, then
+// every other location must succeed).  The daemon pushes each vid's
+// peer fast-path addresses (svn_set_replicas); a write marked
+// replicate ('R') never fans out again.
+// ---------------------------------------------------------------------------
+
+// tiny pooled TCP client for peer fast-path ports
+std::mutex g_fwd_mu;
+std::unordered_map<std::string, std::vector<int>> g_fwd_idle;
+
+int fwd_connect(const std::string& addr) {
+    size_t colon = addr.rfind(':');
+    if (colon == std::string::npos) return -1;
+    std::string host = addr.substr(0, colon);
+    std::string port = addr.substr(colon + 1);
+    struct addrinfo hints {};
+    hints.ai_family = AF_UNSPEC;
+    hints.ai_socktype = SOCK_STREAM;
+    struct addrinfo* res = nullptr;
+    if (getaddrinfo(host.c_str(), port.c_str(), &hints, &res) != 0)
+        return -1;
+    int fd = -1;
+    for (auto* ai = res; ai; ai = ai->ai_next) {
+        fd = socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+        if (fd < 0) continue;
+        struct timeval tv {2, 0};
+        setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+        struct timeval rtv {10, 0};
+        setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &rtv, sizeof(rtv));
+        if (connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) break;
+        close(fd);
+        fd = -1;
+    }
+    freeaddrinfo(res);
+    if (fd >= 0) {
+        int one = 1;
+        setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    }
+    return fd;
+}
+
+int fwd_take(const std::string& addr) {
+    {
+        std::lock_guard<std::mutex> lk(g_fwd_mu);
+        auto it = g_fwd_idle.find(addr);
+        if (it != g_fwd_idle.end() && !it->second.empty()) {
+            int fd = it->second.back();
+            it->second.pop_back();
+            return fd;
+        }
+    }
+    return fwd_connect(addr);
+}
+
+void fwd_put(const std::string& addr, int fd) {
+    std::lock_guard<std::mutex> lk(g_fwd_mu);
+    auto& pool = g_fwd_idle[addr];
+    if (pool.size() >= 8) {
+        close(fd);
+        return;
+    }
+    pool.push_back(fd);
+}
+
+bool fwd_send_all(int fd, const char* data, size_t n) {
+    size_t sent = 0;
+    while (sent < n) {
+        ssize_t r = send(fd, data + sent, n - sent, MSG_NOSIGNAL);
+        if (r <= 0) return false;
+        sent += (size_t)r;
+    }
+    return true;
+}
+
+bool fwd_recv_all(int fd, uint8_t* out, size_t n) {
+    size_t got = 0;
+    while (got < n) {
+        ssize_t r = recv(fd, out + got, n - got, 0);
+        if (r <= 0) return false;
+        got += (size_t)r;
+    }
+    return true;
+}
+
+// One framed request/reply on a pooled peer connection; retries once on
+// a stale pooled socket.  Returns false only when the peer is
+// unreachable; otherwise *status carries the peer's reply code.
+bool fwd_request(const std::string& addr, const std::string& frame,
+                 uint32_t* status) {
+    for (int attempt = 0; attempt < 2; attempt++) {
+        int fd = fwd_take(addr);
+        if (fd < 0) return false;
+        uint8_t hdr[8];
+        if (fwd_send_all(fd, frame.data(), frame.size()) &&
+            fwd_recv_all(fd, hdr, 8)) {
+            *status = get_be32(hdr);
+            uint32_t plen = get_be32(hdr + 4);
+            std::vector<uint8_t> payload(plen);
+            if (plen == 0 || fwd_recv_all(fd, payload.data(), plen)) {
+                fwd_put(addr, fd);
+                return true;
+            }
+        }
+        close(fd);  // stale/broken: retry with a fresh connection
+    }
+    return false;
+}
+
+// Fan a verified local write/delete out to the vid's other locations.
+// 0 = all replicas acked; 307 = can't forward natively (the client
+// falls back to the Python handler, whose fan-out + identical-rewrite
+// dedup make the retry safe); 500 = a replica hard-failed.
+uint32_t forward_to_replicas(uint32_t vid, const std::string& fid,
+                             const std::string* body,
+                             const std::string& jwt, int needed) {
+    std::vector<std::string> addrs;
+    {
+        std::shared_lock<std::shared_mutex> lk(g_replica_mu);
+        auto it = g_replicas.find(vid);
+        if (it != g_replicas.end()) addrs = it->second;
+    }
+    if ((int)addrs.size() < needed) return 307;
+    for (const auto& addr : addrs) {
+        std::string frame;
+        if (body) {
+            frame = "W " + fid + " " + std::to_string(body->size());
+            if (!jwt.empty()) frame += " " + jwt;
+            frame += " R\n";
+            frame += *body;
+        } else {
+            frame = "D " + fid;
+            if (!jwt.empty()) frame += " " + jwt;
+            frame += " R\n";
+        }
+        uint32_t status = 0;
+        if (!fwd_request(addr, frame, &status)) return 307;
+        if (status == 307) return 307;
+        if (status != 0) return 500;
+    }
+    return 0;
 }
 
 std::string json_write_reply(int64_t size, uint32_t crc) {
@@ -1012,11 +1533,25 @@ std::string json_write_reply(int64_t size, uint32_t crc) {
 }
 
 Reply handle_write(uint32_t vid, uint64_t nid, uint32_t cookie,
-                   const std::string& body) {
+                   const std::string& body, const std::string& fid,
+                   bool is_replicate, const std::string& jwt) {
     auto v = serving_vol(vid);
     if (!v) return {307, "volume not served natively"};
     if (!v->writable.load() || v->read_only.load() || v->version != 3)
         return {307, "native writes disabled for this volume"};
+    std::string wkey = jwt_key(true);
+    if (!wkey.empty() && !jwt_verify(wkey, jwt, fid, true))
+        return {401, "unauthorized"};
+    int extra = v->extra_copies.load();
+    if (!is_replicate && extra > 0) {
+        // check forwardability BEFORE the local append: if the replica
+        // set is unknown, 307 now and let the Python handler own the
+        // whole replicated write
+        std::shared_lock<std::shared_mutex> lk(g_replica_mu);
+        auto it = g_replicas.find(vid);
+        if (it == g_replicas.end() || (int)it->second.size() < extra)
+            return {307, "replica set not configured"};
+    }
     int64_t dlen = (int64_t)body.size();
     uint32_t crc = crc32c((const uint8_t*)body.data(), (size_t)dlen);
     // v3 needle with data + HAS_LAST_MODIFIED (what the HTTP write path
@@ -1100,9 +1635,9 @@ Reply handle_write(uint32_t vid, uint64_t nid, uint32_t cookie,
                          (size_t)rec_len, end))
             return {500, "append failed"};
         std::unique_lock<std::shared_mutex> mlk(v->nm.mu);
-        v->nm.apply(nid, (uint64_t)end, (int32_t)size);
         if (!append_idx_entry(v.get(), nid, (uint64_t)end, (int32_t)size))
             return {500, "idx append failed"};
+        v->nm.apply(nid, (uint64_t)end, (int32_t)size);
         ticket = ++v->fs_seq;
     }
     if (append_ns > v->last_append_ns.load())
@@ -1111,20 +1646,53 @@ Reply handle_write(uint32_t vid, uint64_t nid, uint32_t cookie,
         v->last_modified_ts.store(lastmod);
     if (v->do_fsync.load() && !v->fsync_ticket(ticket))
         return {500, "fsync failed"};
+    if (!is_replicate && extra > 0) {
+        uint32_t st = forward_to_replicas(vid, fid, &body, jwt, extra);
+        if (st == 307)
+            // local copy stands; the Python retry dedups it
+            // (isFileUnchanged) and runs its own fan-out
+            return {307, "replica fan-out unavailable"};
+        if (st != 0) return {500, "replica write failed"};
+    }
     return {0, json_write_reply(size, crc)};
 }
 
-Reply handle_delete(uint32_t vid, uint64_t nid, uint32_t cookie) {
+Reply handle_delete(uint32_t vid, uint64_t nid, uint32_t cookie,
+                    const std::string& fid, bool is_replicate,
+                    const std::string& jwt) {
     auto v = serving_vol(vid);
     if (!v) return {307, "volume not served natively"};
     if (!v->writable.load() || v->read_only.load() || v->version != 3)
         return {307, "native writes disabled for this volume"};
+    std::string wkey = jwt_key(true);
+    if (!wkey.empty() && !jwt_verify(wkey, jwt, fid, true))
+        return {401, "unauthorized"};
+    int extra = v->extra_copies.load();
+    if (!is_replicate && extra > 0) {
+        std::shared_lock<std::shared_mutex> lk(g_replica_mu);
+        auto it = g_replicas.find(vid);
+        if (it == g_replicas.end() || (int)it->second.size() < extra)
+            return {307, "replica set not configured"};
+    }
     uint64_t old_off = 0;
     int32_t old_size = 0;
+    bool absent;
     {
         std::shared_lock<std::shared_mutex> lk(v->nm.mu);
-        if (!v->nm.get(nid, &old_off, &old_size) || old_size < 0)
-            return {0, "{\"size\": 0}"};
+        absent = !v->nm.get(nid, &old_off, &old_size) || old_size < 0;
+    }
+    if (absent) {
+        // absent locally — but a replica may still hold it (a
+        // partially-failed earlier fan-out): replicate the delete
+        // unconditionally like the Python handler (_delete_object ->
+        // _replicate) so orphan copies get healed
+        if (!is_replicate && extra > 0) {
+            uint32_t st =
+                forward_to_replicas(vid, fid, nullptr, jwt, extra);
+            if (st == 307) return {307, "replica fan-out unavailable"};
+            if (st != 0) return {500, "replica delete failed"};
+        }
+        return {0, "{\"size\": 0}"};
     }
     // tombstone needle: empty v3 record (volume.py delete_needle)
     uint64_t append_ns = now_unix_ns();
@@ -1147,15 +1715,20 @@ Reply handle_delete(uint32_t vid, uint64_t nid, uint32_t cookie) {
                          (size_t)rec_len, end))
             return {500, "append failed"};
         std::unique_lock<std::shared_mutex> mlk(v->nm.mu);
-        v->nm.apply(nid, 0, kTombstone);
         if (!append_idx_entry(v.get(), nid, (uint64_t)end, kTombstone))
             return {500, "idx append failed"};
+        v->nm.apply(nid, 0, kTombstone);
         ticket = ++v->fs_seq;
     }
     if (append_ns > v->last_append_ns.load())
         v->last_append_ns.store(append_ns);
     if (v->do_fsync.load() && !v->fsync_ticket(ticket))
         return {500, "fsync failed"};
+    if (!is_replicate && extra > 0) {
+        uint32_t st = forward_to_replicas(vid, fid, nullptr, jwt, extra);
+        if (st == 307) return {307, "replica fan-out unavailable"};
+        if (st != 0) return {500, "replica delete failed"};
+    }
     char out[48];
     snprintf(out, sizeof(out), "{\"size\": %d}", old_size);
     return {0, out};
@@ -1221,7 +1794,19 @@ std::string assign_take(int64_t count) {
         out += fid;
         out += "\", \"url\": \"" + lease->url + "\", \"publicUrl\": \"" +
                lease->public_url + "\", \"count\": " +
-               std::to_string(count) + "}";
+               std::to_string(count);
+        // JWT-secured clusters: mint the fid-scoped write token the
+        // master would have attached (/dir/assign "auth" field)
+        std::string wkey = jwt_key(true);
+        if (!wkey.empty()) {
+            int exp;
+            {
+                std::lock_guard<std::mutex> jlk(g_jwt_mu);
+                exp = g_jwt_expire_s;
+            }
+            out += ", \"auth\": \"" + jwt_mint(wkey, fid, exp) + "\"";
+        }
+        out += "}";
         return out;
     }
     return "";
@@ -1270,11 +1855,31 @@ bool send_http_reply(int fd, int status, const char* reason,
     return true;
 }
 
+// Percent-escape control characters in a client-supplied request target
+// before echoing it into a Location header — a bare CR/LF (or any
+// control byte) in the target must never become header structure.
+std::string sanitize_target(const std::string& target) {
+    std::string out;
+    out.reserve(target.size());
+    for (unsigned char c : target) {
+        if (c < 0x21 || c == 0x7f) {
+            char esc[4];
+            snprintf(esc, sizeof(esc), "%%%02X", c);
+            out += esc;
+        } else {
+            out += (char)c;
+        }
+    }
+    return out;
+}
+
 // Handle one HTTP request whose request line is already parsed off
 // `buf` (headers still pending).  Returns false to drop the connection.
 bool serve_http_request(Server* srv, int fd, const std::string& method,
-                        const std::string& target, std::string& buf) {
-    // drain headers until the blank line
+                        const std::string& raw_target, std::string& buf) {
+    // drain headers until the blank line; keep the bearer token in case
+    // the cluster signs reads
+    std::string auth_jwt;
     for (;;) {
         size_t nl;
         while ((nl = buf.find('\n')) == std::string::npos) {
@@ -1285,8 +1890,16 @@ bool serve_http_request(Server* srv, int fd, const std::string& method,
         buf.erase(0, nl + 1);
         if (!line.empty() && line.back() == '\r') line.pop_back();
         if (line.empty()) break;
+        if (line.size() > 15 &&
+            strncasecmp(line.c_str(), "authorization:", 14) == 0) {
+            size_t p = 14;
+            while (p < line.size() && line[p] == ' ') p++;
+            if (strncasecmp(line.c_str() + p, "bearer ", 7) == 0)
+                auth_jwt = line.substr(p + 7);
+        }
     }
     bool head = (method == "HEAD");
+    const std::string target = sanitize_target(raw_target);
     std::string path = target;
     size_t q = path.find('?');
     bool has_query = q != std::string::npos;
@@ -1306,6 +1919,12 @@ bool serve_http_request(Server* srv, int fd, const std::string& method,
         return send_http_reply(
             fd, 302, "Found", "", head,
             "Location: http://" + g_http_redirect + target + "\r\n");
+    }
+    std::string rkey = jwt_key(false);
+    if (!rkey.empty() && !jwt_verify(rkey, auth_jwt, fid, false)) {
+        count_reply(401);
+        return send_http_reply(fd, 401, "Unauthorized", "unauthorized",
+                               head, "");
     }
     Reply r = handle_read(vid, nid, cookie);
     count_reply(r.status);
@@ -1408,6 +2027,16 @@ void serve_conn(Server* srv, int fd) {
                     if (!send_reply(fd, 400, "bad fid")) goto done;
                     continue;
                 }
+                std::string rkey = jwt_key(false);
+                if (!rkey.empty() &&
+                    !jwt_verify(rkey,
+                                parts.size() == 3 ? parts[2] : "",
+                                parts[1], false)) {
+                    g_stat_reads.fetch_add(1);
+                    count_reply(401);
+                    if (!send_reply(fd, 401, "unauthorized")) goto done;
+                    continue;
+                }
                 bool was_ec = false;
                 Reply r = handle_read(vid, nid, cookie, &was_ec);
                 // exactly one type per request: framed reads split into
@@ -1415,7 +2044,8 @@ void serve_conn(Server* srv, int fd) {
                 (was_ec ? g_stat_ec_reads : g_stat_reads).fetch_add(1);
                 count_reply(r.status);
                 if (!send_reply(fd, r.status, r.payload)) goto done;
-            } else if (op == "W" && parts.size() == 3) {
+            } else if (op == "W" && parts.size() >= 3
+                       && parts.size() <= 5) {
                 errno = 0;
                 long long blen = strtoll(parts[2].c_str(), nullptr, 10);
                 if (errno || blen < 0 || blen > INT32_MAX) {
@@ -1434,8 +2064,17 @@ void serve_conn(Server* srv, int fd) {
                     if (!send_reply(fd, 400, "bad fid")) goto done;
                     continue;
                 }
+                // optional trailing tokens: a write JWT and/or the
+                // replicate marker "R" (a JWT always contains '.')
+                std::string jwt;
+                bool is_replicate = false;
+                for (size_t t = 3; t < parts.size(); t++) {
+                    if (parts[t] == "R") is_replicate = true;
+                    else if (parts[t] != "-") jwt = parts[t];
+                }
                 g_stat_writes.fetch_add(1);
-                Reply r = handle_write(vid, nid, cookie, body);
+                Reply r = handle_write(vid, nid, cookie, body, parts[1],
+                                       is_replicate, jwt);
                 count_reply(r.status);
                 if (!send_reply(fd, r.status, r.payload)) goto done;
             } else if (op == "A" && parts.size() <= 2) {
@@ -1456,13 +2095,21 @@ void serve_conn(Server* srv, int fd) {
                     continue;
                 }
                 if (!send_reply(fd, 0, out)) goto done;
-            } else if (op == "D" && parts.size() == 2) {
+            } else if (op == "D" && parts.size() >= 2
+                       && parts.size() <= 4) {
                 g_stat_deletes.fetch_add(1);
                 if (!parse_fid(parts[1], &vid, &nid, &cookie)) {
                     if (!send_reply(fd, 400, "bad fid")) goto done;
                     continue;
                 }
-                Reply r = handle_delete(vid, nid, cookie);
+                std::string jwt;
+                bool is_replicate = false;
+                for (size_t t = 2; t < parts.size(); t++) {
+                    if (parts[t] == "R") is_replicate = true;
+                    else if (parts[t] != "-") jwt = parts[t];
+                }
+                Reply r = handle_delete(vid, nid, cookie, parts[1],
+                                        is_replicate, jwt);
                 count_reply(r.status);
                 if (!send_reply(fd, r.status, r.payload)) goto done;
             } else {
@@ -1556,8 +2203,21 @@ int svn_server_start(const char* host, int port) {
     sockaddr_in addr{};
     addr.sin_family = AF_INET;
     addr.sin_port = htons((uint16_t)port);
-    if (inet_pton(AF_INET, host, &addr.sin_addr) != 1)
-        addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+    if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+        // hostname (e.g. "localhost", a configured DNS name): resolve it
+        // rather than silently binding loopback and advertising a port
+        // nobody can reach
+        struct addrinfo hints {};
+        hints.ai_family = AF_INET;
+        hints.ai_socktype = SOCK_STREAM;
+        struct addrinfo* res = nullptr;
+        if (getaddrinfo(host, nullptr, &hints, &res) != 0 || !res) {
+            close(fd);
+            return -EADDRNOTAVAIL;
+        }
+        addr.sin_addr = ((sockaddr_in*)res->ai_addr)->sin_addr;
+        freeaddrinfo(res);
+    }
     if (bind(fd, (sockaddr*)&addr, sizeof(addr)) != 0) {
         // requested port taken: fall back to ephemeral (clients discover
         // the real port via /admin/status, volume_server/server.py)
@@ -1776,10 +2436,13 @@ double svn_bench(const char* host, int port, int op, const char* fids,
                         if (it == vol_conns.end()) {
                             ok = false;
                         } else {
+                            std::string auth = json_field(assign, "auth");
                             std::string wreq =
                                 "W " + fid + " " +
-                                std::to_string(payload.size()) + "\n" +
-                                payload;
+                                std::to_string(payload.size());
+                            if (!auth.empty()) wreq += " " + auth;
+                            wreq += "\n";
+                            wreq += payload;
                             if (!framed(it->second, vol_bufs[url], wreq,
                                         &st, nullptr)) {
                                 // dead volume conn: drop it so the next
@@ -1807,20 +2470,32 @@ double svn_bench(const char* host, int port, int op, const char* fids,
                                         // workers drain the slots
                 continue;
             }
-            const std::string& fid =
+            const std::string& entry =
                 (op == 'W') ? fid_list[(size_t)(slot % nfids)]
                             : fid_list[rng() % fid_list.size()];
+            // a list entry may carry a per-fid token: "fid jwt"
+            // (JWT-secured clusters; the Python driver joins them)
+            size_t sp = entry.find(' ');
+            std::string fid = entry.substr(0, sp);
+            std::string tok =
+                sp == std::string::npos ? "" : entry.substr(sp + 1);
             req.clear();
             auto t0 = std::chrono::steady_clock::now();
             if (op == 'W') {
-                req = "W " + fid + " " + std::to_string(payload.size()) +
-                      "\n" + payload;
+                req = "W " + fid + " " + std::to_string(payload.size());
+                if (!tok.empty()) req += " " + tok;
+                req += "\n";
+                req += payload;
             } else if (op == 'D') {
-                req = "D " + fid + "\n";
+                req = "D " + fid;
+                if (!tok.empty()) req += " " + tok;
+                req += "\n";
             } else if (op == 'H') {  // HTTP GET against the same port
                 req = "GET /" + fid + " HTTP/1.1\r\nHost: bench\r\n\r\n";
             } else {
-                req = "G " + fid + "\n";
+                req = "G " + fid;
+                if (!tok.empty()) req += " " + tok;
+                req += "\n";
             }
             size_t sent = 0;
             bool ok = true;

@@ -197,13 +197,22 @@ def _run_full_native(master_address: str, num_files: int, file_size: int,
 def _run_native(master_address: str, num_files: int, file_size: int,
                 concurrency: int, delete_percent: int, replication: str,
                 do_read: bool, quiet: bool, assign_batch: int,
-                http_phase: bool = False):
+                http_phase: bool = False, pre_phase_hook=None):
     """Native-engine benchmark: the load generator is the C++ driver in
     native/vol_native.cpp (like the reference's compiled Go benchmark
     client), hitting the volume server's native fast-path port.  File ids
     are assigned from the master in batches via /dir/assign?count=N (the
     reference's Assign count parameter, operation/assign_file_id.go) and
-    expanded with the fid "_delta" convention."""
+    expanded with the fid "_delta" convention.
+
+    JWT-secured clusters: assign replies carry fid-scoped tokens that
+    ride with each fid; the cluster's jwt.signing expires_after_seconds
+    must outlive the whole write phase (the harness uses 3600 s), since
+    every token is minted during the up-front assign loop.
+
+    pre_phase_hook(by_server): called after assigns, before the write
+    phase — e.g. to wait for replica-set propagation on replicated
+    volumes so the native plane serves the writes rather than 307ing."""
     from .storage import native_engine
     from .wdclient.volume_tcp_client import VolumeTcpClient
 
@@ -219,9 +228,13 @@ def _run_native(master_address: str, num_files: int, file_size: int,
         a = call(master_address,
                  f"/dir/assign?replication={replication}&count={k}")
         fid = a["fid"]
+        # JWT clusters: carry the assign's token with each fid ("fid jwt"
+        # entries; the C++ driver appends it to the framed request line —
+        # one batch token authorizes fid and its _N variants)
+        suffix = f" {a['auth']}" if a.get("auth") else ""
         group = by_server.setdefault(a["url"], [])
-        group.append(fid)
-        group.extend(f"{fid}_{i}" for i in range(1, k))
+        group.append(fid + suffix)
+        group.extend(f"{fid}_{i}{suffix}" for i in range(1, k))
         remaining -= k
     assign_seconds = time.perf_counter() - t_assign0
 
@@ -250,6 +263,8 @@ def _run_native(master_address: str, num_files: int, file_size: int,
             result.seconds = max(result.seconds, secs)
             result.latencies_ms.extend(lat.tolist())
 
+    if pre_phase_hook is not None:
+        pre_phase_hook(by_server)
     run_phase("W", write, file_size)
 
     read = BenchResult()

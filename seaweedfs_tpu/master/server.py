@@ -117,9 +117,13 @@ class MasterServer:
         ranges.  Opt-in (-tcp), like the volume fast path."""
         from ..storage import native_engine
 
-        if (not native_engine.available() or self.guard.signing
-                or self.default_replication != "000"):
+        if not native_engine.available():
             return
+        if self.guard.signing:
+            # the 'A' handler mints fid-scoped write tokens itself
+            native_engine.server_set_jwt(
+                self.guard.signing.key, b"",
+                self.guard.signing.expires_after_seconds)
         host, port = self.server.address.rsplit(":", 1)
         wanted = int(port) + 20000
         if native_engine.server_port() <= 0:
@@ -148,7 +152,10 @@ class MasterServer:
         # LOW keeps several leases outstanding so a burst cannot drain
         # the pool between 0.2 s refill ticks (a drought answers 503)
         LEASE, LOW, REFRESH_MS = 8192, 32768, 10_000
-        rp = ReplicaPlacement.parse("000")
+        # leases follow the master's default placement: replicated
+        # volumes are fine — the volume server's native engine fans the
+        # leased writes out (or 307s them to its Python handler)
+        rp = ReplicaPlacement.parse(self.default_replication)
         rp_byte = rp.to_byte()
         while not self._stop.wait(0.2):
             if not self.raft.is_leader:

@@ -86,6 +86,11 @@ def lib() -> Optional[ctypes.CDLL]:
     cdll.svn_ec_serve.argtypes = [_u32, _i64]
     cdll.svn_ec_unregister.argtypes = [_i64]
     cdll.svn_ec_refresh.argtypes = [_i64]
+    cdll.svn_set_ttl.argtypes = [_i64, _i64]
+    cdll.svn_set_replication.argtypes = [_i64, ctypes.c_int]
+    cdll.svn_set_replicas.argtypes = [_u32, ctypes.c_char_p]
+    cdll.svn_server_set_jwt.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
+                                        ctypes.c_int]
     cdll.svn_server_start.restype = ctypes.c_int
     cdll.svn_server_start.argtypes = [ctypes.c_char_p, ctypes.c_int]
     cdll.svn_server_set_redirect.argtypes = [ctypes.c_char_p]
@@ -125,7 +130,8 @@ class NativeNeedleMap:
     kind = "native"
 
     def __init__(self, dat_path: str, idx_path: str, version: int,
-                 writable: bool, read_only: bool, fsync: bool):
+                 writable: bool, read_only: bool, fsync: bool,
+                 ttl_sec: int = 0, extra_copies: int = 0):
         self._lib = lib()
         if self._lib is None:
             raise RuntimeError("native engine unavailable")
@@ -136,6 +142,10 @@ class NativeNeedleMap:
         if h <= 0:
             raise OSError(-h, f"svn_register({dat_path!r}) failed")
         self.handle = h
+        if ttl_sec:
+            self._lib.svn_set_ttl(h, int(ttl_sec))
+        if extra_copies:
+            self._lib.svn_set_replication(h, int(extra_copies))
 
     # -- mutate --------------------------------------------------------------
     def put(self, nid: int, offset: int, size: int):
@@ -144,12 +154,18 @@ class NativeNeedleMap:
     def put_if_newer(self, nid: int, offset: int, size: int) -> bool:
         """Atomic form of the write path's "newer offset wins" guard
         (volume_write.go:160-165): evaluated under the engine's map lock
-        so a racing native-port write cannot be clobbered."""
-        return self._lib.svn_nm_put_if_newer(
-            self.handle, nid, offset, size) == 1
+        so a racing native-port write cannot be clobbered.  Raises
+        OSError when the .idx append failed (ENOSPC/EIO) — the write
+        must fail before it is acknowledged, not vanish on restart."""
+        rc = self._lib.svn_nm_put_if_newer(self.handle, nid, offset, size)
+        if rc < 0:
+            raise OSError(-rc, "idx append failed")
+        return rc == 1
 
     def delete(self, nid: int, offset: int):
-        self._lib.svn_nm_delete(self.handle, nid, offset)
+        rc = self._lib.svn_nm_delete(self.handle, nid, offset)
+        if rc < 0:
+            raise OSError(-rc, "idx append failed")
 
     def set_in_memory(self, nid: int, offset: int, size: int):
         self._lib.svn_nm_set_memory(self.handle, nid, offset, size)
@@ -333,6 +349,27 @@ def server_set_redirect(addr: str):
     cdll = lib()
     if cdll is not None:
         cdll.svn_server_set_redirect(addr.encode())
+
+
+def server_set_jwt(write_key: str | bytes = "", read_key: str | bytes = "",
+                   expire_s: int = 10):
+    """Configure HS256 signing keys for the fast-path port (writes
+    require fid-scoped tokens; reads too when read_key is set).  The
+    'A' assign handler mints matching write tokens."""
+    cdll = lib()
+    if cdll is None:
+        return
+    wk = write_key.encode() if isinstance(write_key, str) else bytes(write_key)
+    rk = read_key.encode() if isinstance(read_key, str) else bytes(read_key)
+    cdll.svn_server_set_jwt(wk, rk, int(expire_s))
+
+
+def set_replicas(vid: int, addrs: list[str]):
+    """Publish vid's peer fast-path addresses for native write fan-out
+    (empty list clears)."""
+    cdll = lib()
+    if cdll is not None:
+        cdll.svn_set_replicas(vid, ",".join(addrs).encode())
 
 
 def server_start(host: str, port: int, http_redirect: str = "") -> int:

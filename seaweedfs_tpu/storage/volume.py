@@ -194,10 +194,12 @@ class Volume:
         return self.data.append(blob)
 
     def _native_writable(self) -> bool:
-        """Whether the native fast path may write this volume directly
-        (no replication fan-out or TTL logic to bypass)."""
-        return (self.super_block.replica_placement.copy_count() == 1
-                and not self.ttl and self.version == CURRENT_VERSION)
+        """Whether the native fast path may write this volume directly.
+        Replicated and TTL volumes qualify too: the engine fans writes
+        out to the vid's published replica set (svn_set_replicas; 307
+        when unconfigured) and stamps lastModified for the TTL read
+        check, so neither bypasses production semantics."""
+        return self.version == CURRENT_VERSION
 
     # -- load/create ---------------------------------------------------------
     def _load(self, create_if_missing: bool, replica_placement=None,
@@ -261,7 +263,11 @@ class Volume:
             try:
                 return native_engine.NativeNeedleMap(
                     dat, idx_path, self.version, self._native_writable(),
-                    self.read_only, self.fsync)
+                    self.read_only, self.fsync,
+                    ttl_sec=self.ttl.minutes() * 60 if self.ttl else 0,
+                    extra_copies=(
+                        self.super_block.replica_placement.copy_count()
+                        - 1))
             except (OSError, RuntimeError):
                 pass
         kind = ("memory" if self.needle_map_kind == "native"

@@ -257,11 +257,10 @@ class VolumeServer:
         ec_current = {}
         for loc in self.store.locations:
             for vid, v in list(loc.volumes.items()):
-                # TTL volumes stay off the native port: its read path has
-                # no expiry check, so they must 307 to the HTTP handler
-                # (volume.py read_needle expiry, volume_read.go:27-35)
-                if (isinstance(v.nm, native_engine.NativeNeedleMap)
-                        and not v.ttl):
+                # TTL volumes serve natively too: the engine 404s
+                # expired needles itself (svn_set_ttl, set at map
+                # creation — volume_read.go:27-35 semantics)
+                if isinstance(v.nm, native_engine.NativeNeedleMap):
                     current[vid] = v.nm
             for vid, ev in list(loc.ec_volumes.items()):
                 ec_current[vid] = ev
@@ -294,6 +293,61 @@ class VolumeServer:
                 entry.binding.sync_shards(ev)
             native_engine.serve_ec_volume(vid, entry.binding)
         self._native_ec = ec_bound
+        self._sync_native_replicas()
+
+    def _sync_native_replicas(self):
+        """Publish each replicated volume's peer fast-path addresses to
+        the engine so native writes fan out without a 307 round-trip
+        (store_replicate.go:24-141's location set, refreshed from the
+        master's lookup on the heartbeat cadence; resolution failures
+        just leave the vid unpublished — writes fall back to the Python
+        handler's fan-out)."""
+        from ..storage import native_engine
+        from ..wdclient.volume_tcp_client import VolumeTcpClient
+
+        now = time.monotonic()
+        cache = getattr(self, "_replica_sync", None)
+        if cache is None:
+            cache = self._replica_sync = {"at": 0.0, "vids": {},
+                                          "fresh": {}}
+        if now - cache["at"] < max(self.pulse_seconds * 4, 4.0):
+            return
+        cache["at"] = now
+        client = getattr(self, "_replica_tcp", None)
+        if client is None:
+            client = self._replica_tcp = VolumeTcpClient()
+        # bound the heartbeat-path work: unpublished vids first, then
+        # round-robin refresh of published ones every REFRESH seconds,
+        # at most BUDGET lookups per tick (each is a blocking master
+        # round-trip — hundreds of replicated volumes must not stall
+        # the heartbeat thread for seconds)
+        BUDGET, REFRESH = 16, 30.0
+        candidates = []
+        for loc in self.store.locations:
+            for vid, v in list(loc.volumes.items()):
+                extra = v.super_block.replica_placement.copy_count() - 1
+                if extra <= 0 or not isinstance(
+                        v.nm, native_engine.NativeNeedleMap):
+                    continue
+                age = now - cache["fresh"].get(vid, 0.0)
+                if vid not in cache["vids"]:
+                    candidates.append((0.0, vid))  # never resolved
+                elif age >= REFRESH:
+                    candidates.append((-age, vid))  # stalest first
+        candidates.sort()
+        for _, vid in candidates[:BUDGET]:
+            try:
+                lookup = call(self.master_address,
+                              f"/dir/lookup?volumeId={vid}", timeout=5)
+                others = [l["url"] for l in lookup.get("locations", [])
+                          if l["url"] != self.store.url]
+                addrs = [client.tcp_address(u) for u in others]
+            except Exception:
+                continue  # unpublished: native writes 307 for now
+            cache["fresh"][vid] = now
+            if cache["vids"].get(vid) != addrs:
+                native_engine.set_replicas(vid, addrs)
+                cache["vids"][vid] = addrs
 
     # -- TCP fast path (volume_server_tcp, port+20000) -----------------------
     def _start_tcp(self):
@@ -304,8 +358,15 @@ class VolumeServer:
         from ..storage import native_engine
         from ..wdclient.volume_tcp_client import TCP_PORT_OFFSET
 
-        if (native_engine.available() and not self.guard.read_signing
-                and not self.guard.signing):
+        if native_engine.available():
+            # JWT-secured clusters ride the fast path too: the engine
+            # verifies fid-scoped HS256 tokens itself (guard.go:18-50
+            # semantics, security/jwt_auth.py key material)
+            if self.guard.signing or self.guard.read_signing:
+                native_engine.server_set_jwt(
+                    self.guard.signing.key,
+                    self.guard.read_signing.key,
+                    self.guard.signing.expires_after_seconds)
             host, port = self.server.address.rsplit(":", 1)
             wanted = int(port) + TCP_PORT_OFFSET
             bound = native_engine.server_port()

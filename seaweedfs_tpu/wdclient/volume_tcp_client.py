@@ -96,17 +96,26 @@ class VolumeTcpClient:
         return resolved
 
     def _request(self, volume_server_url: str, frame: bytes) -> bytes:
-        pool = self._pool(self.tcp_address(volume_server_url))
-        with pool.use() as conn:
-            conn.sendall(frame)
-            header = _read_exact(conn, 8)
-            status, length = struct.unpack(">II", header)
-            payload = _read_exact(conn, length)
-            if status != 0:
-                raise VolumeTcpError(
-                    payload.decode(errors="replace") or "request failed",
-                    status)
-            return payload
+        try:
+            pool = self._pool(self.tcp_address(volume_server_url))
+            with pool.use() as conn:
+                conn.sendall(frame)
+                header = _read_exact(conn, 8)
+                status, length = struct.unpack(">II", header)
+                payload = _read_exact(conn, length)
+        except OSError as e:
+            # a dead pooled connection often means the server restarted
+            # on a new ephemeral port: drop the cached resolution so the
+            # next call re-probes instead of pinning HTTP-fallback forever
+            with self._lock:
+                self._resolved.pop(volume_server_url, None)
+            raise VolumeTcpError(f"fast path unreachable: {e}", 307) \
+                from None
+        if status != 0:
+            raise VolumeTcpError(
+                payload.decode(errors="replace") or "request failed",
+                status)
+        return payload
 
     def read_needle(self, volume_server_url: str, fid: str,
                     jwt: str = "", http_fallback: bool = True) -> bytes:
@@ -123,26 +132,32 @@ class VolumeTcpClient:
                                        jwt=jwt)
 
     def write_needle(self, volume_server_url: str, fid: str,
-                     data: bytes) -> bytes:
-        """Fast-path write (native engine only); 307 (replicated/TTL
-        volume, no native engine) falls back to the HTTP handler, which
-        owns the replication fan-out."""
-        frame = f"W {fid} {len(data)}\n".encode() + data
+                     data: bytes, jwt: str = "") -> bytes:
+        """Fast-path write (native engine only; JWT-secured clusters
+        pass the assign's fid-scoped token).  307 (no native engine,
+        replica set unpublished, vacuum window) falls back to the HTTP
+        handler, whose fan-out + identical-rewrite dedup keep a
+        partially-forwarded native attempt consistent."""
+        line = f"W {fid} {len(data)} {jwt}\n" if jwt \
+            else f"W {fid} {len(data)}\n"
         try:
-            return self._request(volume_server_url, frame)
+            return self._request(volume_server_url, line.encode() + data)
         except VolumeTcpError as e:
             if e.status != 307:
                 raise
             return self._http_fallback(volume_server_url, fid, "POST",
-                                       body=data)
+                                       body=data, jwt=jwt)
 
-    def delete_needle(self, volume_server_url: str, fid: str) -> bytes:
+    def delete_needle(self, volume_server_url: str, fid: str,
+                      jwt: str = "") -> bytes:
+        line = f"D {fid} {jwt}\n" if jwt else f"D {fid}\n"
         try:
-            return self._request(volume_server_url, f"D {fid}\n".encode())
+            return self._request(volume_server_url, line.encode())
         except VolumeTcpError as e:
             if e.status != 307:
                 raise
-            return self._http_fallback(volume_server_url, fid, "DELETE")
+            return self._http_fallback(volume_server_url, fid, "DELETE",
+                                       jwt=jwt)
 
     def _http_fallback(self, url: str, fid: str, method: str,
                        body: Optional[bytes] = None, jwt: str = "") -> bytes:

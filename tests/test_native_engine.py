@@ -275,15 +275,19 @@ class TestNativeServer:
                 == b"body%02d" % i
         v2.close()
 
-    def test_replicated_volume_rejects_native_writes(self, tmp_path,
-                                                     native_server):
+    def test_replicated_volume_without_replica_set_307s(self, tmp_path,
+                                                        native_server):
+        """A replicated volume whose peer fast-path addresses have not
+        been published (svn_set_replicas) must 307 writes to the Python
+        handler, which owns the fan-out; it must never take a write it
+        cannot replicate."""
         from seaweedfs_tpu.storage.super_block import ReplicaPlacement
 
         v = Volume(str(tmp_path), "", 6,
                    replica_placement=ReplicaPlacement.parse("001"))
         ne.serve_volume(6, v.nm)
         st, _ = raw_request(native_server, b"W 6,1aabbccdd 2\nno")
-        assert st == 307  # fan-out must go through the HTTP handler
+        assert st == 307  # replica set unpublished
         # reads are still served natively
         n = Needle.create(b"replica read")
         n.id, n.cookie = 0x9, 0xAABBCCDD
@@ -398,24 +402,39 @@ class TestVolumeServerIntegration:
         finally:
             client.close()
 
-    def test_ttl_volume_not_served_natively(self, cluster):
-        """TTL volumes must 307 off the native port (its read path has
-        no expiry check); the TCP client transparently falls back to the
-        HTTP handler, which enforces expiry."""
+    def test_ttl_volume_served_natively(self, cluster):
+        """TTL volumes ride the native port: the engine itself 404s
+        expired needles (svn_set_ttl; volume_read.go:27-35), so a live
+        needle serves natively without a 307 round-trip."""
         master, vs = cluster
         if not getattr(vs, "_native_owner", False):
             pytest.skip("another test holds the process-wide native port")
         a = call(master.address, "/dir/assign?ttl=5m")
         call(a["url"], f"/{a['fid']}", raw=b"expiring", method="POST")
-        vs.heartbeat_once()  # resync bindings: TTL vid must be excluded
+        vs.heartbeat_once()  # resync bindings: TTL vid is included now
         vid = int(a["fid"].split(",")[0])
-        assert vid not in getattr(vs, "_native_bound", set())
-        client = VolumeTcpClient()
-        try:
-            # served via the 307 -> HTTP fallback, not the native path
-            assert client.read_needle(a["url"], a["fid"]) == b"expiring"
-        finally:
-            client.close()
+        assert vid in getattr(vs, "_native_bound", set())
+        st, body = raw_request(vs.tcp_port, f"G {a['fid']}\n".encode())
+        assert (st, body) == (0, b"expiring")
+
+    def test_ttl_expiry_404s_on_native_port(self, tmp_path,
+                                            native_server):
+        """An expired needle answers 404 straight from the engine: write
+        through a 1-second-TTL native map, then age past the TTL."""
+        v = Volume(str(tmp_path), "", 41)
+        # rebind the map with a 1 s TTL (TTL.parse's floor is 1 minute —
+        # too slow for a test)
+        ne.lib().svn_set_ttl(v.nm.handle, 1)
+        ne.serve_volume(41, v.nm)
+        st, _ = raw_request(native_server, b"W 41,7aabbccdd 7\nexpires")
+        assert st == 0
+        st, body = raw_request(native_server, b"G 41,7aabbccdd\n")
+        assert (st, body) == (0, b"expires")
+        time.sleep(2.1)
+        st, _ = raw_request(native_server, b"G 41,7aabbccdd\n")
+        assert st == 404
+        ne.unserve_volume(41)
+        v.close()
 
     def test_compressed_needle_served_plain(self, cluster):
         """Store-side gzipped needles (gzippable name, HTTP write) must
@@ -672,3 +691,170 @@ class TestVolumeServerIntegration:
         assert w.requests == 300 and w.errors == 0
         assert r.requests == 300 and r.errors == 0
         assert len(w.latencies_ms) == 300
+
+
+class TestNativeJwt:
+    """HS256 JWT verification/minting in the engine must interoperate
+    byte-for-byte with security/jwt_auth.py (the reference's
+    weed/security/jwt.go semantics)."""
+
+    def test_write_requires_valid_token(self, tmp_path, native_server):
+        from seaweedfs_tpu.security.jwt_auth import SigningKey, gen_write_jwt
+
+        key = "native-secret"
+        ne.server_set_jwt(key, "", 30)
+        try:
+            v = Volume(str(tmp_path), "", 51)
+            ne.serve_volume(51, v.nm)
+            fid = "51,3aabbccdd"
+            # no token -> 401; garbage token -> 401
+            st, _ = raw_request(native_server, f"W {fid} 2\nhi".encode())
+            assert st == 401
+            st, _ = raw_request(native_server,
+                                f"W {fid} 2 ey.bad.token\nhi".encode())
+            assert st == 401
+            # wrong-fid token -> 401
+            wrong = gen_write_jwt(SigningKey(key, 30), "51,4ffffffff")
+            st, _ = raw_request(native_server,
+                                f"W {fid} 2 {wrong}\nhi".encode())
+            assert st == 401
+            # Python-minted token for this fid -> accepted
+            tok = gen_write_jwt(SigningKey(key, 30), fid)
+            st, body = raw_request(native_server,
+                                   f"W {fid} 2 {tok}\nhi".encode())
+            assert st == 0, body
+            # the _delta convention: a batch token covers fid_N
+            st, _ = raw_request(native_server,
+                                f"W {fid}_2 2 {tok}\nhi".encode())
+            assert st == 0
+            # deletes verify too
+            st, _ = raw_request(native_server, f"D {fid}\n".encode())
+            assert st == 401
+            st, _ = raw_request(native_server,
+                                f"D {fid} {tok}\n".encode())
+            assert st == 0
+            ne.unserve_volume(51)
+            v.close()
+        finally:
+            ne.server_set_jwt("", "", 10)
+
+    def test_expired_token_rejected(self, tmp_path, native_server):
+        from seaweedfs_tpu.security.jwt_auth import encode_jwt
+
+        key = "native-secret"
+        ne.server_set_jwt(key, "", 30)
+        try:
+            v = Volume(str(tmp_path), "", 52)
+            ne.serve_volume(52, v.nm)
+            fid = "52,1aabbccdd"
+            stale = encode_jwt(key.encode(),
+                               {"fid": fid, "exp": int(time.time()) - 5})
+            st, _ = raw_request(native_server,
+                                f"W {fid} 2 {stale}\nhi".encode())
+            assert st == 401
+            ne.unserve_volume(52)
+            v.close()
+        finally:
+            ne.server_set_jwt("", "", 10)
+
+    def test_native_assign_mints_verifiable_token(self, native_server):
+        from seaweedfs_tpu.security.jwt_auth import Guard
+
+        key = "assign-secret"
+        ne.server_set_jwt(key, "", 30)
+        try:
+            ne.assign_add_lease(77, "127.0.0.1:9999", "", 1000, 1100)
+            st, body = raw_request(native_server, b"A\n")
+            assert st == 0
+            reply = json.loads(body)
+            assert reply["auth"]
+            # the Python guard (same security.toml key) must accept it
+            guard = Guard(signing_key=key)
+            guard.verify_write(reply["auth"], reply["fid"])
+            guard.verify_write(reply["auth"], reply["fid"] + "_3")
+            with pytest.raises(PermissionError):
+                guard.verify_write(reply["auth"], "77,9999deadbeef")
+        finally:
+            ne.assign_clear()
+            ne.server_set_jwt("", "", 10)
+
+
+class TestNativeReplication:
+    def test_native_fanout_to_subprocess_replica(self, tmp_path):
+        """End-to-end 001 replication on the native plane: a write to
+        one server's fast-path port must land on BOTH replicas (the
+        engine forwards framed replicate-marked writes to the peer's
+        fast-path port — store_replicate.go:24-141 semantics)."""
+        import os
+        import subprocess
+        import sys
+
+        master = MasterServer(port=0, pulse_seconds=0.2,
+                              default_replication="001")
+        master.start()
+        vs1_dir, vs2_dir = tmp_path / "vs1", tmp_path / "vs2"
+        vs1_dir.mkdir(), vs2_dir.mkdir()
+        vs = VolumeServer([str(vs1_dir)], master.address, port=0,
+                          pulse_seconds=0.2, enable_tcp=True)
+        vs.start()
+        vs.heartbeat_once()
+        if not getattr(vs, "_native_owner", False):
+            vs.stop()
+            master.stop()
+            pytest.skip("another test holds the process-wide native port")
+        # second replica in a subprocess (its own native listener)
+        proc = subprocess.Popen(
+            [sys.executable, "weed.py", "volume", "-dir", str(vs2_dir),
+             "-mserver", master.address, "-port", "0", "-tcp",
+             "-pulseSeconds", "0.2"],
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        try:
+            line = ""
+            for _ in range(200):
+                line = proc.stdout.readline()
+                if "listening on" in line:
+                    break
+            vs2_url = line.split("listening on ")[1].split(",")[0].strip()
+            # wait for both servers to register, then assign a 001 fid
+            deadline = time.time() + 20
+            a = None
+            while time.time() < deadline:
+                try:
+                    a = call(master.address,
+                             "/dir/assign?replication=001")
+                    break
+                except Exception:
+                    time.sleep(0.3)
+            assert a and "fid" in a, f"assign failed: {a}"
+            fid = a["fid"]
+            # drive vs1's native port; retry while the replica set
+            # propagates (heartbeat-cadence lookup in
+            # _sync_native_replicas)
+            st = 307
+            deadline = time.time() + 20
+            while time.time() < deadline:
+                vs.heartbeat_once()
+                st, body = raw_request(
+                    vs.tcp_port, f"W {fid} 9\nreplica-1".encode())
+                if st == 0:
+                    break
+                time.sleep(0.4)
+            assert st == 0, f"native replicated write never engaged: {st}"
+            # both replicas hold the needle (read each server directly)
+            got1 = call(vs.address, f"/{fid}")
+            got2 = call(vs2_url, f"/{fid}")
+            assert got1 == b"replica-1" and got2 == b"replica-1"
+            # delete fans out too
+            st, _ = raw_request(vs.tcp_port, f"D {fid}\n".encode())
+            assert st == 0
+            from seaweedfs_tpu.rpc.http_rpc import RpcError
+
+            for url in (vs.address, vs2_url):
+                with pytest.raises(RpcError):
+                    call(url, f"/{fid}")
+        finally:
+            proc.terminate()
+            proc.wait(timeout=10)
+            vs.stop()
+            master.stop()
