@@ -57,6 +57,15 @@ class Counter(_Metric):
         with self._lock:
             self._values[labels] = self._values.get(labels, 0.0) + amount
 
+    def set_cumulative(self, value: float, labels: tuple = ()):
+        """Adopt an externally-maintained cumulative count (e.g. the
+        C++ engine's off-GIL counters) while keeping counter semantics:
+        the stored value never goes backwards, so rate()/increase()
+        stay correct."""
+        with self._lock:
+            if value >= self._values.get(labels, 0.0):
+                self._values[labels] = float(value)
+
     def expose(self) -> list[str]:
         lines = ["# HELP %s %s" % (self.name, self.help),
                  "# TYPE %s counter" % self.name]
@@ -77,6 +86,9 @@ class _CounterChild:
 
     def inc(self, amount: float = 1.0):
         self._parent.inc(amount, self._labels)
+
+    def set_cumulative(self, value: float):
+        self._parent.set_cumulative(value, self._labels)
 
 
 class Gauge(_Metric):
@@ -265,9 +277,10 @@ VolumeServerRequestCounter = REGISTRY.counter(
 VolumeServerRequestHistogram = REGISTRY.histogram(
     "SeaweedFS_volumeServer_request_seconds", "volume server request latency",
     ("type",))
-# requests served entirely by the native engine (off-GIL; fed from the
-# C++ counters right before each exposition)
-VolumeServerNativeRequestGauge = REGISTRY.gauge(
+# requests served entirely by the native engine (off-GIL; adopted from
+# the C++ cumulative counters right before each exposition — a counter,
+# so Prometheus rate()/increase() type-check)
+VolumeServerNativeRequestCounter = REGISTRY.counter(
     "SeaweedFS_volumeServer_native_request_total",
     "native fast-path requests", ("type",))
 VolumeServerVolumeCounter = REGISTRY.gauge(

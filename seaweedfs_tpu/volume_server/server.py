@@ -482,7 +482,8 @@ class VolumeServer:
             from ..storage import native_engine
 
             for op, n in native_engine.server_stats().items():
-                stats.VolumeServerNativeRequestGauge.labels(op).set(n)
+                stats.VolumeServerNativeRequestCounter.labels(
+                    op).set_cumulative(n)
         return stats.metrics_handler(req)
 
     def heartbeat_once(self):
