@@ -439,6 +439,91 @@ def bench_small_file(num_files: int) -> tuple[float, float, float]:
         shutil.rmtree(workdir, ignore_errors=True)
 
 
+def bench_ec_degraded_read(num_files: int = 3000,
+                           read_reqs: int = 20000
+                           ) -> tuple[float, float]:
+    """Degraded EC reads: write 1 KB needles, ec.encode the volume, then
+    KILL the shards holding the data (delete the files + unmount) and
+    measure the reconstruct-path read rate — every read regenerates its
+    span from 10 local survivors through the parallel-survivor path
+    (ec_volume.py _recover_span; store_ec.go:328-382's
+    recoverOneRemoteEcShardInterval).  This is the latency that matters
+    mid-incident.  Returns (reads/s, p99_ms); zeros when unavailable."""
+    from seaweedfs_tpu.storage import native_engine
+
+    if not native_engine.available():
+        return 0.0, 0.0
+    import tempfile
+
+    from seaweedfs_tpu.master.server import MasterServer
+    from seaweedfs_tpu.rpc.http_rpc import call
+    from seaweedfs_tpu.shell import commands as sh
+    from seaweedfs_tpu.volume_server.server import VolumeServer
+
+    workdir = tempfile.mkdtemp(prefix="swbench_deg_")
+    master = MasterServer(port=0, pulse_seconds=1.0,
+                          volume_size_limit_mb=1024)
+    master.start()
+    vs = VolumeServer([workdir], master.address, port=0,
+                      pulse_seconds=1.0, max_volume_counts=[16],
+                      enable_tcp=True)
+    vs.start()
+    vs.heartbeat_once()
+    try:
+        rng = np.random.default_rng(3)
+        payload = rng.integers(0, 256, 1024, dtype=np.uint8).tobytes()
+        fids = []
+        vid = None
+        for _ in range(num_files):
+            a = call(master.address, "/dir/assign")
+            if vid is None:
+                vid = int(a["fid"].split(",")[0])
+            if int(a["fid"].split(",")[0]) != vid:
+                continue  # keep one volume so the kill set is exact
+            call(a["url"], f"/{a['fid']}", raw=payload, method="POST")
+            fids.append(a["fid"])
+        env = sh.CommandEnv(master.address)
+        sh.ec_encode(env, vid)
+        vs.heartbeat_once()
+        # kill the data shards that hold the needles: num_files KB fits
+        # in the first few 1 MB blocks, i.e. shards 0..ceil(MB)-1; kill
+        # 4 so every read reconstructs from the 10 survivors
+        kill = [0, 1, 2, 3]
+        call(vs.store.url, "/admin/ec/unmount",
+             {"volume": vid, "shard_ids": kill})
+        call(vs.store.url, "/admin/ec/delete_shards",
+             {"volume": vid, "shard_ids": kill})
+        vs.heartbeat_once()
+        # sanity: a read still answers the original bytes
+        got = call(vs.store.url, f"/{fids[0]}")
+        assert got == payload, "degraded read returned wrong bytes"
+
+        import concurrent.futures as cf
+
+        lat: list[float] = []
+        lat_lock = __import__("threading").Lock()
+
+        def one(i: int):
+            fid = fids[i % len(fids)]
+            t0 = time.perf_counter()
+            call(vs.store.url, f"/{fid}")
+            dt = (time.perf_counter() - t0) * 1000.0
+            with lat_lock:
+                lat.append(dt)
+
+        t0 = time.perf_counter()
+        with cf.ThreadPoolExecutor(max_workers=16) as pool:
+            list(pool.map(one, range(read_reqs)))
+        secs = time.perf_counter() - t0
+        lat.sort()
+        p99 = lat[int(len(lat) * 0.99) - 1] if lat else 0.0
+        return read_reqs / secs, p99
+    finally:
+        vs.stop()
+        master.stop()
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
 def bench_small_file_secured(num_files: int) -> tuple[float, float]:
     """Small-file data plane under PRODUCTION configuration: JWT write
     signing + replication 001 — two volume servers (the second in a
@@ -723,6 +808,13 @@ def main():
         print(f"note: secured small-file bench failed: {e}",
               file=sys.stderr)
 
+    # -- degraded EC reads (4 shards dead, reconstruct per read) -------------
+    deg_rps = deg_p99 = 0.0
+    try:
+        deg_rps, deg_p99 = bench_ec_degraded_read()
+    except Exception as e:
+        print(f"note: degraded-read bench failed: {e}", file=sys.stderr)
+
     vs_baseline = hbm_fused / cpu_kernel if cpu_kernel > 0 else 0.0
     print(json.dumps({
         "metric": "rs10_4_batched_encode_fused_throughput",
@@ -767,6 +859,8 @@ def main():
             sf_http_read_rps / 47019.38, 2),
         "smallfile_jwt_repl001_write_rps": round(sec_write_rps, 1),
         "smallfile_jwt_repl001_read_rps": round(sec_read_rps, 1),
+        "ec_degraded_read_rps": round(deg_rps, 1),
+        "ec_degraded_read_p99_ms": round(deg_p99, 2),
         "smallfile_secured_vs_plain_write": (
             round(sec_write_rps / sf_write_rps, 2) if sf_write_rps
             else 0.0),
