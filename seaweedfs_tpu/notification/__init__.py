@@ -54,26 +54,41 @@ class FileQueue(NotificationQueue):
 
 
 class KafkaQueue(NotificationQueue):
-    """notification.kafka sink; requires a kafka client library."""
+    """notification.kafka sink (kafka_queue.go:1-100).  Prefers the
+    kafka-python package; without it, falls back to the in-repo minimal
+    v0-protocol client (notification/kafka_wire.py) — single broker,
+    partition 0 — so the kafka path works and is testable in
+    environments with no kafka client library installed."""
 
     name = "kafka"
 
     def __init__(self, hosts: list[str], topic: str):
+        self.topic = topic
+        self.producer = None
+        self._minimal = None
         try:
             from kafka import KafkaProducer  # type: ignore
-        except ImportError as e:
-            raise RuntimeError(
-                "kafka notification sink needs the kafka-python package, "
-                "which is not installed in this environment") from e
-        self.topic = topic
-        self.producer = KafkaProducer(bootstrap_servers=hosts)
+
+            self.producer = KafkaProducer(bootstrap_servers=hosts)
+        except ImportError:
+            from .kafka_wire import MinimalKafkaClient
+
+            host, _, port = hosts[0].partition(":")
+            self._minimal = MinimalKafkaClient(
+                host, int(port or 9092), topic)
 
     def send(self, key: str, event: dict):
-        self.producer.send(self.topic, key=key.encode(),
-                           value=json.dumps(event).encode())
+        value = json.dumps(event).encode()
+        if self.producer is not None:
+            self.producer.send(self.topic, key=key.encode(), value=value)
+        else:
+            self._minimal.produce(key.encode(), value)
 
     def close(self):
-        self.producer.close()
+        if self.producer is not None:
+            self.producer.close()
+        if self._minimal is not None:
+            self._minimal.close()
 
 
 def load_notification_queue(conf) -> Optional[NotificationQueue]:
@@ -159,35 +174,62 @@ class FileQueueInput(NotificationInput):
 
 
 class KafkaQueueInput(NotificationInput):
-    """Kafka consumer input; requires a kafka client library."""
+    """Kafka consumer input.  Prefers kafka-python; falls back to the
+    in-repo minimal v0-protocol client with the same manual-commit
+    semantics (ack() persists the consumed offset to the broker's
+    group-offset table; a restarted consumer resumes after the last
+    acked message, replaying unacked ones)."""
 
     name = "kafka"
 
     def __init__(self, hosts: list[str], topic: str,
                  group: str = "seaweedfs-replicate"):
+        self.group = group
+        self.consumer = None
+        self._minimal = None
         try:
             from kafka import KafkaConsumer  # type: ignore
-        except ImportError as e:
-            raise RuntimeError(
-                "kafka notification input needs the kafka-python "
-                "package, which is not installed in this environment"
-            ) from e
-        self.consumer = KafkaConsumer(topic, bootstrap_servers=hosts,
-                                      group_id=group,
-                                      enable_auto_commit=False)
+
+            self.consumer = KafkaConsumer(topic, bootstrap_servers=hosts,
+                                          group_id=group,
+                                          enable_auto_commit=False)
+        except ImportError:
+            from .kafka_wire import MinimalKafkaClient
+
+            host, _, port = hosts[0].partition(":")
+            self._minimal = MinimalKafkaClient(
+                host, int(port or 9092), topic)
+            committed = self._minimal.fetch_offset(group)
+            self._next = committed if committed >= 0 else 0
+            self._pending: Optional[int] = None
 
     def receive_message(self) -> Optional[tuple[str, dict]]:
-        batch = self.consumer.poll(timeout_ms=1000, max_records=1)
-        for records in batch.values():
-            for r in records:
-                return (r.key or b"").decode(), json.loads(r.value)
-        return None
+        if self.consumer is not None:
+            batch = self.consumer.poll(timeout_ms=1000, max_records=1)
+            for records in batch.values():
+                for r in records:
+                    return (r.key or b"").decode(), json.loads(r.value)
+            return None
+        msgs = self._minimal.fetch(self._next)
+        if not msgs:
+            return None
+        offset, key, value = msgs[0]
+        self._pending = offset + 1
+        self._next = offset + 1
+        return key.decode(), json.loads(value)
 
     def ack(self):
-        self.consumer.commit()
+        if self.consumer is not None:
+            self.consumer.commit()
+        elif self._pending is not None:
+            self._minimal.commit_offset(self.group, self._pending)
+            self._pending = None
 
     def close(self):
-        self.consumer.close()
+        if self.consumer is not None:
+            self.consumer.close()
+        if self._minimal is not None:
+            self._minimal.close()
 
 
 def load_notification_input(conf) -> Optional[NotificationInput]:
