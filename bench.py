@@ -524,6 +524,88 @@ def bench_ec_degraded_read(num_files: int = 3000,
         shutil.rmtree(workdir, ignore_errors=True)
 
 
+def bench_s3_gateway(num_objects: int = 5000) -> dict:
+    """Small-object data plane through the S3 gateway vs the filer's own
+    HTTP API — the gateway's overhead is auth + XML + key mapping on top
+    of the same save_bytes/read_bytes machinery (object bytes ride the
+    filer's chunk paths, which use the native fast path when available).
+    1 KB objects, keep-alive connections, 8 concurrent workers.
+    Returns {s3_put_rps, s3_get_rps, filer_put_rps, filer_get_rps}."""
+    from seaweedfs_tpu.storage import native_engine  # noqa: F401
+
+    import http.client
+    import tempfile
+    from concurrent.futures import ThreadPoolExecutor
+
+    from seaweedfs_tpu.filer.server import FilerServer
+    from seaweedfs_tpu.master.server import MasterServer
+    from seaweedfs_tpu.s3api.server import S3ApiServer
+    from seaweedfs_tpu.volume_server.server import VolumeServer
+
+    workdir = tempfile.mkdtemp(prefix="swbench_s3_")
+    master = MasterServer(port=0, pulse_seconds=1.0,
+                          volume_size_limit_mb=1024)
+    master.start()
+    vs = VolumeServer([workdir], master.address, port=0,
+                      pulse_seconds=1.0, max_volume_counts=[16],
+                      enable_tcp=True)
+    vs.start()
+    vs.heartbeat_once()
+    filer = FilerServer(master.address, port=0)
+    filer.start()
+    s3 = S3ApiServer(filer, port=0)  # anonymous (no identities)
+    s3.start()
+    payload = b"s" * 1024
+    out = {}
+    try:
+        def phase(address, method, path_of, nreq, body, workers=8):
+            def worker(span):
+                host, port = address.rsplit(":", 1)
+                conn = http.client.HTTPConnection(host, int(port),
+                                                 timeout=30)
+                ok = 0
+                for i in span:
+                    conn.request(method, path_of(i), body=body)
+                    resp = conn.getresponse()
+                    resp.read()
+                    if resp.status in (200, 201, 204):
+                        ok += 1
+                conn.close()
+                return ok
+
+            spans = [range(w, nreq, workers) for w in range(workers)]
+            t0 = time.perf_counter()
+            with ThreadPoolExecutor(max_workers=workers) as pool:
+                oks = sum(pool.map(worker, spans))
+            secs = time.perf_counter() - t0
+            if oks < nreq * 0.99:
+                print(f"note: s3 bench {method} errors: {nreq - oks}",
+                      file=sys.stderr)
+            return oks / secs if secs else 0.0
+
+        # bucket first
+        phase(s3.address, "PUT", lambda i: "/bench", 1, b"")
+        out["s3_put_rps"] = phase(
+            s3.address, "PUT", lambda i: f"/bench/o{i}", num_objects,
+            payload)
+        out["s3_get_rps"] = phase(
+            s3.address, "GET", lambda i: f"/bench/o{i}", num_objects,
+            None)
+        out["filer_put_rps"] = phase(
+            filer.address, "PUT", lambda i: f"/bench2/o{i}", num_objects,
+            payload)
+        out["filer_get_rps"] = phase(
+            filer.address, "GET", lambda i: f"/bench2/o{i}", num_objects,
+            None)
+        return out
+    finally:
+        s3.stop()
+        filer.stop()
+        vs.stop()
+        master.stop()
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
 def bench_small_file_secured(num_files: int) -> tuple[float, float]:
     """Small-file data plane under PRODUCTION configuration: JWT write
     signing + replication 001 — two volume servers (the second in a
@@ -815,6 +897,13 @@ def main():
     except Exception as e:
         print(f"note: degraded-read bench failed: {e}", file=sys.stderr)
 
+    # -- S3 gateway vs filer data plane --------------------------------------
+    s3_stats: dict = {}
+    try:
+        s3_stats = bench_s3_gateway()
+    except Exception as e:
+        print(f"note: s3 bench failed: {e}", file=sys.stderr)
+
     vs_baseline = hbm_fused / cpu_kernel if cpu_kernel > 0 else 0.0
     print(json.dumps({
         "metric": "rs10_4_batched_encode_fused_throughput",
@@ -861,6 +950,13 @@ def main():
         "smallfile_jwt_repl001_read_rps": round(sec_read_rps, 1),
         "ec_degraded_read_rps": round(deg_rps, 1),
         "ec_degraded_read_p99_ms": round(deg_p99, 2),
+        "s3_put_rps": round(s3_stats.get("s3_put_rps", 0.0), 1),
+        "s3_get_rps": round(s3_stats.get("s3_get_rps", 0.0), 1),
+        "filer_put_rps": round(s3_stats.get("filer_put_rps", 0.0), 1),
+        "filer_get_rps": round(s3_stats.get("filer_get_rps", 0.0), 1),
+        "s3_vs_filer_get": (
+            round(s3_stats["s3_get_rps"] / s3_stats["filer_get_rps"], 2)
+            if s3_stats.get("filer_get_rps") else 0.0),
         "smallfile_secured_vs_plain_write": (
             round(sec_write_rps / sf_write_rps, 2) if sf_write_rps
             else 0.0),
