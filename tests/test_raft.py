@@ -43,8 +43,13 @@ def trio(tmp_path):
     for i, p in enumerate(ports):
         d = tmp_path / f"m{i}"
         d.mkdir()
+        # 0.6 s election timeout + generous waits: with the whole
+        # suite sharing the box, scheduler starvation can stall raft
+        # heartbeats for hundreds of ms — margins must absorb that or
+        # this fixture flakes under load (a gate that cries wolf gets
+        # ignored)
         m = MasterServer(port=p, peers=[a for a in addrs],
-                         raft_dir=str(d), raft_election_timeout=0.3,
+                         raft_dir=str(d), raft_election_timeout=0.6,
                          pulse_seconds=1.0)
         m.start()
         masters.append(m)
@@ -73,7 +78,10 @@ class TestRaftElection:
         assert vids == sorted(vids)
         leader.stop()
         rest = [m for m in trio if m is not leader]
-        assert wait_for(lambda: len(leaders(rest)) == 1, timeout=15)
+        # 60 s: failover needs only ~2x election timeout on a quiet box,
+        # but vote splits + starved threads under full-suite load can
+        # chain several rounds
+        assert wait_for(lambda: len(leaders(rest)) == 1, timeout=60)
         new_leader = leaders(rest)[0]
         v6 = new_leader.raft.next_volume_id()
         assert v6 > vids[-1], "allocation must survive failover monotonically"
