@@ -864,14 +864,57 @@ class FilerServer:
                     yield e
 
     def _h_remote_cache(self, req: Request):
-        """Materialise remote objects locally (command_remote_cache.go)."""
+        """Materialise remote objects locally (command_remote_cache.go).
+
+        Large objects are fetched BY THE VOLUME SERVER (the
+        FetchAndWriteNeedle analogue, /admin/remote/fetch_write —
+        volume_grpc_remote.go:16-83): the filer assigns fids and sends
+        the remote conf+location+range; object bytes flow external
+        store -> volume server, never through this process.  Small
+        objects (inline threshold) and volume servers without the RPC
+        fall back to filer-transit."""
         from . import remote_storage as rs
+        from ..storage.types import parse_file_id
 
         directory = req.json()["dir"]
         cached = 0
         for entry in self._walk_remote_entries(directory):
             if entry.chunks or entry.content:
                 continue  # already cached
+            size = int((entry.remote_entry or {}).get("remote_size", 0))
+            # cipher-enabled filers keep the transit path: volumes must
+            # only ever see ciphertext, which the volume server cannot
+            # produce from the plaintext remote object
+            mapped = rs.mapped_location(self.filer, entry.full_path) \
+                if size > INLINE_LIMIT and not self.cipher else None
+            if mapped is not None:
+                _, loc = mapped
+                conf = rs.load_remote_conf(self.filer, loc.name)
+                try:
+                    chunks = []
+                    for off in range(0, size, self.chunk_size):
+                        clen = min(self.chunk_size, size - off)
+                        assign = self._assign()
+                        vid, nid, cookie = parse_file_id(assign["fid"])
+                        up = call(
+                            assign["url"], "/admin/remote/fetch_write",
+                            {"volume": vid, "needle_id": nid,
+                             "cookie": cookie,
+                             "remote_conf": conf.to_dict(),
+                             "remote_location": str(loc),
+                             "offset": off, "size": clen}, timeout=300)
+                        chunks.append(FileChunk(
+                            fid=assign["fid"], offset=off,
+                            size=int(up["size"]),
+                            etag=up.get("eTag", ""),
+                            modified_ts_ns=time.time_ns()))
+                    entry.chunks = chunks
+                    entry.attr.file_size = size
+                    self.filer.create_entry(entry)
+                    cached += 1
+                    continue
+                except RpcError:
+                    pass  # older volume server: filer-transit below
             data = rs.read_through(self.filer, entry)
             entry.attr.file_size = len(data)
             entry.attr.md5 = hashlib.md5(data).hexdigest()

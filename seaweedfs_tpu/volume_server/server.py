@@ -571,6 +571,8 @@ class VolumeServer:
         s.add("POST", "/admin/volume/tier_upload", g(self._h_tier_upload))
         s.add("POST", "/admin/volume/tier_download",
               g(self._h_tier_download))
+        s.add("POST", "/admin/remote/fetch_write",
+              g(self._h_remote_fetch_write))
         s.add("POST", "/admin/leave", g(self._h_leave))
         s.add("POST", "/query", self._h_query)
         s.add("GET", "/metrics", self._h_metrics)
@@ -645,6 +647,34 @@ class VolumeServer:
         self._try_heartbeat()
         return {"volume": v.id, "key": remote.key,
                 "size": remote.file_size}
+
+    def _h_remote_fetch_write(self, req: Request):
+        """FetchAndWriteNeedle (volume_grpc_remote.go:16-83): pull a
+        remote object's byte range from the external store DIRECTLY into
+        a local needle, so remote.cache of large objects never
+        round-trips the bytes through the filer process.  Fans out to
+        the volume's replicas like a normal write."""
+        from ..remote_storage import (RemoteConf, RemoteLocation,
+                                      make_remote_client)
+
+        p = req.json()
+        vid = int(p["volume"])
+        nid = int(p["needle_id"])
+        cookie = int(p["cookie"])
+        self._volume_or_404(vid)
+        client = make_remote_client(RemoteConf.from_dict(p["remote_conf"]))
+        loc = RemoteLocation.parse(p["remote_location"])
+        offset = int(p.get("offset", 0))
+        size = int(p.get("size", -1))
+        data = client.read_range(loc, offset, size) if size >= 0 \
+            else client.read_file(loc)
+        n = Needle.create(data)
+        n.id, n.cookie = nid, cookie
+        self.store.write_needle(vid, n)
+        fid = f"{vid},{nid:x}{cookie:08x}"
+        self._replicate(vid, fid, "POST", data,
+                        {"Content-Type": "application/octet-stream"})
+        return {"size": len(data), "eTag": n.etag()}
 
     def _h_tier_download(self, req: Request):
         """VolumeTierMoveDatFromRemote (volume_grpc_tier_download.go)."""

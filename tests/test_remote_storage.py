@@ -257,3 +257,41 @@ class TestReviewFixes:
         weed.main(args)
         assert not os.path.exists(
             os.path.join(remote_tree, "bkt", "sub", "two.bin"))
+
+
+class TestVolumeServerLocalFetch:
+    """remote.cache of large objects materialises needles ON the volume
+    server (/admin/remote/fetch_write — the FetchAndWriteNeedle analogue,
+    volume_grpc_remote.go:16-83); object bytes must never transit the
+    filer process."""
+
+    def test_cache_bytes_bypass_filer(self, cluster, remote_tree,
+                                      monkeypatch):
+        master, vs, filer, env = cluster
+        rem.remote_configure(env, name="prod", type="local",
+                             directory=remote_tree)
+        rem.remote_mount(env, "/mnt/prod", "prod/bkt")
+
+        # if the filer ever pulls the object bytes itself, fail loudly
+        from seaweedfs_tpu.filer import remote_storage as frs
+
+        def transit_forbidden(*a, **k):
+            raise AssertionError("object bytes transited the filer")
+
+        monkeypatch.setattr(frs, "read_through", transit_forbidden)
+        out = rem.remote_cache(env, "/mnt/prod/photos")
+        assert out["cached"] == 1
+
+        meta = call(filer.address, "/mnt/prod/photos/?metadata=true")
+        entry = meta["Entries"][0]
+        chunks = entry["chunks"]
+        # 2800 bytes over chunk_size=512 -> 6 chunks with exact offsets
+        assert len(chunks) == 6
+        assert [c["offset"] for c in chunks] == [0, 512, 1024, 1536,
+                                                 2048, 2560]
+        # the needles live on the volume server and reassemble exactly
+        for c in chunks:
+            got = call(vs.store.url, f"/{c['fid']}")
+            assert len(got) == c["size"]
+        assert call(filer.address, "/mnt/prod/photos/cat.jpg",
+                    parse=False) == b"meow" * 700
