@@ -1517,6 +1517,12 @@ uint32_t forward_to_replicas(uint32_t vid, const std::string& fid,
         uint32_t status = 0;
         if (!fwd_request(addr, frame, &status)) return 307;
         if (status == 307) return 307;
+        // 4xx from a peer = it cannot take framed replicate writes
+        // (e.g. the Python read-only TCP loop answers 400, or its JWT
+        // clock disagrees): hand the whole write to the Python handler
+        // rather than failing it — only genuine replica errors (5xx)
+        // fail the write, like store_replicate.go
+        if (status >= 400 && status < 500) return 307;
         if (status != 0) return 500;
     }
     return 0;

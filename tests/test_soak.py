@@ -130,3 +130,179 @@ class TestConcurrencySoak:
         for key, body in random.sample(live, min(50, len(live))):
             url, fid = key.rsplit("/", 1)
             assert bytes(call(url, f"/{fid}", parse=False)) == body
+
+
+class TestSecuredReplicatedSoak:
+    """The same interleavings under PRODUCTION configuration: JWT write
+    signing + replication 001, two volume servers (the peer in a
+    subprocess with its own native listener), traffic driven through the
+    fast-path client (framed writes with fid-scoped tokens, native
+    replica fan-out, 307 fallback).  Every read must return the written
+    bytes or a clean 404 after delete, on BOTH replicas."""
+
+    def test_jwt_replicated_write_read_delete(self, tmp_path):
+        import os
+        import subprocess
+        import sys
+        import time
+
+        from seaweedfs_tpu.security import Guard
+        from seaweedfs_tpu.storage import native_engine
+        from seaweedfs_tpu.wdclient.volume_tcp_client import VolumeTcpClient
+
+        if not native_engine.available():
+            pytest.skip("native engine unavailable")
+        key = "soak-secret"
+        conf_dir = tmp_path / "conf"
+        conf_dir.mkdir()
+        (conf_dir / "security.toml").write_text(
+            '[jwt.signing]\nkey = "%s"\nexpires_after_seconds = 300\n'
+            % key)
+        master = MasterServer(port=0, pulse_seconds=0.2,
+                              default_replication="001",
+                              guard=Guard(signing_key=key,
+                                          expires_after_seconds=300))
+        master.start()
+        (tmp_path / "v1").mkdir()
+        vs = VolumeServer([str(tmp_path / "v1")], master.address, port=0,
+                          pulse_seconds=0.2, enable_tcp=True,
+                          guard=Guard(signing_key=key,
+                                      expires_after_seconds=300))
+        vs.start()
+        vs.heartbeat_once()
+        if not getattr(vs, "_native_owner", False):
+            vs.stop()
+            master.stop()
+            pytest.skip("another test holds the process-wide native port")
+        (tmp_path / "v2").mkdir()
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        proc = subprocess.Popen(
+            [sys.executable, os.path.join(repo, "weed.py"), "volume",
+             "-dir", str(tmp_path / "v2"), "-mserver", master.address,
+             "-port", "0", "-tcp", "-pulseSeconds", "0.2"],
+            cwd=str(conf_dir), stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True,
+            env={**os.environ, "PYTHONPATH": repo})
+        client = VolumeTcpClient()
+        try:
+            line = ""
+            for _ in range(200):
+                line = proc.stdout.readline()
+                if "listening on" in line:
+                    break
+            vs2_url = line.split("listening on ")[1].split(",")[0].strip()
+            deadline = time.time() + 20
+            while time.time() < deadline:
+                try:
+                    a = call(master.address, "/dir/assign?replication=001")
+                    if a.get("fid"):
+                        break
+                except Exception:
+                    time.sleep(0.3)
+
+            written: dict[str, bytes] = {}
+            deleted: set[str] = set()
+            lock = threading.Lock()
+            failures: list[str] = []
+            stop = threading.Event()
+
+            def writer(seed: int):
+                rng = random.Random(seed)
+                for i in range(60):
+                    if stop.is_set():
+                        return
+                    body = bytes(rng.randrange(256)
+                                 for _ in range(rng.randrange(10, 1500)))
+                    try:
+                        a = call(master.address,
+                                 "/dir/assign?replication=001")
+                        client.write_needle(a["url"], a["fid"], body,
+                                            jwt=a.get("auth", ""))
+                    except Exception as e:
+                        failures.append(f"write: {e}")
+                        continue
+                    with lock:
+                        written[f"{a['url']}/{a['fid']}"] = body
+                    vs.heartbeat_once()  # replica-set propagation
+
+            def reader(seed: int):
+                rng = random.Random(seed)
+                while not stop.is_set():
+                    with lock:
+                        if not written:
+                            continue
+                        key_, body = rng.choice(list(written.items()))
+                        was_deleted = key_ in deleted
+                    url, fid = key_.rsplit("/", 1)
+                    try:
+                        got = client.read_needle(url, fid)
+                        if bytes(got) != body and not was_deleted:
+                            with lock:
+                                still_live = key_ not in deleted
+                            if still_live:
+                                failures.append(f"corrupt read {fid}")
+                    except Exception as e:
+                        st = getattr(e, "status", 0)
+                        if st != 404:
+                            failures.append(f"read {fid}: {e}")
+                        elif not was_deleted:
+                            with lock:
+                                still_live = key_ not in deleted
+                            if still_live:
+                                failures.append(
+                                    f"missing live needle {fid}")
+
+            def deleter():
+                rng = random.Random(7)
+                from seaweedfs_tpu.security.jwt_auth import (SigningKey,
+                                                             gen_write_jwt)
+
+                signing = SigningKey(key, 300)
+                while not stop.is_set():
+                    with lock:
+                        candidates = [k for k in written
+                                      if k not in deleted]
+                    if len(candidates) > 15:
+                        key_ = rng.choice(candidates)
+                        url, fid = key_.rsplit("/", 1)
+                        with lock:
+                            deleted.add(key_)
+                        try:
+                            client.delete_needle(
+                                url, fid, jwt=gen_write_jwt(signing, fid))
+                        except Exception:
+                            pass
+                    stop.wait(0.02)
+
+            threads = ([threading.Thread(target=writer, args=(i,))
+                        for i in range(3)]
+                       + [threading.Thread(target=reader, args=(50 + i,))
+                          for i in range(2)]
+                       + [threading.Thread(target=deleter)])
+            for t in threads:
+                t.start()
+            for t in threads[:3]:
+                t.join(timeout=180)
+            stop.set()
+            for t in threads[3:]:
+                t.join(timeout=30)
+            assert not failures, failures[:10]
+            assert len(written) >= 150
+            # convergence: every live needle is present with the exact
+            # bytes on BOTH replicas
+            live = [(k, v) for k, v in written.items()
+                    if k not in deleted]
+            for key_, body in random.sample(live, min(30, len(live))):
+                _, fid = key_.rsplit("/", 1)
+                for u in (vs.address, vs2_url):
+                    assert call(u, f"/{fid}", parse=False) == body, \
+                        f"replica divergence {fid} on {u}"
+        finally:
+            client.close()
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+            vs.stop()
+            master.stop()
