@@ -103,6 +103,9 @@ class MasterServer:
             if t is not None:
                 t.join(timeout=5)
             native_engine.assign_clear()
+            if getattr(self, "_native_jwt_owner", False):
+                native_engine.server_set_jwt("", "", 10)
+                self._native_jwt_owner = False
             if self._native_assign_owner:
                 native_engine.server_stop()
             self._native_assign = False
@@ -120,10 +123,12 @@ class MasterServer:
         if not native_engine.available():
             return
         if self.guard.signing:
-            # the 'A' handler mints fid-scoped write tokens itself
+            # the 'A' handler mints fid-scoped write tokens itself; the
+            # keys are engine-global, so clear them on stop
             native_engine.server_set_jwt(
                 self.guard.signing.key, b"",
                 self.guard.signing.expires_after_seconds)
+            self._native_jwt_owner = True
         host, port = self.server.address.rsplit(":", 1)
         wanted = int(port) + 20000
         if native_engine.server_port() <= 0:

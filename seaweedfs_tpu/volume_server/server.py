@@ -222,6 +222,7 @@ class VolumeServer:
     def stop(self):
         self._stop.set()
         if getattr(self, "_native_owner", False) or \
+                getattr(self, "_native_jwt_owner", False) or \
                 getattr(self, "_native_listener_owner", False):
             from ..storage import native_engine
 
@@ -233,6 +234,9 @@ class VolumeServer:
                     entry.binding.close()
                 native_engine.release_serving()
                 self._native_owner = False
+            if getattr(self, "_native_jwt_owner", False):
+                native_engine.server_set_jwt("", "", 10)
+                self._native_jwt_owner = False
             if getattr(self, "_native_listener_owner", False):
                 native_engine.server_stop()
                 self._native_listener_owner = False
@@ -367,6 +371,10 @@ class VolumeServer:
                     self.guard.signing.key,
                     self.guard.read_signing.key,
                     self.guard.signing.expires_after_seconds)
+                # the keys are engine-global: the instance that set them
+                # clears them on stop, or a later unsecured server in
+                # the same process (tests; redeploys) inherits them
+                self._native_jwt_owner = True
             host, port = self.server.address.rsplit(":", 1)
             wanted = int(port) + TCP_PORT_OFFSET
             bound = native_engine.server_port()
