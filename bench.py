@@ -304,7 +304,7 @@ def bench_e2e_default(vol_bytes: int, workdir: str
     base = os.path.join(workdir, "defvol")
     _write_volume(base, vol_bytes, seed=11)
     best, stages = 0.0, {}
-    for _ in range(2):
+    for _ in range(3):
         st: dict = {}
         t0 = time.perf_counter()
         if batched:
@@ -439,8 +439,8 @@ def bench_small_file(num_files: int) -> tuple[float, float, float]:
         shutil.rmtree(workdir, ignore_errors=True)
 
 
-def bench_ec_degraded_read(num_files: int = 3000,
-                           read_reqs: int = 20000
+def bench_ec_degraded_read(num_files: int = 2000,
+                           read_reqs: int = 10000
                            ) -> tuple[float, float]:
     """Degraded EC reads: write 1 KB needles, ec.encode the volume, then
     KILL the shards holding the data (delete the files + unmount) and
@@ -472,15 +472,31 @@ def bench_ec_degraded_read(num_files: int = 3000,
     try:
         rng = np.random.default_rng(3)
         payload = rng.integers(0, 256, 1024, dtype=np.uint8).tobytes()
+
+        from seaweedfs_tpu.rpc.http_rpc import RpcError
+
+        def call_retry(url, path, **kw):
+            # earlier bench stages can leave the (shared) box briefly
+            # catatonic; a transient connect timeout (RpcError 503
+            # "cannot reach") must not kill the whole stage
+            for attempt in range(3):
+                try:
+                    return call(url, path, timeout=60, **kw)
+                except RpcError as e:
+                    if attempt == 2 or e.status != 503:
+                        raise
+                    time.sleep(1.0)
+
         fids = []
         vid = None
         for _ in range(num_files):
-            a = call(master.address, "/dir/assign")
+            a = call_retry(master.address, "/dir/assign")
             if vid is None:
                 vid = int(a["fid"].split(",")[0])
             if int(a["fid"].split(",")[0]) != vid:
                 continue  # keep one volume so the kill set is exact
-            call(a["url"], f"/{a['fid']}", raw=payload, method="POST")
+            call_retry(a["url"], f"/{a['fid']}", raw=payload,
+                       method="POST")
             fids.append(a["fid"])
         env = sh.CommandEnv(master.address)
         sh.ec_encode(env, vid)

@@ -1502,30 +1502,52 @@ uint32_t forward_to_replicas(uint32_t vid, const std::string& fid,
         if (it != g_replicas.end()) addrs = it->second;
     }
     if ((int)addrs.size() < needed) return 307;
-    for (const auto& addr : addrs) {
-        std::string frame;
-        if (body) {
-            frame = "W " + fid + " " + std::to_string(body->size());
-            if (!jwt.empty()) frame += " " + jwt;
-            frame += " R\n";
-            frame += *body;
-        } else {
-            frame = "D " + fid;
-            if (!jwt.empty()) frame += " " + jwt;
-            frame += " R\n";
-        }
-        uint32_t status = 0;
-        if (!fwd_request(addr, frame, &status)) return 307;
-        if (status == 307) return 307;
+    std::string frame;
+    if (body) {
+        frame = "W " + fid + " " + std::to_string(body->size());
+        if (!jwt.empty()) frame += " " + jwt;
+        frame += " R\n";
+        frame += *body;
+    } else {
+        frame = "D " + fid;
+        if (!jwt.empty()) frame += " " + jwt;
+        frame += " R\n";
+    }
+    // one peer: forward inline; several: in parallel like the
+    // reference's per-location goroutines (store_replicate.go:63-100),
+    // so latency is max(peer RTTs) rather than their sum
+    auto classify = [](bool reached, uint32_t status) -> uint32_t {
+        if (!reached || status == 307) return 307;
         // 4xx from a peer = it cannot take framed replicate writes
         // (e.g. the Python read-only TCP loop answers 400, or its JWT
         // clock disagrees): hand the whole write to the Python handler
         // rather than failing it — only genuine replica errors (5xx)
         // fail the write, like store_replicate.go
         if (status >= 400 && status < 500) return 307;
-        if (status != 0) return 500;
+        return status == 0 ? 0 : 500;
+    };
+    if (addrs.size() == 1) {
+        uint32_t status = 0;
+        bool reached = fwd_request(addrs[0], frame, &status);
+        return classify(reached, status);
     }
-    return 0;
+    std::vector<uint32_t> results(addrs.size(), 500);
+    std::vector<std::thread> threads;
+    threads.reserve(addrs.size());
+    for (size_t i = 0; i < addrs.size(); i++) {
+        threads.emplace_back([&, i]() {
+            uint32_t status = 0;
+            bool reached = fwd_request(addrs[i], frame, &status);
+            results[i] = classify(reached, status);
+        });
+    }
+    for (auto& t : threads) t.join();
+    uint32_t worst = 0;
+    for (uint32_t r : results) {
+        if (r == 500) return 500;  // hard replica failure wins
+        if (r != 0) worst = r;     // else any 307 -> fallback
+    }
+    return worst;
 }
 
 std::string json_write_reply(int64_t size, uint32_t crc) {
@@ -1909,7 +1931,31 @@ bool serve_http_request(Server* srv, int fd, const std::string& method,
     std::string path = target;
     size_t q = path.find('?');
     bool has_query = q != std::string::npos;
-    if (has_query) path = path.substr(0, q);
+    if (has_query) {
+        // a bare ?jwt=<token> stays on the fast path (the reference's
+        // query-parameter token convention, security/jwt.go GetJwt);
+        // any other parameter means full-handler semantics -> 302
+        std::string query = path.substr(q + 1);
+        path = path.substr(0, q);
+        bool only_jwt = true;
+        size_t pos = 0;
+        while (pos <= query.size() && only_jwt) {
+            size_t amp = query.find('&', pos);
+            std::string kv = query.substr(
+                pos, amp == std::string::npos ? std::string::npos
+                                              : amp - pos);
+            if (!kv.empty()) {
+                if (kv.rfind("jwt=", 0) == 0) {
+                    if (auth_jwt.empty()) auth_jwt = kv.substr(4);
+                } else {
+                    only_jwt = false;
+                }
+            }
+            if (amp == std::string::npos) break;
+            pos = amp + 1;
+        }
+        has_query = !only_jwt;
+    }
     uint32_t vid;
     uint64_t nid;
     uint32_t cookie;

@@ -890,8 +890,8 @@ class FilerServer:
             if mapped is not None:
                 _, loc = mapped
                 conf = rs.load_remote_conf(self.filer, loc.name)
+                chunks = []
                 try:
-                    chunks = []
                     for off in range(0, size, self.chunk_size):
                         clen = min(self.chunk_size, size - off)
                         assign = self._assign()
@@ -910,11 +910,21 @@ class FilerServer:
                             modified_ts_ns=time.time_ns()))
                     entry.chunks = chunks
                     entry.attr.file_size = size
+                    # no whole-object md5: the bytes never transited
+                    # this process — readers fall back to the chunk
+                    # etags (etag_of_chunks), like any chunked upload
                     self.filer.create_entry(entry)
                     cached += 1
                     continue
                 except RpcError:
-                    pass  # older volume server: filer-transit below
+                    # older volume server / transient failure: reclaim
+                    # the needles already written, then fall back to
+                    # filer-transit for this entry
+                    if chunks:
+                        try:
+                            self._delete_chunks(chunks)
+                        except Exception:
+                            pass
             data = rs.read_through(self.filer, entry)
             entry.attr.file_size = len(data)
             entry.attr.md5 = hashlib.md5(data).hexdigest()

@@ -363,18 +363,6 @@ class VolumeServer:
         from ..wdclient.volume_tcp_client import TCP_PORT_OFFSET
 
         if native_engine.available():
-            # JWT-secured clusters ride the fast path too: the engine
-            # verifies fid-scoped HS256 tokens itself (guard.go:18-50
-            # semantics, security/jwt_auth.py key material)
-            if self.guard.signing or self.guard.read_signing:
-                native_engine.server_set_jwt(
-                    self.guard.signing.key,
-                    self.guard.read_signing.key,
-                    self.guard.signing.expires_after_seconds)
-                # the keys are engine-global: the instance that set them
-                # clears them on stop, or a later unsecured server in
-                # the same process (tests; redeploys) inherits them
-                self._native_jwt_owner = True
             host, port = self.server.address.rsplit(":", 1)
             wanted = int(port) + TCP_PORT_OFFSET
             bound = native_engine.server_port()
@@ -390,6 +378,19 @@ class VolumeServer:
             # master starts it for assign leases); SERVING vids is a
             # separate, single-claim role per process
             if bound > 0 and native_engine.claim_serving():
+                # JWT-secured clusters ride the fast path too: the
+                # engine verifies fid-scoped HS256 tokens itself
+                # (guard.go:18-50 semantics).  Keys are set only AFTER
+                # the serving claim succeeds: a server that did not
+                # engage must neither set nor (on stop) clear the
+                # engine-global keys another in-process server relies
+                # on — clearing them would fail open.
+                if self.guard.signing or self.guard.read_signing:
+                    native_engine.server_set_jwt(
+                        self.guard.signing.key,
+                        self.guard.read_signing.key,
+                        self.guard.signing.expires_after_seconds)
+                    self._native_jwt_owner = True
                 # the listener may predate this volume server (combined
                 # process: the master starts it for assign leases) —
                 # the HTTP 302 fallback must point at OUR full handler

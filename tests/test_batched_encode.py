@@ -315,3 +315,29 @@ class TestScrub:
         final = scrub_ec_volume(str(tmp_path), "", 5)
         assert final["checked"] == list(range(14))
         assert not final["corrupt"] and not final["missing"]
+
+
+def test_host_pipeline_tiny_blocks_iov_cap(tmp_path):
+    """Block sizes small enough that a span would exceed IOV_MAX rows
+    must still encode (pwritev is capped at 1024 iovecs)."""
+    import numpy as np
+
+    from seaweedfs_tpu.ops.crc32c import crc32c
+    from seaweedfs_tpu.parallel.batched_encode import encode_volumes
+    from seaweedfs_tpu.storage.erasure_coding import to_ext
+
+    base = str(tmp_path / "tiny")
+    rng = np.random.default_rng(5)
+    data = rng.integers(0, 256, 2_000_000, dtype=np.uint8)
+    data.tofile(base + ".dat")
+    crcs = encode_volumes([base], large_block=10000, small_block=100,
+                          host_codec=True)[base]
+    ref = str(tmp_path / "tinyref")
+    os.link(base + ".dat", ref + ".dat")
+    ec_encoder.write_ec_files(ref, large_block_size=10000,
+                              small_block_size=100, batched=False)
+    for i in range(14):
+        got = np.fromfile(base + to_ext(i), dtype=np.uint8)
+        want = np.fromfile(ref + to_ext(i), dtype=np.uint8)
+        assert np.array_equal(got, want), f"shard {i}"
+        assert crcs[i] == crc32c(got.tobytes())

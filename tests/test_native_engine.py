@@ -858,3 +858,47 @@ class TestNativeReplication:
             proc.wait(timeout=10)
             vs.stop()
             master.stop()
+
+
+class TestNativeReadJwtQueryParam:
+    def test_http_read_jwt_via_query(self, tmp_path, native_server):
+        """The ?jwt=<token> convention (security/jwt.go GetJwt) stays on
+        the fast path for plain-HTTP reads: valid token -> 200, missing
+        or wrong -> 401, and other query params still 302 to the full
+        handler."""
+        import http.client
+
+        from seaweedfs_tpu.security.jwt_auth import SigningKey, gen_read_jwt
+
+        key = "read-secret"
+        ne.server_set_jwt("", key, 60)
+        try:
+            v = Volume(str(tmp_path), "", 61)
+            n = Needle.create(b"query token read")
+            n.id, n.cookie = 0x5, 0xAABBCC01
+            v.write_needle(n)
+            ne.serve_volume(61, v.nm)
+            fid = "61,5aabbcc01"
+            tok = gen_read_jwt(SigningKey(key, 60), fid)
+
+            def http_get(path):
+                c = http.client.HTTPConnection("127.0.0.1", native_server,
+                                               timeout=10)
+                c.request("GET", path)
+                r = c.getresponse()
+                body = r.read()
+                c.close()
+                return r.status, body
+
+            assert http_get(f"/{fid}?jwt={tok}") == (
+                200, b"query token read")
+            assert http_get(f"/{fid}")[0] == 401
+            wrong = gen_read_jwt(SigningKey(key, 60), "61,9ffffffff")
+            assert http_get(f"/{fid}?jwt={wrong}")[0] == 401
+            # non-jwt params leave the fast path (302 -> full handler)
+            ne.lib().svn_server_set_redirect(b"127.0.0.1:1")
+            assert http_get(f"/{fid}?readDeleted=true")[0] == 302
+            ne.unserve_volume(61)
+            v.close()
+        finally:
+            ne.server_set_jwt("", "", 10)
