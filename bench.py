@@ -441,14 +441,17 @@ def bench_small_file(num_files: int) -> tuple[float, float, float]:
 
 def bench_ec_degraded_read(num_files: int = 2000,
                            read_reqs: int = 10000
-                           ) -> tuple[float, float]:
+                           ) -> tuple[float, float, float]:
     """Degraded EC reads: write 1 KB needles, ec.encode the volume, then
     KILL the shards holding the data (delete the files + unmount) and
     measure the reconstruct-path read rate — every read regenerates its
     span from 10 local survivors through the parallel-survivor path
     (ec_volume.py _recover_span; store_ec.go:328-382's
     recoverOneRemoteEcShardInterval).  This is the latency that matters
-    mid-incident.  Returns (reads/s, p99_ms); zeros when unavailable."""
+    mid-incident.  Also measures the NATIVE port's degraded reads (the
+    engine reconstructs missing spans from 10 local survivors in C++).
+    Returns (http_reads/s, http_p99_ms, native_reads/s); zeros when
+    unavailable."""
     from seaweedfs_tpu.storage import native_engine
 
     if not native_engine.available():
@@ -533,7 +536,20 @@ def bench_ec_degraded_read(num_files: int = 2000,
         secs = time.perf_counter() - t0
         lat.sort()
         p99 = lat[int(len(lat) * 0.99) - 1] if lat else 0.0
-        return read_reqs / secs, p99
+
+        # native-port degraded reads: C++ reconstructs each span from
+        # the 10 local survivors (zero GIL involvement)
+        native_rps = 0.0
+        if getattr(vs, "_native_owner", False) and vs.tcp_port:
+            nsecs, nerrs, _ = native_engine.bench(
+                "127.0.0.1", vs.tcp_port, "R", fids,
+                max(read_reqs, 20000), 0, 16)
+            nreq = max(read_reqs, 20000)
+            if nerrs > nreq * 0.01:
+                print(f"note: native degraded read errors: {nerrs}",
+                      file=sys.stderr)
+            native_rps = (nreq - nerrs) / nsecs if nsecs else 0.0
+        return read_reqs / secs, p99, native_rps
     finally:
         vs.stop()
         master.stop()
@@ -907,9 +923,9 @@ def main():
               file=sys.stderr)
 
     # -- degraded EC reads (4 shards dead, reconstruct per read) -------------
-    deg_rps = deg_p99 = 0.0
+    deg_rps = deg_p99 = deg_native_rps = 0.0
     try:
-        deg_rps, deg_p99 = bench_ec_degraded_read()
+        deg_rps, deg_p99, deg_native_rps = bench_ec_degraded_read()
     except Exception as e:
         print(f"note: degraded-read bench failed: {e}", file=sys.stderr)
 
@@ -966,6 +982,7 @@ def main():
         "smallfile_jwt_repl001_read_rps": round(sec_read_rps, 1),
         "ec_degraded_read_rps": round(deg_rps, 1),
         "ec_degraded_read_p99_ms": round(deg_p99, 2),
+        "ec_degraded_read_native_rps": round(deg_native_rps, 1),
         "s3_put_rps": round(s3_stats.get("s3_put_rps", 0.0), 1),
         "s3_get_rps": round(s3_stats.get("s3_get_rps", 0.0), 1),
         "filer_put_rps": round(s3_stats.get("filer_put_rps", 0.0), 1),

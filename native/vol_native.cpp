@@ -362,10 +362,48 @@ struct NVolume {
 
 using VolPtr = std::shared_ptr<NVolume>;
 
+// GF(2^8)/0x11D multiplication table for degraded-read reconstruction
+// (same construction as ec_native.cpp / ops/gf256.py).
+struct GfMulTables {
+    uint8_t mul[256][256];
+    GfMulTables() {
+        uint8_t exp_t[510];
+        int log_t[256] = {0};
+        int x = 1;
+        for (int i = 0; i < 255; i++) {
+            exp_t[i] = (uint8_t)x;
+            log_t[x] = i;
+            x <<= 1;
+            if (x & 0x100) x ^= 0x11D;
+        }
+        for (int i = 255; i < 510; i++) exp_t[i] = exp_t[i - 255];
+        for (int a = 0; a < 256; a++)
+            for (int b = 0; b < 256; b++)
+                mul[a][b] = (a && b) ? exp_t[log_t[a] + log_t[b]] : 0;
+    }
+};
+
+const uint8_t (*gf_mul())[256] {
+    static const GfMulTables t;
+    return t.mul;
+}
+
+// Per-missing-shard recovery plan: reconstruct its bytes at any offset
+// as XOR_j mul(coeffs[j], survivor_j bytes at the SAME offset) — the
+// one-matmul survivor->missing row the daemon derives with
+// rebuild_matrix (RS parity is columnwise, so spans align).
+struct EcRecovery {
+    uint8_t survivors[10];
+    uint8_t coeffs[10];
+};
+
 // EC volume handle: sorted .ecx + local shard files.  Serves reads whose
-// intervals all hit local shards; anything else answers 307 and the
-// client falls back to the HTTP ladder (local -> remote -> reconstruct,
-// store_ec.go:125-163).  Writes/deletes to EC volumes stay in Python.
+// intervals all hit local shards; a missing shard's span reconstructs
+// on the fly from 10 local survivors when the daemon pushed a recovery
+// plan (native degraded reads — recoverOneRemoteEcShardInterval,
+// store_ec.go:328-382, minus the remote fetches); anything else answers
+// 307 and the client falls back to the HTTP ladder (local -> remote ->
+// reconstruct, store_ec.go:125-163).  Writes/deletes stay in Python.
 struct NEcVolume {
     int ecx_fd = -1;
     std::atomic<int64_t> ecx_entries{0};
@@ -379,8 +417,17 @@ struct NEcVolume {
     std::atomic<int> shard_fds[14];
     std::mutex retired_mu;
     std::vector<int> retired;
+    mutable std::shared_mutex recovery_mu;
+    std::unique_ptr<EcRecovery> recovery[14];
     NEcVolume() {
         for (int i = 0; i < 14; i++) shard_fds[i].store(-1);
+    }
+    // copy of shard sid's recovery plan, or false when none is set
+    bool get_recovery(int sid, EcRecovery* out) const {
+        std::shared_lock<std::shared_mutex> lk(recovery_mu);
+        if (!recovery[sid]) return false;
+        *out = *recovery[sid];
+        return true;
     }
     void retire(int fd) {
         if (fd < 0) return;
@@ -878,6 +925,31 @@ int svn_ec_unregister(int64_t handle) {
 
 // Refresh the cached .ecx entry count (the file grows only on rebuild;
 // deletes rewrite size fields in place, which preads observe directly)
+// Install (n=10) or clear (n=0) shard_id's degraded-read recovery plan:
+// `survivors` are 10 shard ids whose same-offset bytes, combined with
+// `coeffs` under GF(2^8), reproduce shard_id's bytes.  The daemon
+// derives the row with rebuild_matrix at shard-sync time.
+int svn_ec_set_recovery(int64_t handle, int shard_id,
+                        const uint8_t* survivors, const uint8_t* coeffs,
+                        int n) {
+    std::shared_lock<std::shared_mutex> rlk(g_reg_mu);
+    auto it = g_ec_handles.find(handle);
+    if (it == g_ec_handles.end()) return -1;
+    auto ev = it->second;
+    rlk.unlock();
+    if (shard_id < 0 || shard_id >= 14) return -1;
+    std::unique_lock<std::shared_mutex> lk(ev->recovery_mu);
+    if (n != 10) {
+        ev->recovery[shard_id].reset();
+        return 0;
+    }
+    auto rec = std::make_unique<EcRecovery>();
+    memcpy(rec->survivors, survivors, 10);
+    memcpy(rec->coeffs, coeffs, 10);
+    ev->recovery[shard_id] = std::move(rec);
+    return 0;
+}
+
 int svn_ec_refresh(int64_t handle) {
     std::shared_lock<std::shared_mutex> lk(g_reg_mu);
     auto it = g_ec_handles.find(handle);
@@ -1326,7 +1398,38 @@ Reply handle_ec_read(const EcPtr& ev, uint64_t nid, uint32_t cookie) {
                          (is_large ? row * lb : n_large_rows * lb + row * sb);
         int sid = (int)(block_index % 10);
         int fd = ev->shard_fds[sid].load();
-        if (fd < 0) return {307, "shard not local"};
+        if (fd < 0) {
+            // degraded read: rebuild this span from 10 local survivors
+            // using the daemon-pushed recovery row; a wrong plan can
+            // never serve silently — the needle CRC check downstream
+            // rejects it
+            EcRecovery rec;
+            if (!ev->get_recovery(sid, &rec))
+                return {307, "shard not local"};
+            std::string sur((size_t)take, '\0');
+            uint8_t* out = (uint8_t*)blob.data() + wrote;
+            memset(out, 0, (size_t)take);
+            const uint8_t (*mt)[256] = gf_mul();
+            for (int j = 0; j < 10; j++) {
+                int sfd = ev->shard_fds[rec.survivors[j]].load();
+                if (sfd < 0) return {307, "survivor not local"};
+                if (!pread_full(sfd, (uint8_t*)sur.data(), (size_t)take,
+                                ec_off))
+                    return {500, "short survivor read"};
+                const uint8_t* row = mt[rec.coeffs[j]];
+                const uint8_t* in = (const uint8_t*)sur.data();
+                for (int64_t k = 0; k < take; k++) out[k] ^= row[in[k]];
+            }
+            wrote += take;
+            want -= take;
+            block_index++;
+            if (is_large && block_index == n_large_rows * 10) {
+                is_large = false;
+                block_index = 0;
+            }
+            inner = 0;
+            continue;
+        }
         if (!pread_full(fd, (uint8_t*)blob.data() + wrote, (size_t)take,
                         ec_off))
             return {500, "short shard read"};

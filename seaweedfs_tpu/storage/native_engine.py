@@ -83,6 +83,9 @@ def lib() -> Optional[ctypes.CDLL]:
                                      _i64]
     cdll.svn_ec_add_shard.argtypes = [_i64, ctypes.c_int, ctypes.c_char_p]
     cdll.svn_ec_remove_shard.argtypes = [_i64, ctypes.c_int]
+    cdll.svn_ec_set_recovery.argtypes = [_i64, ctypes.c_int,
+                                         ctypes.c_char_p, ctypes.c_char_p,
+                                         ctypes.c_int]
     cdll.svn_ec_serve.argtypes = [_u32, _i64]
     cdll.svn_ec_unregister.argtypes = [_i64]
     cdll.svn_ec_refresh.argtypes = [_i64]
@@ -304,7 +307,33 @@ class NativeEcBinding:
             # ec.balance deletes the file after moving it)
             self._lib.svn_ec_remove_shard(self.handle, sid)
         self.shard_ids = current
+        self._sync_recovery(current)
         self._lib.svn_ec_refresh(self.handle)
+
+    def _sync_recovery(self, current: frozenset):
+        """Push per-missing-shard reconstruction rows so the engine
+        serves DEGRADED reads natively: with >=10 local shards, any
+        missing data shard's span is a fixed GF(2^8) combination of the
+        survivors' same-offset bytes (rebuild_matrix — the one-matmul
+        form of klauspost Reconstruct).  A wrong row cannot serve
+        silently: the needle CRC check rejects it."""
+        if len(current) >= 10:
+            from ..parallel.batched_encode import rebuild_matrix
+
+            present = sorted(current)
+            for sid in range(14):
+                if sid in current:
+                    self._lib.svn_ec_set_recovery(
+                        self.handle, sid, b"", b"", 0)
+                    continue
+                chosen, matrix = rebuild_matrix(present, [sid])
+                self._lib.svn_ec_set_recovery(
+                    self.handle, sid, bytes(chosen[:10]),
+                    bytes(int(c) for c in matrix[0][:10]), 10)
+        else:
+            for sid in range(14):
+                self._lib.svn_ec_set_recovery(self.handle, sid, b"",
+                                              b"", 0)
 
     def close(self):
         if self.handle:

@@ -902,3 +902,74 @@ class TestNativeReadJwtQueryParam:
             v.close()
         finally:
             ne.server_set_jwt("", "", 10)
+
+
+class TestNativeDegradedEcReads:
+    def test_reads_survive_losing_four_shards(self, tmp_path):
+        """After ec.encode, unmount+delete 4 data shards: framed reads
+        must STILL answer natively (status 0, exact bytes) — the engine
+        reconstructs missing spans from 10 local survivors using the
+        daemon-pushed recovery rows (store_ec.go:328-382 semantics,
+        entirely off the GIL)."""
+        import os as _os
+
+        master = MasterServer(port=0, pulse_seconds=0.2)
+        master.start()
+        vs = VolumeServer([str(tmp_path)], master.address, port=0,
+                          pulse_seconds=0.2, enable_tcp=True)
+        vs.start()
+        vs.heartbeat_once()
+        try:
+            if not getattr(vs, "_native_owner", False):
+                pytest.skip(
+                    "another test holds the process-wide native port")
+            from seaweedfs_tpu.shell import commands as sh
+
+            by_vid: dict[int, dict] = {}
+            for i in range(40):
+                a = call(master.address, "/dir/assign")
+                payload = _os.urandom(600 + 41 * i)
+                call(a["url"], f"/{a['fid']}", raw=payload, method="POST")
+                by_vid.setdefault(int(a["fid"].split(",")[0]),
+                                  {})[a["fid"]] = payload
+            # assigns spread across volumes: encode the fullest one
+            vid = max(by_vid, key=lambda v: len(by_vid[v]))
+            stored = by_vid[vid]
+            env = sh.CommandEnv(master.address)
+            sh.ec_encode(env, vid)
+            vs.heartbeat_once()
+            assert vid in getattr(vs, "_native_ec", {})
+
+            # lose 4 data shards entirely (files + mounts)
+            kill = [0, 1, 2, 3]
+            call(vs.store.url, "/admin/ec/unmount",
+                 {"volume": vid, "shard_ids": kill})
+            call(vs.store.url, "/admin/ec/delete_shards",
+                 {"volume": vid, "shard_ids": kill})
+            vs.heartbeat_once()  # resync pushes the recovery rows
+
+            served = 0
+            for fid, payload in stored.items():
+                st, body = raw_request(vs.tcp_port, f"G {fid}\n".encode())
+                assert st == 0, f"{fid}: native degraded read got {st}"
+                assert body == payload, f"{fid}: wrong bytes"
+                served += 1
+            # assigns spread across volumes; every needle on OUR vid
+            # must have served natively despite the 4 lost shards
+            assert served == len(stored) and served >= 5
+            # losing an 11th shard makes reconstruction impossible:
+            # those spans must 307 (fallback), never serve garbage
+            call(vs.store.url, "/admin/ec/unmount",
+                 {"volume": vid, "shard_ids": [4]})
+            call(vs.store.url, "/admin/ec/delete_shards",
+                 {"volume": vid, "shard_ids": [4]})
+            vs.heartbeat_once()
+            statuses = set()
+            for fid in list(stored)[:10]:
+                st, _ = raw_request(vs.tcp_port, f"G {fid}\n".encode())
+                statuses.add(st)
+            assert 0 not in statuses or len(
+                {s for s in statuses if s not in (0, 307)}) == 0
+        finally:
+            vs.stop()
+            master.stop()
