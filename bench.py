@@ -455,7 +455,7 @@ def bench_ec_degraded_read(num_files: int = 2000,
     from seaweedfs_tpu.storage import native_engine
 
     if not native_engine.available():
-        return 0.0, 0.0
+        return 0.0, 0.0, 0.0
     import tempfile
 
     from seaweedfs_tpu.master.server import MasterServer

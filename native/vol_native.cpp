@@ -923,8 +923,6 @@ int svn_ec_unregister(int64_t handle) {
     return g_ec_handles.erase(handle) ? 0 : -1;
 }
 
-// Refresh the cached .ecx entry count (the file grows only on rebuild;
-// deletes rewrite size fields in place, which preads observe directly)
 // Install (n=10) or clear (n=0) shard_id's degraded-read recovery plan:
 // `survivors` are 10 shard ids whose same-offset bytes, combined with
 // `coeffs` under GF(2^8), reproduce shard_id's bytes.  The daemon
@@ -943,6 +941,8 @@ int svn_ec_set_recovery(int64_t handle, int shard_id,
         ev->recovery[shard_id].reset();
         return 0;
     }
+    for (int j = 0; j < 10; j++)
+        if (survivors[j] >= 14) return -1;  // would index OOB on read
     auto rec = std::make_unique<EcRecovery>();
     memcpy(rec->survivors, survivors, 10);
     memcpy(rec->coeffs, coeffs, 10);
@@ -950,6 +950,8 @@ int svn_ec_set_recovery(int64_t handle, int shard_id,
     return 0;
 }
 
+// Refresh the cached .ecx entry count (the file grows only on rebuild;
+// deletes rewrite size fields in place, which preads observe directly)
 int svn_ec_refresh(int64_t handle) {
     std::shared_lock<std::shared_mutex> lk(g_reg_mu);
     auto it = g_ec_handles.find(handle);
@@ -1398,7 +1400,11 @@ Reply handle_ec_read(const EcPtr& ev, uint64_t nid, uint32_t cookie) {
                          (is_large ? row * lb : n_large_rows * lb + row * sb);
         int sid = (int)(block_index % 10);
         int fd = ev->shard_fds[sid].load();
-        if (fd < 0) {
+        if (fd >= 0) {
+            if (!pread_full(fd, (uint8_t*)blob.data() + wrote,
+                            (size_t)take, ec_off))
+                return {500, "short shard read"};
+        } else {
             // degraded read: rebuild this span from 10 local survivors
             // using the daemon-pushed recovery row; a wrong plan can
             // never serve silently — the needle CRC check downstream
@@ -1420,19 +1426,7 @@ Reply handle_ec_read(const EcPtr& ev, uint64_t nid, uint32_t cookie) {
                 const uint8_t* in = (const uint8_t*)sur.data();
                 for (int64_t k = 0; k < take; k++) out[k] ^= row[in[k]];
             }
-            wrote += take;
-            want -= take;
-            block_index++;
-            if (is_large && block_index == n_large_rows * 10) {
-                is_large = false;
-                block_index = 0;
-            }
-            inner = 0;
-            continue;
         }
-        if (!pread_full(fd, (uint8_t*)blob.data() + wrote, (size_t)take,
-                        ec_off))
-            return {500, "short shard read"};
         wrote += take;
         want -= take;
         block_index++;

@@ -306,8 +306,13 @@ class NativeEcBinding:
             # unmounted shards must stop serving (and release the fd:
             # ec.balance deletes the file after moving it)
             self._lib.svn_ec_remove_shard(self.handle, sid)
+        changed = current != self.shard_ids
         self.shard_ids = current
-        self._sync_recovery(current)
+        if changed:
+            # recovery rows depend only on the shard SET; skip the
+            # matrix inversions + 14 FFI calls on every unchanged
+            # heartbeat resync
+            self._sync_recovery(current)
         self._lib.svn_ec_refresh(self.handle)
 
     def _sync_recovery(self, current: frozenset):
