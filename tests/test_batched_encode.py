@@ -341,3 +341,39 @@ def test_host_pipeline_tiny_blocks_iov_cap(tmp_path):
         want = np.fromfile(ref + to_ext(i), dtype=np.uint8)
         assert np.array_equal(got, want), f"shard {i}"
         assert crcs[i] == crc32c(got.tobytes())
+
+
+def test_host_pipeline_large_block_col_chunks(tmp_path):
+    """Rows whose block size exceeds _HOST_SPAN_MAX_BLOCK take the
+    column-chunk path (strided preads per shard instead of one
+    contiguous span) — byte- and CRC-identical to the sync loop."""
+    import numpy as np
+
+    from seaweedfs_tpu.parallel import batched_encode as be
+    from seaweedfs_tpu.ops.crc32c import crc32c
+    from seaweedfs_tpu.storage.erasure_coding import to_ext
+
+    large, small = 16 << 20, 1 << 20
+    base = str(tmp_path / "big")
+    rng = np.random.default_rng(9)
+    # > large*10 so the plan emits one 16 MB-block large row (the col
+    # path: 16 MB > _HOST_SPAN_MAX_BLOCK) plus small-row tail
+    n = large * 10 + 3 * small * 10 + 12345
+    with open(base + ".dat", "wb") as f:
+        left = n
+        while left:
+            take = min(32 << 20, left)
+            f.write(rng.integers(0, 256, take, dtype=np.uint8).tobytes())
+            left -= take
+    crcs = be.encode_volumes([base], large_block=large, small_block=small,
+                             host_codec=True)[base]
+    ref = str(tmp_path / "bigref")
+    os.link(base + ".dat", ref + ".dat")
+    ec_encoder.write_ec_files(ref, large_block_size=large,
+                              small_block_size=small, batched=False)
+    for i in range(14):
+        with open(base + to_ext(i), "rb") as a, \
+                open(ref + to_ext(i), "rb") as b:
+            got = a.read()
+            assert got == b.read(), f"shard {i}"
+        assert crcs[i] == crc_host.crc32c(got), f"crc {i}"
