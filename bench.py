@@ -937,6 +937,8 @@ def main():
         print(f"note: s3 bench failed: {e}", file=sys.stderr)
 
     vs_baseline = hbm_fused / cpu_kernel if cpu_kernel > 0 else 0.0
+    from seaweedfs_tpu.util.platform import available_cpu_count
+
     print(json.dumps({
         "metric": "rs10_4_batched_encode_fused_throughput",
         "value": round(hbm_fused, 3),
@@ -966,7 +968,9 @@ def main():
                            if cpu_e2e > 0 else 0.0),
         "e2e_default_stages": default_stages,
         "e2e_scale_stages": scale_stages,
-        "host_cores": os.cpu_count() or 1,
+        # affinity-aware (sched_getaffinity): matches the worker count
+        # the host pipeline will actually spawn on this box
+        "host_cores": available_cpu_count(),
         "hbm_fused_variants": {k: round(v, 3)
                                for k, v in hbm_variants.items()},
         "link_h2d_mbps": round(h2d_mbps, 1),

@@ -172,7 +172,9 @@ constexpr int kPaddingSize = 8;
 constexpr int32_t kTombstone = -1;
 constexpr int64_t kMaxVolumeSize = 32LL * 1024 * 1024 * 1024;
 constexpr uint8_t kFlagHasLastModified = 0x08;
+constexpr uint8_t kFlagHasTtl = 0x10;
 constexpr int kLastModifiedBytes = 5;
+constexpr int kTtlBytes = 2;  // count, unit (storage/ttl.py to_bytes)
 
 // Cumulative request counters (exposed to Prometheus via
 // svn_server_stats; native requests never enter Python, so the
@@ -308,8 +310,12 @@ struct NVolume {
     std::atomic<bool> read_only{false};
     std::atomic<bool> do_fsync{false};
     // TTL volumes: reads 404 expired needles (volume_read.go:27-35);
-    // the daemon's vacuum still reclaims them
+    // the daemon's vacuum still reclaims them.  ttl_raw is the volume
+    // TTL's on-disk uint32 form ((count<<8)|unit, storage/ttl.py):
+    // native writes stamp it into every needle so natively-written
+    // needles on TTL volumes expire and vacuum like Python-written ones
     std::atomic<int64_t> ttl_sec{0};
+    std::atomic<uint32_t> ttl_raw{0};
     // replicated volumes: native writes must fan out to this many other
     // locations (store_replicate.go:24-141); when the replica address
     // set is smaller, writes 307 to the Python handler instead
@@ -637,11 +643,13 @@ int svn_set_flags(int64_t handle, int writable, int read_only) {
     return 0;
 }
 
-// TTL volumes: native reads 404 needles older than ttl_sec (0 = none).
-int svn_set_ttl(int64_t handle, int64_t ttl_sec) {
+// TTL volumes: native reads 404 needles older than ttl_sec (0 = none);
+// native writes append ttl_raw ((count<<8)|unit) to each needle.
+int svn_set_ttl(int64_t handle, int64_t ttl_sec, uint32_t ttl_raw) {
     auto v = handle_vol(handle);
     if (!v) return -1;
     v->ttl_sec.store(ttl_sec);
+    v->ttl_raw.store(ttl_raw);
     return 0;
 }
 
@@ -674,12 +682,16 @@ int svn_set_replicas(uint32_t vid, const char* csv) {
 }
 
 // HS256 signing keys for the fast-path port (security.toml jwt.signing
-// / jwt.signing.read — guard.go:18-50).  Empty string disables a key.
+// / jwt.signing.read — guard.go:18-50).  Empty string disables a key;
+// NULL leaves that key untouched.  The keys are ENGINE-global and the
+// engine is shared by every in-process daemon, so each owner (master
+// guard, volume guard) must only ever set/clear ITS key — a master
+// shutting down must not also clear the volume server's read key.
 int svn_server_set_jwt(const char* write_key, const char* read_key,
                        int expire_s) {
     std::lock_guard<std::mutex> lk(g_jwt_mu);
-    g_jwt_write_key = write_key ? write_key : "";
-    g_jwt_read_key = read_key ? read_key : "";
+    if (write_key) g_jwt_write_key = write_key;
+    if (read_key) g_jwt_read_key = read_key;
     if (expire_s > 0) g_jwt_expire_s = expire_s;
     return 0;
 }
@@ -1680,8 +1692,13 @@ Reply handle_write(uint32_t vid, uint64_t nid, uint32_t cookie,
     int64_t dlen = (int64_t)body.size();
     uint32_t crc = crc32c((const uint8_t*)body.data(), (size_t)dlen);
     // v3 needle with data + HAS_LAST_MODIFIED (what the HTTP write path
-    // produces for a plain body: needle.py Needle.create)
-    int64_t size = dlen ? 4 + dlen + 1 + kLastModifiedBytes : 0;
+    // produces for a plain body: needle.py Needle.create), plus the
+    // volume's TTL on TTL volumes (needle.py stamps ttl the same way;
+    // without it the needle would never expire or vacuum)
+    uint32_t ttl_raw = v->ttl_sec.load() > 0 ? v->ttl_raw.load() : 0;
+    int64_t size = dlen
+        ? 4 + dlen + 1 + kLastModifiedBytes + (ttl_raw ? kTtlBytes : 0)
+        : 0;
     if (size > INT32_MAX) return {413, "entity too large"};
 
     // cookie check + identical-rewrite dedup against the existing needle
@@ -1735,13 +1752,18 @@ Reply handle_write(uint32_t vid, uint64_t nid, uint32_t cookie,
         w += 4;
         memcpy(p + w, body.data(), (size_t)dlen);
         w += dlen;
-        p[w++] = kFlagHasLastModified;
+        p[w++] = ttl_raw ? (kFlagHasLastModified | kFlagHasTtl)
+                         : kFlagHasLastModified;
         // 5-byte big-endian seconds (needle_write.go writes the low 5
         // bytes of the u64)
         for (int i = 0; i < kLastModifiedBytes; i++)
             p[w + i] =
                 (uint8_t)(lastmod >> (8 * (kLastModifiedBytes - 1 - i)));
         w += kLastModifiedBytes;
+        if (ttl_raw) {  // count, unit — after lastModified (needle.py)
+            p[w++] = (uint8_t)((ttl_raw >> 8) & 0xFF);
+            p[w++] = (uint8_t)(ttl_raw & 0xFF);
+        }
     }
     put_be32(p + w, crc);
     w += 4;

@@ -104,7 +104,11 @@ class MasterServer:
                 t.join(timeout=5)
             native_engine.assign_clear()
             if getattr(self, "_native_jwt_owner", False):
-                native_engine.server_set_jwt("", "", 10)
+                # owner-aware: the master only ever set the WRITE key,
+                # so it must only clear the write key — None leaves the
+                # read key alone for an in-process volume server whose
+                # secured reads would otherwise fail open
+                native_engine.server_set_jwt("", None, 10)
                 self._native_jwt_owner = False
             if self._native_assign_owner:
                 native_engine.server_stop()
@@ -124,9 +128,11 @@ class MasterServer:
             return
         if self.guard.signing:
             # the 'A' handler mints fid-scoped write tokens itself; the
-            # keys are engine-global, so clear them on stop
+            # keys are engine-global, so set/clear ONLY the write key
+            # (None = leave the read key to its owner, the in-process
+            # volume server) and clear it on stop
             native_engine.server_set_jwt(
-                self.guard.signing.key, b"",
+                self.guard.signing.key, None,
                 self.guard.signing.expires_after_seconds)
             self._native_jwt_owner = True
         host, port = self.server.address.rsplit(":", 1)

@@ -55,9 +55,30 @@ class NativeEncoder(RSCodecBase):
                     parity_out: np.ndarray) -> list[int]:
         """Fused span encode: data (R, d, L) -> parity_out (R, p, L), one
         ctypes call; returns per-shard CRC32Cs chained across the R rows
-        (= the rolling file CRC of the span's L*R-byte shard slice)."""
+        (= the rolling file CRC of the span's L*R-byte shard slice).
+
+        Buffer ownership contract: the CALLER owns both buffers, and the
+        kernel only touches them for the duration of this call — `data`
+        is read-only, `parity_out` is fully overwritten before return.
+        Nothing is retained, so a write-behind pipeline may hand either
+        buffer to another thread (or recycle it through a slot pool) the
+        moment this returns; conversely neither buffer may be mutated BY
+        other threads while the call is in flight.  All three arrays
+        must be C-contiguous uint8 — the kernel walks raw pointers with
+        row strides computed from the shapes."""
+        for name, arr in (("parity_matrix", parity_matrix),
+                          ("data", data), ("parity_out", parity_out)):
+            if arr.dtype != np.uint8 or not arr.flags["C_CONTIGUOUS"]:
+                raise ValueError(
+                    f"encode_rows: {name} must be C-contiguous uint8 "
+                    f"(got dtype={arr.dtype}, "
+                    f"contiguous={arr.flags['C_CONTIGUOUS']})")
         p, d = parity_matrix.shape
         rows, _, length = data.shape
+        if parity_out.shape != (rows, p, length):
+            raise ValueError(
+                f"encode_rows: parity_out shape {parity_out.shape} != "
+                f"{(rows, p, length)}")
         crcs = (ctypes.c_uint32 * (d + p))()
         self._lib.sw_encode_rows(
             parity_matrix.ctypes.data_as(ctypes.c_char_p), p, d,

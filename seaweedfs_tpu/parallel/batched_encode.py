@@ -26,10 +26,12 @@ in the .vif sidecar for scrub tooling.
 
 from __future__ import annotations
 
+import ctypes
 import math
 import os
 import queue
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -118,17 +120,186 @@ def _read_unit(dat, dat_size: int, u: _Unit, chunk: int, out: np.ndarray):
             out[i, got:].fill(0)
 
 
-class _ShardWriters:
-    """Open .ec00-.ec13 for one volume; tracks rolling per-file CRC32C."""
+# -- the write stage's shared plumbing --------------------------------------
+# checked vectored writes, dirty-page writeback pacing, and the raw shard
+# fd set.  Shared by all three consumers: the host pipeline's writer pool,
+# the device pipeline's drain side, and the rebuild path.
 
-    def __init__(self, base: str, to_ext):
-        self.files = [open(base + to_ext(i), "wb")
-                      for i in range(TOTAL_SHARDS)]
+_IOV_MAX = 1024       # kernel cap on iovecs per pwritev
+_SFR_WAIT_BEFORE = 1  # SYNC_FILE_RANGE_WAIT_BEFORE
+_SFR_WRITE = 2        # SYNC_FILE_RANGE_WRITE
+_SFR_WAIT_AFTER = 4   # SYNC_FILE_RANGE_WAIT_AFTER
+
+_sfr_fn = None
+_sfr_probed = False
+
+
+def _sync_file_range():
+    """ctypes handle to sync_file_range(2) — not exposed by the os
+    module; None when the libc doesn't have it (non-Linux)."""
+    global _sfr_fn, _sfr_probed
+    if not _sfr_probed:
+        _sfr_probed = True
+        try:
+            libc = ctypes.CDLL(None, use_errno=True)
+            fn = libc.sync_file_range
+            fn.argtypes = [ctypes.c_int, ctypes.c_int64, ctypes.c_int64,
+                           ctypes.c_uint]
+            fn.restype = ctypes.c_int
+            _sfr_fn = fn
+        except (OSError, AttributeError):
+            _sfr_fn = None
+    return _sfr_fn
+
+
+def _write_knobs() -> tuple[bool, int, int, bool]:
+    """The WEED_EC_WRITE_* knob set, read per encode (daemons and tests
+    flip them without a reimport): (write_behind, writers, flush_bytes,
+    drop_cache).
+
+      WEED_EC_WRITE_BEHIND     0 disables the decoupled writer stage
+                               (compute workers write synchronously)
+      WEED_EC_WRITERS          writer-pool size (0 = auto: workers/2,
+                               capped at 4)
+      WEED_EC_WRITE_FLUSH_MB   writeback pacing window in MiB
+                               (0 disables pacing; default 32)
+      WEED_EC_WRITE_DROP_CACHE 1 = drop synced windows from the page
+                               cache (posix_fadvise DONTNEED)
+    """
+    behind = os.environ.get("WEED_EC_WRITE_BEHIND", "1").lower() \
+        not in ("0", "false", "no")
+    writers = int(os.environ.get("WEED_EC_WRITERS", "0") or 0)
+    mb = os.environ.get("WEED_EC_WRITE_FLUSH_MB", "")
+    flush_bytes = int(float(mb) * (1 << 20)) if mb else (32 << 20)
+    drop = os.environ.get("WEED_EC_WRITE_DROP_CACHE", "0").lower() \
+        not in ("", "0", "false", "no")
+    return behind, writers, flush_bytes, drop
+
+
+def _pwritev_full(fd: int, bufs, offset: int) -> int:
+    """pwritev that writes every byte or raises OSError.  A short kernel
+    write must fail the encode, not silently truncate a shard whose CRC
+    was already computed from memory (ADVICE.md batched_encode.py:551):
+    partial progress is retried from where the kernel stopped; zero
+    progress is a hard error."""
+    iovs = [memoryview(b) for b in bufs]
+    total = sum(v.nbytes for v in iovs)
+    written = 0
+    while written < total:
+        n = os.pwritev(fd, iovs, offset + written)
+        if n <= 0:
+            raise OSError(
+                "pwritev made no progress: %d of %d bytes at offset %d "
+                "(shard would be truncated)" % (written, total, offset))
+        written += n
+        if written >= total:
+            break
+        while n >= iovs[0].nbytes:  # drop fully-written iovecs
+            n -= iovs[0].nbytes
+            iovs.pop(0)
+        if n:
+            iovs[0] = iovs[0][n:]
+    return total
+
+
+class _WritebackPacer:
+    """Paces dirty-page writeback for the shard writer stage: after
+    every `flush_bytes` written to an fd, kick the kernel's async
+    writeback for the newly-written window (sync_file_range(WRITE)) so
+    dirty pages drain continuously instead of accumulating until
+    vm.dirty_ratio stalls every writer at once — the failure mode of the
+    8.79 GiB scale run, whose write stage was 93.5% of wall time.  With
+    drop_cache the window is synced and evicted (posix_fadvise DONTNEED):
+    shard bytes are write-once and never re-read by this process.
+
+    Time spent flushing is accumulated in `flush_seconds` so callers can
+    attribute it separately from the pwritev busy time."""
+
+    def __init__(self, flush_bytes: int, drop_cache: bool):
+        self.flush_bytes = flush_bytes
+        self.drop_cache = drop_cache
+        self._sfr = _sync_file_range() if flush_bytes > 0 else None
+        self._lock = threading.Lock()
+        self._state: dict[int, list[int]] = {}  # fd -> [acc, cursor, hi]
+        self.flush_seconds = 0.0
+        self.flushes = 0
+
+    def wrote(self, fd: int, offset: int, n: int):
+        if self.flush_bytes <= 0 or n <= 0:
+            return
+        with self._lock:
+            st = self._state.setdefault(fd, [0, 0, 0])
+            st[0] += n
+            end = offset + n
+            if end > st[2]:
+                st[2] = end
+            if st[0] < self.flush_bytes:
+                return
+            st[0] = 0
+            lo, hi = st[1], st[2]
+            st[1] = hi
+        self._flush_window(fd, lo, hi)
+
+    def _flush_window(self, fd: int, lo: int, hi: int):
+        if hi <= lo:
+            return
+        t0 = time.perf_counter()
+        try:
+            if self._sfr is not None:
+                self._sfr(fd, lo, hi - lo, _SFR_WRITE)
+            if self.drop_cache:
+                if self._sfr is not None:
+                    self._sfr(fd, lo, hi - lo,
+                              _SFR_WAIT_BEFORE | _SFR_WRITE | _SFR_WAIT_AFTER)
+                os.posix_fadvise(fd, lo, hi - lo, os.POSIX_FADV_DONTNEED)
+        except OSError:
+            self.flush_bytes = 0  # fs doesn't support pacing; stop trying
+            return
+        with self._lock:
+            self.flush_seconds += time.perf_counter() - t0
+            self.flushes += 1
+
+    def forget(self, fds):
+        """Drop per-fd state on close: fd numbers get recycled."""
+        with self._lock:
+            for fd in fds:
+                self._state.pop(fd, None)
+
+
+class _ShardFileSet:
+    """One volume's 14 shard files as raw O_WRONLY fds (no BufferedWriter
+    copy, no seek-flush churn — profiling showed buffered seek+write was
+    the #1 cost of the old host stage) with rolling per-file CRC32C.
+    pwritev is positional and thread-safe, so reader, writer-pool and
+    drain threads can all write concurrently.  Files are ftruncate()d to
+    their final size up front: extending i_size a megabyte at a time
+    measurably slows tmpfs/ext4 writes (~3x on the profiled box).  Every
+    write goes through the checked pwritev (full length or OSError) and
+    reports to the writeback pacer."""
+
+    def __init__(self, base: str, to_ext, shard_size: int = 0,
+                 pacer: Optional[_WritebackPacer] = None):
+        self.fds = [os.open(base + to_ext(i),
+                            os.O_CREAT | os.O_TRUNC | os.O_WRONLY, 0o644)
+                    for i in range(TOTAL_SHARDS)]
+        if shard_size:
+            for fd in self.fds:
+                os.ftruncate(fd, shard_size)
         self.crcs = [0] * TOTAL_SHARDS
+        self.pacer = pacer
+
+    def write(self, shard: int, bufs, offset: int) -> int:
+        fd = self.fds[shard]
+        n = _pwritev_full(fd, bufs, offset)
+        if self.pacer is not None:
+            self.pacer.wrote(fd, offset, n)
+        return n
 
     def close(self):
-        for f in self.files:
-            f.close()
+        if self.pacer is not None:
+            self.pacer.forget(self.fds)
+        for fd in self.fds:
+            os.close(fd)
 
 
 def encode_volumes(bases: list[str], large_block: Optional[int] = None,
@@ -169,13 +340,18 @@ def encode_volumes(bases: list[str], large_block: Optional[int] = None,
     if not units:
         out = {}
         for vi, p in enumerate(plans):
-            _ShardWriters(p.base, to_ext).close()
+            _ShardFileSet(p.base, to_ext).close()
             out[p.base] = [0] * TOTAL_SHARDS
         return out
     if host_codec:
         return _encode_units_host(plans, units, chunk, host_codec,
                                   stage_stats)
-    writers = {vi: _ShardWriters(p.base, to_ext)
+    _, _, flush_bytes, drop_cache = _write_knobs()
+    pacer = _WritebackPacer(flush_bytes, drop_cache)
+    writers = {vi: _ShardFileSet(
+                   p.base, to_ext,
+                   (p.rows[-1][1] + p.rows[-1][2]) if p.rows else 0,
+                   pacer)
                for vi, p in enumerate(plans)}
     return _encode_units_device(plans, units, chunk, writers, mesh,
                                 batch_units)
@@ -234,8 +410,7 @@ class _PipelineIO:
                                self.chunk, buf[k])
                     w = self.writers[u.vol]
                     for i in range(DATA_SHARDS):
-                        w.files[i].seek(u.shard_off)
-                        w.files[i].write(buf[k, i])
+                        w.write(i, [buf[k, i]], u.shard_off)
                 if not self.put(self.ready, (buf, batch)):
                     return
             self.put(self.ready, None)
@@ -253,9 +428,8 @@ class _PipelineIO:
                 for k, u in enumerate(batch):
                     w = self.writers[u.vol]
                     for i in range(PARITY_SHARDS):
-                        f = w.files[DATA_SHARDS + i]
-                        f.seek(u.shard_off)
-                        f.write(parity[k, i])
+                        w.write(DATA_SHARDS + i, [parity[k, i]],
+                                u.shard_off)
         except BaseException as e:
             self.errors.append(e)
             self.stop.set()
@@ -360,28 +534,6 @@ def _encode_units_device(plans, units, chunk, writers, mesh,
     return io.result()
 
 
-class _RawShardFiles:
-    """Unbuffered per-volume shard files for the host pipeline: os-level
-    fds (no BufferedWriter copy, no seek-flush churn — profiling showed
-    buffered seek+write was the #1 cost of the old host stage) written
-    with pwritev, which is thread-safe across compute workers; plus the
-    rolling per-file CRC32C.  Files are ftruncate()d to their final size
-    up front: extending i_size a megabyte at a time measurably slows
-    tmpfs/ext4 writes (~3x on the profiled box)."""
-
-    def __init__(self, base: str, to_ext, shard_size: int):
-        self.fds = [os.open(base + to_ext(i),
-                            os.O_CREAT | os.O_TRUNC | os.O_WRONLY, 0o644)
-                    for i in range(TOTAL_SHARDS)]
-        for fd in self.fds:
-            os.ftruncate(fd, shard_size)
-        self.crcs = [0] * TOTAL_SHARDS
-
-    def close(self):
-        for fd in self.fds:
-            os.close(fd)
-
-
 # Host-pipeline work sizing: a span batches consecutive equal-block rows
 # into one contiguous .dat read (the striped rows of ec_encoder.go:57-59
 # are adjacent on disk, so R rows = ONE preadv of R*10*block bytes, and
@@ -443,21 +595,37 @@ def _host_work_items(plans) -> list[_HostWork]:
 
 def _encode_units_host(plans, units, chunk, host_codec,
                        stage_stats=None) -> dict[str, list[int]]:
-    """The host encode path: work items (multi-row spans / column chunks)
-    flow read -> fused parity+CRC kernel -> pwritev, with per-shard-file
-    CRC32Cs chained in stripe order.
+    """The host encode path as a true three-stage pipeline.  Work items
+    (multi-row spans / column chunks) flow
+
+      read    — a reader thread fills staging slots with contiguous
+                preadv()s of the .dat;
+      encode  — a pool of compute workers (WEED_EC_HOST_WORKERS, default
+                one per *available* core, each releasing the GIL inside
+                the fused native parity+CRC kernel) encodes into pooled
+                parity slots;
+      write   — a dedicated writer pool drains a bounded hand-off queue,
+                coalescing adjacent spans into one pwritev per shard
+                file and pacing dirty-page writeback (_WritebackPacer)
+                so scale runs don't stall on a full dirty-page budget.
+
+    Compute workers hand (data, parity, crcs) to the writer stage and
+    immediately pull the next item instead of blocking on 14 synchronous
+    pwritev calls — at 300-volume scale the write stage was 93.5% of
+    wall time while the codec sat idle.  Parity slots are pooled rather
+    than thread-local because with write-behind a slot outlives its
+    compute call until the writer stage releases it (each worker
+    effectively double-buffers).
 
     On a single-core host everything runs inline in the calling thread —
     profiling showed reader/worker threads on one core cost ~3x in GIL
-    convoying around every ctypes/syscall boundary.  With more cores a
-    reader thread fills staging slots and a pool of compute workers
-    (WEED_EC_HOST_WORKERS, default one per core, each releasing the GIL
-    inside the native kernel and pwritev) fans the codec out — the
-    multi-volume analogue of the reference's goroutine-per-volume encode
-    (ec_encoder.go:194-231) without its per-row synchronous codec loop.
+    convoying around every ctypes/syscall boundary.  WEED_EC_WRITE_BEHIND=0
+    degrades to the two-stage form (compute workers write synchronously),
+    byte- and CRC-identical either way.
 
-    stage_stats (optional dict) gets per-stage busy seconds + fractions:
-    the pipeline's own answer to "which stage is the bottleneck"."""
+    stage_stats (optional dict) gets per-stage busy seconds + fractions
+    (read / encode_crc / write / flush): the pipeline's own answer to
+    "which stage is the bottleneck"."""
     import time as _t
     from concurrent.futures import ThreadPoolExecutor
 
@@ -473,28 +641,39 @@ def _encode_units_host(plans, units, chunk, host_codec,
 
     nworkers = int(os.environ.get("WEED_EC_HOST_WORKERS", "0") or 0)
     if nworkers <= 0:
-        nworkers = max(1, min(16, os.cpu_count() or 1))
+        from ..util.platform import available_cpu_count
+
+        # affinity-aware: an affinity-restricted box must not over-spawn
+        # workers onto cores it cannot use (ADVICE.md bench.py:969)
+        nworkers = max(1, min(16, available_cpu_count()))
+
+    write_behind, nwriters, flush_bytes, drop_cache = _write_knobs()
+    write_behind = write_behind and nworkers > 1
+    if nwriters <= 0:
+        nwriters = max(1, min(4, nworkers // 2))
+    if not write_behind:
+        nwriters = 0
 
     items = _host_work_items(plans)
     slot_bytes = max(i.rows * DATA_SHARDS * i.length for i in items)
     parity_bytes = max(i.rows * PARITY_SHARDS * i.length for i in items)
-    # one parity buffer per compute thread, reused across items: a fresh
-    # np.empty per item costs first-touch page faults on every span
-    parity_tls = threading.local()
+    # pooled parity slots (not thread-local: see docstring); sized so
+    # compute never starves while the writer pool holds slots in flight
+    n_pslots = 1 if nworkers == 1 else nworkers + 2 * nwriters + 2
+    parity_free: "queue.Queue[np.ndarray]" = queue.Queue()
+    for _ in range(n_pslots):
+        parity_free.put(np.empty(parity_bytes, dtype=np.uint8))
 
-    def parity_view(w: _HostWork) -> np.ndarray:
-        buf = getattr(parity_tls, "buf", None)
-        if buf is None:
-            buf = parity_tls.buf = np.empty(parity_bytes, dtype=np.uint8)
-        need = w.rows * PARITY_SHARDS * w.length
-        return buf[:need].reshape(w.rows, PARITY_SHARDS, w.length)
-
+    stop = threading.Event()
+    errors: list[BaseException] = []
+    pacer = _WritebackPacer(flush_bytes, drop_cache)
     dat_fds = [os.open(p.base + ".dat", os.O_RDONLY) for p in plans]
-    vols = {vi: _RawShardFiles(
+    vols = {vi: _ShardFileSet(
                 p.base, to_ext,
-                (p.rows[-1][1] + p.rows[-1][2]) if p.rows else 0)
+                (p.rows[-1][1] + p.rows[-1][2]) if p.rows else 0,
+                pacer)
             for vi, p in enumerate(plans)}
-    timers = {"read": 0.0, "encode_crc": 0.0, "write": 0.0}
+    timers = {"read": 0.0, "encode_crc": 0.0, "write": 0.0, "flush": 0.0}
     tlock = threading.Lock()
 
     def read_item(w: _HostWork, flat: np.ndarray) -> np.ndarray:
@@ -531,9 +710,20 @@ def _encode_units_host(plans, units, chunk, host_codec,
                     row[i, got:] = 0
         return view
 
-    def compute_write(w: _HostWork, data: np.ndarray) -> list[int]:
+    def encode_item(w: _HostWork, data: np.ndarray):
+        """Encode stage: parity+CRC into a pooled parity slot.  The slot
+        travels with the item to the writer stage (write-behind) or is
+        released right after the inline write."""
         t0 = _t.perf_counter()
-        parity = parity_view(w)
+        while True:  # stop-aware: an error elsewhere must not wedge us
+            try:
+                pbuf = parity_free.get(timeout=0.5)
+                break
+            except queue.Empty:
+                if stop.is_set():
+                    raise RuntimeError("encode pipeline stopped")
+        need = w.rows * PARITY_SHARDS * w.length
+        parity = pbuf[:need].reshape(w.rows, PARITY_SHARDS, w.length)
         if fused:
             crcs = enc.encode_rows(parity_matrix, data, parity)
         else:
@@ -545,18 +735,29 @@ def _encode_units_host(plans, units, chunk, host_codec,
                 for i in range(PARITY_SHARDS):
                     crcs[DATA_SHARDS + i] = crc_host.crc32c(
                         parity[r, i], crcs[DATA_SHARDS + i])
-        t1 = _t.perf_counter()
+        with tlock:
+            timers["encode_crc"] += _t.perf_counter() - t0
+        return pbuf, parity, crcs
+
+    def write_item(w: _HostWork, data: np.ndarray, parity: np.ndarray):
+        """Write stage body: the item's data+parity shard spans."""
+        t0 = _t.perf_counter()
         v = vols[w.vol]
         for i in range(DATA_SHARDS):
-            os.pwritev(v.fds[i], [data[r, i] for r in range(w.rows)],
-                       w.shard_off)
+            v.write(i, [data[r, i] for r in range(w.rows)], w.shard_off)
         for i in range(PARITY_SHARDS):
-            os.pwritev(v.fds[DATA_SHARDS + i],
-                       [parity[r, i] for r in range(w.rows)], w.shard_off)
-        t2 = _t.perf_counter()
+            v.write(DATA_SHARDS + i,
+                    [parity[r, i] for r in range(w.rows)], w.shard_off)
         with tlock:
-            timers["encode_crc"] += t1 - t0
-            timers["write"] += t2 - t1
+            timers["write"] += _t.perf_counter() - t0
+
+    def encode_write_item(w: _HostWork, data: np.ndarray) -> list[int]:
+        """Two-stage form (WEED_EC_WRITE_BEHIND=0): the compute worker
+        writes synchronously, as the pipeline always did before the
+        writer stage was split out."""
+        pbuf, parity, crcs = encode_item(w, data)
+        write_item(w, data, parity)
+        parity_free.put(pbuf)
         return crcs
 
     def combine(w: _HostWork, crcs: list[int]):
@@ -564,6 +765,23 @@ def _encode_units_host(plans, units, chunk, host_codec,
         for s in range(TOTAL_SHARDS):
             v.crcs[s] = crc_host.crc32c_combine(
                 v.crcs[s], crcs[s], w.rows * w.length)
+
+    def qput(q, item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.5)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def qget(q):
+        while not stop.is_set():
+            try:
+                return q.get(timeout=0.5)
+            except queue.Empty:
+                continue
+        return None
 
     wall0 = _t.perf_counter()
     try:
@@ -573,53 +791,104 @@ def _encode_units_host(plans, units, chunk, host_codec,
                 t0 = _t.perf_counter()
                 data = read_item(w, flat)
                 timers["read"] += _t.perf_counter() - t0
-                combine(w, compute_write(w, data))
+                pbuf, parity, crcs = encode_item(w, data)
+                write_item(w, data, parity)
+                parity_free.put(pbuf)
+                combine(w, crcs)
         else:
-            n_slots = max(_SLOTS, nworkers + 2)
+            n_slots = max(_SLOTS, nworkers + 2 * nwriters + 2)
             free_slots: "queue.Queue[np.ndarray]" = queue.Queue()
             for _ in range(n_slots):
                 free_slots.put(np.empty(slot_bytes, dtype=np.uint8))
             ready: "queue.Queue" = queue.Queue(maxsize=n_slots)
-            stop = threading.Event()
-            errors: list[BaseException] = []
+            write_q: "queue.Queue" = queue.Queue(maxsize=2 * nwriters + 2)
 
             def reader():
                 try:
                     for w in items:
-                        while not stop.is_set():
-                            try:
-                                flat = free_slots.get(timeout=0.5)
-                                break
-                            except queue.Empty:
-                                continue
-                        else:
+                        flat = qget(free_slots)
+                        if flat is None:
                             return
                         t0 = _t.perf_counter()
                         data = read_item(w, flat)
                         with tlock:
                             timers["read"] += _t.perf_counter() - t0
-                        while not stop.is_set():
-                            try:
-                                ready.put((flat, data, w), timeout=0.5)
-                                break
-                            except queue.Full:
-                                continue
-                        else:
+                        if not qput(ready, (flat, data, w)):
                             return
-                    while not stop.is_set():
-                        try:
-                            ready.put(None, timeout=0.5)
-                            break
-                        except queue.Full:
-                            continue
+                    qput(ready, None)
+                except BaseException as e:
+                    errors.append(e)
+                    stop.set()
+
+            # the writer pool: items arrive in stripe order (the main
+            # loop combines and enqueues in submission order), so a
+            # writer can coalesce the adjacent spans queued behind its
+            # current item into ONE pwritev per shard file
+            _GROUP_MAX = 8  # spans per coalesced group
+
+            def write_group(group):
+                t0 = _t.perf_counter()
+                v = vols[group[0][0].vol]
+                base_off = group[0][0].shard_off
+                for s in range(TOTAL_SHARDS):
+                    iovs = []
+                    for (w, _flat, data, parity, _pbuf) in group:
+                        src = data if s < DATA_SHARDS else parity
+                        j = s if s < DATA_SHARDS else s - DATA_SHARDS
+                        for r in range(w.rows):
+                            iovs.append(src[r, j])
+                    v.write(s, iovs, base_off)
+                with tlock:
+                    timers["write"] += _t.perf_counter() - t0
+                for (_w, flat, _data, _parity, pbuf) in group:
+                    free_slots.put(flat)
+                    parity_free.put(pbuf)
+
+            def writer_loop():
+                carry = None
+                try:
+                    while True:
+                        if carry is not None:
+                            item, carry = carry, None
+                        else:
+                            item = qget(write_q)
+                        if item is None:
+                            return
+                        group = [item]
+                        rows = item[0].rows
+                        while len(group) < _GROUP_MAX:
+                            try:
+                                nxt = write_q.get_nowait()
+                            except queue.Empty:
+                                break
+                            if nxt is None:
+                                # a sibling's sentinel: hand it back
+                                write_q.put(None)
+                                break
+                            lw, nw = group[-1][0], nxt[0]
+                            if (nw.vol != lw.vol
+                                    or nw.shard_off != lw.shard_off
+                                    + lw.rows * lw.length
+                                    or rows + nw.rows > _IOV_MAX):
+                                carry = nxt
+                                break
+                            group.append(nxt)
+                            rows += nw.rows
+                        write_group(group)
                 except BaseException as e:
                     errors.append(e)
                     stop.set()
 
             rt = threading.Thread(target=reader, daemon=True)
             rt.start()
+            wthreads = [threading.Thread(target=writer_loop, daemon=True)
+                        for _ in range(nwriters)]
+            for wt in wthreads:
+                wt.start()
             pool = ThreadPoolExecutor(max_workers=nworkers)
             # keep up to nworkers+1 items in flight; combine in order
+            # (per-file CRCs chain in stripe order, and in-order hand-off
+            # is what lets the writer pool coalesce adjacent spans)
             pending: list = []
             try:
                 done = False
@@ -632,39 +901,67 @@ def _encode_units_host(plans, units, chunk, host_codec,
                         done = True
                     else:
                         flat, data, w = item
+                        fn = encode_item if write_behind else \
+                            encode_write_item
                         pending.append(
-                            (w, flat, pool.submit(compute_write, w, data)))
+                            (w, flat, data, pool.submit(fn, w, data)))
                     while pending and (len(pending) > nworkers or done):
-                        w, flat, fut = pending.pop(0)
-                        combine(w, fut.result())
-                        free_slots.put(flat)
+                        w, flat, data, fut = pending.pop(0)
+                        if write_behind:
+                            pbuf, parity, crcs = fut.result()
+                            combine(w, crcs)
+                            if not qput(write_q,
+                                        (w, flat, data, parity, pbuf)):
+                                break
+                        else:
+                            combine(w, fut.result())
+                            free_slots.put(flat)
+                for _ in range(nwriters):
+                    qput(write_q, None)
+                for wt in wthreads:
+                    wt.join(timeout=600)
                 if errors:
                     raise errors[0]
             except BaseException:
                 stop.set()
+                if errors:  # the root cause, not a secondary unwind
+                    raise errors[0] from None
                 raise
             finally:
                 stop.set()
                 pool.shutdown(wait=True)
                 rt.join(timeout=30)
+                for wt in wthreads:
+                    wt.join(timeout=5)
     finally:
         for fd in dat_fds:
             os.close(fd)
         for v in vols.values():
             v.close()
 
+    wall = _t.perf_counter() - wall0
+    # the pacer flushes inside timed write sections: attribute its time
+    # to the flush stage, not double-counted under write
+    timers["flush"] = pacer.flush_seconds
+    timers["write"] = max(0.0, timers["write"] - pacer.flush_seconds)
     if stage_stats is not None:
-        wall = _t.perf_counter() - wall0
         stage_stats.update({k: round(v, 3) for k, v in timers.items()})
         stage_stats["wall"] = round(wall, 3)
         stage_stats["workers"] = nworkers
+        stage_stats["writers"] = nwriters
+        stage_stats["write_behind"] = write_behind
         stage_stats["fused"] = fused
         stage_stats["items"] = len(items)
-        for k in ("read", "encode_crc", "write"):
+        stage_stats["flushes"] = pacer.flushes
+        for k in ("read", "encode_crc", "write", "flush"):
             stage_stats[f"{k}_frac"] = (
                 round(timers[k] / wall, 3) if wall > 0 else 0.0)
     from ..stats import metrics as stats
     stats.EcEncodeBytesCounter.inc(sum(p.dat_size for p in plans))
+    for k, v in timers.items():
+        stats.EcEncodeStageSeconds.labels(k).set(round(v, 3))
+    if pacer.flushes:
+        stats.EcWritebackFlushCounter.inc(pacer.flushes)
     return {p.base: vols[vi].crcs for vi, p in enumerate(plans)}
 
 
@@ -741,8 +1038,38 @@ def rebuild_shards(base: str, mesh=None,
     sharding = NamedSharding(mesh, P("data", None, "block"))
 
     inputs = [open(base + to_ext(i), "rb") for i in chosen]
-    outputs = {sid: open(base + to_ext(sid), "wb") for sid in missing}
+    _, _, flush_bytes, drop_cache = _write_knobs()
+    pacer = _WritebackPacer(flush_bytes, drop_cache)
+    out_fds = {sid: os.open(base + to_ext(sid),
+                            os.O_CREAT | os.O_TRUNC | os.O_WRONLY, 0o644)
+               for sid in missing}
+    for fd in out_fds.values():
+        os.ftruncate(fd, shard_size)
     crcs = {sid: 0 for sid in missing}
+    # write-behind: rebuilt batches are handed to a writer thread so the
+    # next device dispatch isn't serialized behind checked pwritevs; the
+    # pacer keeps large rebuilds from stalling on dirty-page writeback
+    werrs: list[BaseException] = []
+    wq: "queue.Queue" = queue.Queue(maxsize=2)
+
+    def wb_writer():
+        try:
+            while True:
+                item = wq.get()
+                if item is None:
+                    return
+                batch_offs, out = item
+                for k, off in enumerate(batch_offs):
+                    width = min(chunk, shard_size - off)
+                    for j, sid in enumerate(missing):
+                        fd = out_fds[sid]
+                        _pwritev_full(fd, [out[k, j, :width]], off)
+                        pacer.wrote(fd, off, width)
+        except BaseException as e:
+            werrs.append(e)
+
+    wt = threading.Thread(target=wb_writer, daemon=True)
+    wt.start()
     try:
         inflight: list = []
 
@@ -754,8 +1081,6 @@ def rebuild_shards(base: str, mesh=None,
                 width = min(chunk, shard_size - off)
                 fin = finalize(raw[k], chunk)
                 for j, sid in enumerate(missing):
-                    outputs[sid].seek(off)
-                    outputs[sid].write(out[k, j, :width])
                     # chunks are full except possibly the last; a short
                     # final chunk was zero-padded on device, and CRCs of
                     # zero-extended data un-extend via combine algebra
@@ -763,7 +1088,14 @@ def rebuild_shards(base: str, mesh=None,
                         crc_host.crc32c(out[k, j, :width].tobytes())
                     crcs[sid] = crc_host.crc32c_combine(
                         crcs[sid], chunk_crc, width)
-            return None
+            while True:  # `out` is fresh per drain — safe to hand off
+                if werrs:
+                    raise werrs[0]
+                try:
+                    wq.put((batch_offs, out), timeout=0.5)
+                    return None
+                except queue.Full:
+                    continue
 
         # two staging buffers: a buffer is refilled only after its batch
         # drained (which implies the host->device transfer completed)
@@ -790,8 +1122,15 @@ def rebuild_shards(base: str, mesh=None,
         while inflight:
             drain_one()
     finally:
+        try:
+            wq.put(None, timeout=5)
+        except queue.Full:
+            pass
+        wt.join(timeout=120)
         for f in inputs:
             f.close()
-        for f in outputs.values():
-            f.close()
+        for fd in out_fds.values():
+            os.close(fd)
+    if werrs:
+        raise werrs[0]
     return crcs

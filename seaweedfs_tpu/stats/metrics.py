@@ -300,6 +300,12 @@ VolumeFsyncBatchCounter = REGISTRY.counter(
 EcEncodeBytesCounter = REGISTRY.counter(
     "SeaweedFS_volumeServer_ec_encode_bytes_total",
     "volume bytes pushed through the batched EC encode pipeline")
+EcEncodeStageSeconds = REGISTRY.gauge(
+    "SeaweedFS_volumeServer_ec_encode_stage_seconds",
+    "busy seconds per host EC encode stage, last encode run", ("stage",))
+EcWritebackFlushCounter = REGISTRY.counter(
+    "SeaweedFS_volumeServer_ec_writeback_flushes_total",
+    "sync_file_range writeback-pacing windows flushed by EC writers")
 FilerChunkCacheCounter = REGISTRY.counter(
     "SeaweedFS_filer_chunk_cache_total",
     "filer chunk cache lookups", ("result",))

@@ -47,7 +47,8 @@ def write_ec_files(base_file_name: str, encoder=None,
                    large_block_size: int = LARGE_BLOCK_SIZE,
                    small_block_size: int = SMALL_BLOCK_SIZE,
                    chunk_bytes: int = DEFAULT_CHUNK_BYTES,
-                   batched: Optional[bool] = None):
+                   batched: Optional[bool] = None,
+                   stage_stats: Optional[dict] = None):
     """Generate .ec00..ec13 from .dat (WriteEcFiles, ec_encoder.go:57-59).
 
     Default path (no explicit codec): auto-selected by PREDICTED
@@ -61,6 +62,10 @@ def write_ec_files(base_file_name: str, encoder=None,
     `encoder` (or batched=False) forces the host loop; batched=True
     forces the device pipeline (-ec.backend=tpu).  A wedged JAX backend
     falls back to the host codec rather than hanging a daemon.
+
+    stage_stats: optional dict the host pipeline fills with per-stage
+    busy seconds (read / encode_crc / write / flush) and fractions —
+    see parallel/batched_encode._encode_units_host.
     """
     auto_host = False
     if batched is None:
@@ -73,7 +78,8 @@ def write_ec_files(base_file_name: str, encoder=None,
 
         crcs = encode_volumes([base_file_name],
                               large_block=large_block_size,
-                              small_block=small_block_size)
+                              small_block=small_block_size,
+                              stage_stats=stage_stats)
         return crcs[base_file_name]
     if auto_host:
         # auto-selection rejected the (link-capped) device path: run the
@@ -86,7 +92,8 @@ def write_ec_files(base_file_name: str, encoder=None,
         crcs = encode_volumes([base_file_name],
                               large_block=large_block_size,
                               small_block=small_block_size,
-                              host_codec=True)
+                              host_codec=True,
+                              stage_stats=stage_stats)
         return crcs[base_file_name]
     if encoder is None:
         # explicit batched=False: the reference-architecture synchronous

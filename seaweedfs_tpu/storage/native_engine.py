@@ -89,7 +89,7 @@ def lib() -> Optional[ctypes.CDLL]:
     cdll.svn_ec_serve.argtypes = [_u32, _i64]
     cdll.svn_ec_unregister.argtypes = [_i64]
     cdll.svn_ec_refresh.argtypes = [_i64]
-    cdll.svn_set_ttl.argtypes = [_i64, _i64]
+    cdll.svn_set_ttl.argtypes = [_i64, _i64, _u32]
     cdll.svn_set_replication.argtypes = [_i64, ctypes.c_int]
     cdll.svn_set_replicas.argtypes = [_u32, ctypes.c_char_p]
     cdll.svn_server_set_jwt.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
@@ -134,7 +134,8 @@ class NativeNeedleMap:
 
     def __init__(self, dat_path: str, idx_path: str, version: int,
                  writable: bool, read_only: bool, fsync: bool,
-                 ttl_sec: int = 0, extra_copies: int = 0):
+                 ttl_sec: int = 0, extra_copies: int = 0,
+                 ttl_raw: int = 0):
         self._lib = lib()
         if self._lib is None:
             raise RuntimeError("native engine unavailable")
@@ -146,7 +147,9 @@ class NativeNeedleMap:
             raise OSError(-h, f"svn_register({dat_path!r}) failed")
         self.handle = h
         if ttl_sec:
-            self._lib.svn_set_ttl(h, int(ttl_sec))
+            # ttl_raw = the volume TTL's (count<<8)|unit form: native
+            # writes stamp FlagHasTtl + these 2 bytes into each needle
+            self._lib.svn_set_ttl(h, int(ttl_sec), int(ttl_raw))
         if extra_copies:
             self._lib.svn_set_replication(h, int(extra_copies))
 
@@ -385,17 +388,27 @@ def server_set_redirect(addr: str):
         cdll.svn_server_set_redirect(addr.encode())
 
 
-def server_set_jwt(write_key: str | bytes = "", read_key: str | bytes = "",
+def server_set_jwt(write_key: str | bytes | None = "",
+                   read_key: str | bytes | None = "",
                    expire_s: int = 10):
     """Configure HS256 signing keys for the fast-path port (writes
     require fid-scoped tokens; reads too when read_key is set).  The
-    'A' assign handler mints matching write tokens."""
+    'A' assign handler mints matching write tokens.
+
+    The keys are engine-global and shared by every in-process daemon:
+    pass None to leave a key untouched, so one owner (e.g. a master
+    shutting down) can clear ITS key without clearing the other
+    daemon's.  Empty string explicitly disables a key."""
     cdll = lib()
     if cdll is None:
         return
-    wk = write_key.encode() if isinstance(write_key, str) else bytes(write_key)
-    rk = read_key.encode() if isinstance(read_key, str) else bytes(read_key)
-    cdll.svn_server_set_jwt(wk, rk, int(expire_s))
+
+    def enc(k):
+        if k is None:
+            return None
+        return k.encode() if isinstance(k, str) else bytes(k)
+
+    cdll.svn_server_set_jwt(enc(write_key), enc(read_key), int(expire_s))
 
 
 def set_replicas(vid: int, addrs: list[str]):

@@ -267,7 +267,8 @@ class Volume:
                     ttl_sec=self.ttl.minutes() * 60 if self.ttl else 0,
                     extra_copies=(
                         self.super_block.replica_placement.copy_count()
-                        - 1))
+                        - 1),
+                    ttl_raw=self.ttl.to_uint32() if self.ttl else 0)
             except (OSError, RuntimeError):
                 pass
         kind = ("memory" if self.needle_map_kind == "native"

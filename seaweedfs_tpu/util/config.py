@@ -10,8 +10,15 @@ weed/util/config.go).  Python 3.11+ ships tomllib, so parsing is stdlib.
 from __future__ import annotations
 
 import os
-import tomllib
 from typing import Any, Optional
+
+try:
+    import tomllib
+except ModuleNotFoundError:  # tomllib is 3.11+; tomli is its backport
+    try:
+        import tomli as tomllib
+    except ModuleNotFoundError:
+        tomllib = None
 
 _SEARCH_DIRS = [".", os.path.expanduser("~/.seaweedfs"), "/etc/seaweedfs"]
 
@@ -59,6 +66,9 @@ def load_configuration(name: str, required: bool = False,
     for d in search_dirs or _SEARCH_DIRS:
         path = os.path.join(d, name + ".toml")
         if os.path.isfile(path):
+            if tomllib is None:
+                # env overrides still apply via Configuration.get
+                return Configuration({})
             with open(path, "rb") as f:
                 return Configuration(tomllib.load(f))
     if required:

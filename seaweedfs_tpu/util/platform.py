@@ -11,6 +11,7 @@ probe per window, not one per operation.
 
 from __future__ import annotations
 
+import os
 import subprocess
 import sys
 import threading
@@ -20,6 +21,18 @@ _INIT_TIMEOUT_S = 30.0
 _NEGATIVE_TTL_S = 300.0
 _lock = threading.Lock()
 _cache: dict = {}  # {"ready": bool, "platform": str, "at": monotonic}
+
+
+def available_cpu_count() -> int:
+    """Cores THIS process may run on: the scheduling affinity mask when
+    the platform exposes it (cgroup cpusets, taskset, k8s cpu-manager
+    pins all shrink it below os.cpu_count()), else os.cpu_count().
+    Worker-pool sizing must use this — spawning os.cpu_count() workers
+    onto an affinity-restricted box just convoys them on the GIL."""
+    try:
+        return len(os.sched_getaffinity(0)) or 1
+    except (AttributeError, OSError):
+        return os.cpu_count() or 1
 
 
 def _parent_platforms() -> str:
@@ -166,10 +179,8 @@ def host_codec_gibps() -> float:
         # side: ~1.2 GiB/s of read+write per I/O-overlapping worker
         # (measured: single-core tmpfs page-allocation bound), scaling
         # with the worker fan-out on multi-core hosts
-        import os
-
         workers = int(os.environ.get("WEED_EC_HOST_WORKERS", "0") or 0) \
-            or max(1, min(16, os.cpu_count() or 1))
+            or max(1, min(16, available_cpu_count()))
         rate = min(kernel * 0.75, 1.2 * workers)
     except Exception:
         rate = 0.05  # pure-python/numpy fallback territory

@@ -21,7 +21,12 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    # newer jax spells the device count as a config option; older
+    # versions only honour the XLA_FLAGS form set above
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    pass
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO_ROOT not in sys.path:

@@ -137,8 +137,6 @@ class TestBackendAutoSelection:
     item 2); -ec.backend=tpu still forces the device pipeline."""
 
     def test_slow_link_prefers_host_codec(self, tmp_path, monkeypatch):
-        import os as _os
-
         from seaweedfs_tpu.util import platform as plat
 
         monkeypatch.setattr(plat, "_probe", lambda t: (True, "tpu"))
@@ -147,8 +145,9 @@ class TestBackendAutoSelection:
         assert plat.predicted_batched_gibps() < 0.01
         assert plat.prefer_batched_encode() is False
         # multi-core host: the fallback is the PIPELINED host mode,
-        # which still returns shard CRCs
-        monkeypatch.setattr(_os, "cpu_count", lambda: 8)
+        # which still returns shard CRCs (worker sizing reads
+        # available_cpu_count — the affinity mask, not os.cpu_count)
+        monkeypatch.setattr(plat, "available_cpu_count", lambda: 8)
         base = _make_volume(tmp_path, "slow", 12345, 5)
         crcs = ec_encoder.write_ec_files(base, large_block_size=LARGE,
                                          small_block_size=SMALL)
@@ -158,7 +157,7 @@ class TestBackendAutoSelection:
         # 1-core host: the host pipeline runs inline (no reader thread /
         # worker pool — they convoy the GIL on one core) but still
         # produces identical shards and fused CRCs
-        monkeypatch.setattr(_os, "cpu_count", lambda: 1)
+        monkeypatch.setattr(plat, "available_cpu_count", lambda: 1)
         base2 = _make_volume(tmp_path, "slow1c", 12345, 5)
         crcs2 = ec_encoder.write_ec_files(base2, large_block_size=LARGE,
                                           small_block_size=SMALL)
@@ -377,3 +376,133 @@ def test_host_pipeline_large_block_col_chunks(tmp_path):
             got = a.read()
             assert got == b.read(), f"shard {i}"
         assert crcs[i] == crc_host.crc32c(got), f"crc {i}"
+
+
+class TestWriteBehindStage:
+    """The decoupled writer stage (three-stage host pipeline): async
+    write-behind must be byte- and CRC-identical to the inline path,
+    partial pwritev must hard-fail the encode, and the stage-stats
+    schema must attribute write and flush separately."""
+
+    def _encode(self, tmp_path, monkeypatch, tag, size=1_234_567, seed=21,
+                **env):
+        base = _make_volume(tmp_path, tag, size, seed)
+        for k, v in env.items():
+            monkeypatch.setenv(k, v)
+        st: dict = {}
+        crcs = encode_volumes([base], large_block=LARGE, small_block=SMALL,
+                              host_codec=True, stage_stats=st)[base]
+        return base, crcs, st
+
+    def test_write_behind_matches_inline(self, tmp_path, monkeypatch):
+        """Async write-behind (4 workers, 3 writers, tiny pacing window)
+        produces shards byte- and CRC-identical to the single-threaded
+        inline path on the same input."""
+        b_async, c_async, st = self._encode(
+            tmp_path, monkeypatch, "wb",
+            WEED_EC_HOST_WORKERS="4", WEED_EC_WRITERS="3",
+            WEED_EC_WRITE_BEHIND="1", WEED_EC_WRITE_FLUSH_MB="1")
+        assert st["write_behind"] is True and st["writers"] == 3
+        b_inline, c_inline, st2 = self._encode(
+            tmp_path, monkeypatch, "inl", WEED_EC_HOST_WORKERS="1")
+        assert st2["write_behind"] is False and st2["writers"] == 0
+        assert c_async == c_inline
+        for i in range(14):
+            with open(b_async + to_ext(i), "rb") as a, \
+                    open(b_inline + to_ext(i), "rb") as b:
+                got = a.read()
+                assert got == b.read(), f"shard {i}"
+            assert c_async[i] == crc_host.crc32c(got), f"crc {i}"
+
+    def test_sync_mode_knob_matches(self, tmp_path, monkeypatch):
+        """WEED_EC_WRITE_BEHIND=0 degrades to the two-stage form
+        (compute workers write synchronously) with identical output."""
+        b_sync, c_sync, st = self._encode(
+            tmp_path, monkeypatch, "sync",
+            WEED_EC_HOST_WORKERS="4", WEED_EC_WRITE_BEHIND="0")
+        assert st["write_behind"] is False and st["writers"] == 0
+        b_inline, c_inline, _ = self._encode(
+            tmp_path, monkeypatch, "sref", WEED_EC_HOST_WORKERS="1")
+        assert c_sync == c_inline
+        for i in range(14):
+            with open(b_sync + to_ext(i), "rb") as a, \
+                    open(b_inline + to_ext(i), "rb") as b:
+                assert a.read() == b.read(), f"shard {i}"
+
+    def test_stage_stats_schema(self, tmp_path, monkeypatch):
+        """With the writer stage enabled and >=2 workers, stage stats
+        attribute read / encode_crc / write / flush separately, plus the
+        pipeline-shape fields bench.py reports."""
+        _, _, st = self._encode(
+            tmp_path, monkeypatch, "ss",
+            WEED_EC_HOST_WORKERS="2", WEED_EC_WRITE_BEHIND="1",
+            WEED_EC_WRITERS="0", WEED_EC_WRITE_FLUSH_MB="1")
+        for k in ("read", "encode_crc", "write", "flush", "wall"):
+            assert isinstance(st[k], float), k
+            assert st[k] >= 0.0, k
+        for k in ("read", "encode_crc", "write", "flush"):
+            assert isinstance(st[f"{k}_frac"], float), k
+        assert st["workers"] == 2
+        assert st["writers"] >= 1          # auto: workers//2, min 1
+        assert st["write_behind"] is True
+        assert isinstance(st["flushes"], int)
+        assert st["items"] >= 1
+        # busy seconds never double-count: write excludes flush time
+        assert st["write"] + st["flush"] <= st["wall"] * (st["workers"] + 1)
+
+    @pytest.mark.parametrize("workers", ["1", "4"])
+    def test_partial_pwritev_zero_progress_is_hard_error(
+            self, tmp_path, monkeypatch, workers):
+        """A pwritev that makes no progress must fail the encode — never
+        silently truncate a shard whose CRC was computed from memory."""
+        base = _make_volume(tmp_path, f"zp{workers}", 123_456, 7)
+        monkeypatch.setenv("WEED_EC_HOST_WORKERS", workers)
+        monkeypatch.setattr(os, "pwritev", lambda fd, bufs, off: 0)
+        with pytest.raises(OSError, match="no progress"):
+            encode_volumes([base], large_block=LARGE, small_block=SMALL,
+                           host_codec=True)
+
+    def test_short_pwritev_retries_to_full_length(self, tmp_path,
+                                                  monkeypatch):
+        """Transient short kernel writes (partial progress) are retried
+        from where the kernel stopped until every byte lands — output
+        stays byte-identical."""
+        real_pwritev = os.pwritev
+        calls = {"n": 0}
+
+        def short_pwritev(fd, bufs, offset):
+            calls["n"] += 1
+            mv = memoryview(bufs[0]).cast("B")
+            # write at most half of the first iovec (>=1 byte)
+            return real_pwritev(fd, [mv[:max(1, mv.nbytes // 2)]], offset)
+
+        base = _make_volume(tmp_path, "short", 234_567, 13)
+        monkeypatch.setenv("WEED_EC_HOST_WORKERS", "2")
+        monkeypatch.setattr(os, "pwritev", short_pwritev)
+        crcs = encode_volumes([base], large_block=LARGE, small_block=SMALL,
+                              host_codec=True)[base]
+        monkeypatch.setattr(os, "pwritev", real_pwritev)
+        assert calls["n"] > 0
+        ref = _host_reference(tmp_path, base, "shortref")
+        for i in range(14):
+            with open(base + to_ext(i), "rb") as a, \
+                    open(ref + to_ext(i), "rb") as b:
+                got = a.read()
+                assert got == b.read(), f"shard {i}"
+            assert crcs[i] == crc_host.crc32c(got), f"crc {i}"
+
+    def test_pwritev_full_unit(self, tmp_path):
+        """_pwritev_full unit coverage: multi-iovec writes land fully at
+        the right offset; zero progress raises."""
+        from seaweedfs_tpu.parallel.batched_encode import _pwritev_full
+
+        path = str(tmp_path / "f")
+        fd = os.open(path, os.O_CREAT | os.O_WRONLY, 0o644)
+        try:
+            bufs = [b"aa", b"bbb", b"cccc"]
+            n = _pwritev_full(fd, bufs, 3)
+            assert n == 9
+        finally:
+            os.close(fd)
+        with open(path, "rb") as f:
+            assert f.read() == b"\0\0\0aabbbcccc"

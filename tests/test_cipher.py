@@ -10,8 +10,12 @@ import pytest
 from seaweedfs_tpu.filer.server import FilerServer
 from seaweedfs_tpu.master.server import MasterServer
 from seaweedfs_tpu.rpc.http_rpc import call
-from seaweedfs_tpu.util.cipher import decrypt, encrypt, gen_cipher_key
+from seaweedfs_tpu.util.cipher import (cipher_available, decrypt, encrypt,
+                                       gen_cipher_key)
 from seaweedfs_tpu.volume_server.server import VolumeServer
+
+pytestmark = pytest.mark.skipif(
+    not cipher_available(), reason="cryptography (AES-256-GCM) unavailable")
 
 
 class TestCipherPrimitives:

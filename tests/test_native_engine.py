@@ -423,8 +423,10 @@ class TestVolumeServerIntegration:
         through a 1-second-TTL native map, then age past the TTL."""
         v = Volume(str(tmp_path), "", 41)
         # rebind the map with a 1 s TTL (TTL.parse's floor is 1 minute —
-        # too slow for a test)
-        ne.lib().svn_set_ttl(v.nm.handle, 1)
+        # too slow for a test); ttl_raw as a 1-minute volume would stamp
+        from seaweedfs_tpu.storage.ttl import TTL
+
+        ne.lib().svn_set_ttl(v.nm.handle, 1, TTL.parse("1m").to_uint32())
         ne.serve_volume(41, v.nm)
         st, _ = raw_request(native_server, b"W 41,7aabbccdd 7\nexpires")
         assert st == 0
@@ -434,6 +436,27 @@ class TestVolumeServerIntegration:
         st, _ = raw_request(native_server, b"G 41,7aabbccdd\n")
         assert st == 404
         ne.unserve_volume(41)
+        v.close()
+
+    def test_native_write_stamps_ttl_flag(self, tmp_path, native_server):
+        """Needles written through the native port on a TTL volume must
+        carry FlagHasTtl plus the volume's 2-byte TTL (needle.go
+        ParseAppendAtNs path), so Python-side reads, vacuum, and export
+        see the same expiry a Python-written needle would."""
+        from seaweedfs_tpu.storage.ttl import TTL
+
+        ttl = TTL.parse("5m")
+        v = Volume(str(tmp_path), "", 31, ttl=ttl)
+        assert isinstance(v.nm, ne.NativeNeedleMap)
+        ne.serve_volume(31, v.nm)
+        st, _ = raw_request(native_server, b"W 31,10aabbccdd 5\nhello")
+        assert st == 0
+        n = v.read_needle(0x10)
+        assert n.data == b"hello"
+        assert n.has_last_modified and n.last_modified > 0
+        assert n.has_ttl
+        assert n.ttl.to_uint32() == ttl.to_uint32()
+        ne.unserve_volume(31)
         v.close()
 
     def test_compressed_needle_served_plain(self, cluster):
