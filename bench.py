@@ -441,21 +441,21 @@ def bench_small_file(num_files: int) -> tuple[float, float, float]:
 
 def bench_ec_degraded_read(num_files: int = 2000,
                            read_reqs: int = 10000
-                           ) -> tuple[float, float, float]:
+                           ) -> tuple[float, float, float, dict]:
     """Degraded EC reads: write 1 KB needles, ec.encode the volume, then
     KILL the shards holding the data (delete the files + unmount) and
     measure the reconstruct-path read rate — every read regenerates its
-    span from 10 local survivors through the parallel-survivor path
-    (ec_volume.py _recover_span; store_ec.go:328-382's
+    span through the fast degraded-read path (ec_volume.py
+    _recover_span: decode-plan cache + recovered-block LRU +
+    single-flight; store_ec.go:328-382's
     recoverOneRemoteEcShardInterval).  This is the latency that matters
     mid-incident.  Also measures the NATIVE port's degraded reads (the
     engine reconstructs missing spans from 10 local survivors in C++).
-    Returns (http_reads/s, http_p99_ms, native_reads/s); zeros when
-    unavailable."""
+    Returns (http_reads/s, http_p99_ms, native_reads/s, stage_stats);
+    the Python HTTP path runs with or without the native engine — only
+    the native column is zeroed when the library is missing."""
     from seaweedfs_tpu.storage import native_engine
 
-    if not native_engine.available():
-        return 0.0, 0.0, 0.0
     import tempfile
 
     from seaweedfs_tpu.master.server import MasterServer
@@ -517,6 +517,11 @@ def bench_ec_degraded_read(num_files: int = 2000,
         got = call(vs.store.url, f"/{fids[0]}")
         assert got == payload, "degraded read returned wrong bytes"
 
+        from seaweedfs_tpu.storage.erasure_coding.recover import \
+            STATS as RECOVER_STATS
+
+        RECOVER_STATS.reset()  # count only the timed phase
+
         import concurrent.futures as cf
 
         lat: list[float] = []
@@ -536,11 +541,13 @@ def bench_ec_degraded_read(num_files: int = 2000,
         secs = time.perf_counter() - t0
         lat.sort()
         p99 = lat[int(len(lat) * 0.99) - 1] if lat else 0.0
+        stages = RECOVER_STATS.snapshot(wall=secs)
 
         # native-port degraded reads: C++ reconstructs each span from
         # the 10 local survivors (zero GIL involvement)
         native_rps = 0.0
-        if getattr(vs, "_native_owner", False) and vs.tcp_port:
+        if (native_engine.available()
+                and getattr(vs, "_native_owner", False) and vs.tcp_port):
             nsecs, nerrs, _ = native_engine.bench(
                 "127.0.0.1", vs.tcp_port, "R", fids,
                 max(read_reqs, 20000), 0, 16)
@@ -549,7 +556,7 @@ def bench_ec_degraded_read(num_files: int = 2000,
                 print(f"note: native degraded read errors: {nerrs}",
                       file=sys.stderr)
             native_rps = (nreq - nerrs) / nsecs if nsecs else 0.0
-        return read_reqs / secs, p99, native_rps
+        return read_reqs / secs, p99, native_rps, stages
     finally:
         vs.stop()
         master.stop()
@@ -924,8 +931,10 @@ def main():
 
     # -- degraded EC reads (4 shards dead, reconstruct per read) -------------
     deg_rps = deg_p99 = deg_native_rps = 0.0
+    deg_stages: dict = {}
     try:
-        deg_rps, deg_p99, deg_native_rps = bench_ec_degraded_read()
+        deg_rps, deg_p99, deg_native_rps, deg_stages = \
+            bench_ec_degraded_read()
     except Exception as e:
         print(f"note: degraded-read bench failed: {e}", file=sys.stderr)
 
@@ -987,6 +996,7 @@ def main():
         "ec_degraded_read_rps": round(deg_rps, 1),
         "ec_degraded_read_p99_ms": round(deg_p99, 2),
         "ec_degraded_read_native_rps": round(deg_native_rps, 1),
+        "ec_degraded_read_stages": deg_stages,
         "s3_put_rps": round(s3_stats.get("s3_put_rps", 0.0), 1),
         "s3_get_rps": round(s3_stats.get("s3_get_rps", 0.0), 1),
         "filer_put_rps": round(s3_stats.get("filer_put_rps", 0.0), 1),

@@ -14,12 +14,15 @@ selected by backend the way the north-star design selects `-ec.backend=tpu`:
 from __future__ import annotations
 
 import ctypes
+import os
 
 import numpy as np
 
 from . import native
 from ..util.platform import on_tpu
-from .rs_numpy import NumpyEncoder, ReconstructError, RSCodecBase  # noqa: F401
+from .rs_numpy import (NumpyEncoder, ReconstructError,  # noqa: F401
+                       RSCodecBase, decode_plan_cache_info, decode_rows,
+                       gf_apply_matrix)
 
 
 class NativeEncoder(RSCodecBase):
@@ -86,6 +89,52 @@ class NativeEncoder(RSCodecBase):
             parity_out.ctypes.data_as(ctypes.c_char_p), crcs,
         )
         return list(crcs)
+
+
+# Spans below this stay on the host codec: a device dispatch + two link
+# round-trips cost more than the mat-vec itself for small recoveries.
+_RECOVER_DEVICE_MIN_BYTES = int(
+    os.environ.get("WEED_EC_RECOVER_DEVICE_MIN_KB", "512") or 0) << 10
+
+
+def _apply_rows_host(rows: np.ndarray, inputs: np.ndarray) -> np.ndarray:
+    """(t, d) decode rows x (d, L) survivor spans on the best host
+    backend: the native kernel ladder when built, else NumPy tables."""
+    lib = native.lib()
+    if lib is None:
+        return gf_apply_matrix(rows, inputs)
+    t, d = rows.shape
+    length = inputs.shape[1]
+    rows = np.ascontiguousarray(rows, dtype=np.uint8)
+    inputs = np.ascontiguousarray(inputs, dtype=np.uint8)
+    out = np.zeros((t, length), dtype=np.uint8)
+    lib.sw_gf_apply_matrix(
+        rows.ctypes.data_as(ctypes.c_char_p), t, d,
+        inputs.ctypes.data_as(ctypes.c_char_p), length,
+        out.ctypes.data_as(ctypes.c_char_p))
+    return out
+
+
+def reconstruct_span(survivors, inputs: np.ndarray, target: int,
+                     data_shards: int = 10,
+                     total_shards: int = 14) -> np.ndarray:
+    """Target-row reconstruction: rebuild ONE shard's span from the
+    (d, L) survivor stack via the cached decode plan — one GF mat-vec,
+    never a full Reconstruct.  `inputs[i]` must be the span read from
+    `survivors[i]`.  L may be many coalesced spans laid end to end (the
+    batched multi-span decode): the math is column-wise, so stacking is
+    free.  Dispatch: fused JAX/Pallas kernel for large spans on a TPU,
+    native/NumPy host kernel for small ones."""
+    rows = decode_rows(data_shards, total_shards, survivors, (target,))
+    if inputs.nbytes >= _RECOVER_DEVICE_MIN_BYTES and on_tpu():
+        try:
+            from .rs_jax import apply_matrix
+
+            return np.asarray(apply_matrix(
+                np.asarray(rows), inputs, method="pallas"))[0]
+        except Exception:
+            pass  # device hiccup mid-incident: the host path always works
+    return _apply_rows_host(rows, inputs)[0]
 
 
 def new_host_encoder(data_shards: int = 10, parity_shards: int = 4):

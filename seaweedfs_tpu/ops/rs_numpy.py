@@ -15,6 +15,8 @@ shard is missing.  Shards 0..data-1 are systematic data, the rest parity.
 
 from __future__ import annotations
 
+import functools
+
 import numpy as np
 
 from . import gf256
@@ -22,6 +24,59 @@ from . import gf256
 
 class ReconstructError(Exception):
     pass
+
+
+@functools.lru_cache(maxsize=4096)
+def _decode_rows_cached(data_shards: int, total_shards: int,
+                        survivors: tuple, targets: tuple) -> np.ndarray:
+    """The decode-plan cache.  One entry per (survivor-set, target-set):
+    the rows of the decode matrix mapping the chosen survivors directly
+    to the target shards, so a degraded read is ONE (t, d) x (d, L) GF
+    mat-vec instead of a full matrix inversion + Reconstruct per span.
+
+    Keyed on the ordered survivor tuple: rows[i] pairs with the input
+    stacked from survivors[i].  When the survivors are exactly the data
+    shards (0..d-1) the submatrix is the identity and no inversion
+    happens at all — parity targets read their encode rows straight from
+    the encoding matrix."""
+    if len(survivors) != data_shards:
+        raise ReconstructError(
+            f"decode plan needs exactly {data_shards} survivors, "
+            f"got {len(survivors)}")
+    full = gf256.build_matrix(data_shards, total_shards)
+    if list(survivors) == list(range(data_shards)):
+        inv = None  # identity submatrix: skip the O(d^3) inversion
+    else:
+        inv = gf256.gf_invert(full[list(survivors)])
+    rows = []
+    for t in targets:
+        if not 0 <= t < total_shards:
+            raise ReconstructError(f"target shard {t} out of range")
+        if inv is None:
+            rows.append(np.eye(data_shards, dtype=np.uint8)[t]
+                        if t < data_shards else full[t])
+        elif t < data_shards:
+            rows.append(inv[t])
+        else:
+            rows.append(gf256.gf_matmul(full[t:t + 1], inv)[0])
+    out = np.stack(rows).astype(np.uint8)
+    out.setflags(write=False)  # cached: callers must not mutate
+    return out
+
+
+def decode_rows(data_shards: int, total_shards: int,
+                survivors, targets) -> np.ndarray:
+    """(len(targets), data_shards) decode matrix for reconstructing
+    `targets` from inputs stacked in `survivors` order.  Cached per
+    (survivor-set, target-set); the returned array is read-only."""
+    return _decode_rows_cached(data_shards, total_shards,
+                               tuple(int(s) for s in survivors),
+                               tuple(int(t) for t in targets))
+
+
+def decode_plan_cache_info():
+    """lru cache statistics for the decode-plan cache (hits/misses)."""
+    return _decode_rows_cached.cache_info()
 
 
 def gf_apply_matrix(matrix: np.ndarray, inputs: np.ndarray) -> np.ndarray:
@@ -99,13 +154,15 @@ class RSCodecBase:
             )
 
         # Decode matrix: rows of the encoding matrix for the first data_shards
-        # present shards (klauspost picks the same subset), inverted.
-        sub_rows = present[: self.data_shards]
-        inv = gf256.gf_invert(self.matrix[sub_rows])
-        inputs = np.stack([arrs[i] for i in sub_rows])
-
+        # present shards (klauspost picks the same subset), inverted.  When
+        # only parity is missing every data shard is present, the submatrix
+        # is the identity, and the inversion is skipped entirely — parity
+        # regenerates below from the encoding matrix and the data shards.
         missing_data = [i for i in range(self.data_shards) if arrs[i] is None]
         if missing_data:
+            sub_rows = present[: self.data_shards]
+            inv = gf256.gf_invert(self.matrix[sub_rows])
+            inputs = np.stack([arrs[i] for i in sub_rows])
             regenerated = self._apply(inv[missing_data], inputs)
             for out_i, i in enumerate(missing_data):
                 arrs[i] = regenerated[out_i]
@@ -122,6 +179,25 @@ class RSCodecBase:
                 for out_i, i in enumerate(missing_parity):
                     arrs[i] = regenerated[out_i]
         return arrs
+
+    def reconstruct_one(self, shards: list, target: int) -> np.ndarray:
+        """Reconstruct ONLY shard `target` from a klauspost-style shard
+        list (None = missing) — the degraded-read primitive.  Unlike
+        `reconstruct` this never regenerates shards it will not serve:
+        one cached decode row, one 1xd GF mat-vec."""
+        arrs = self._as_arrays(shards)
+        self._check_shape(arrs)
+        if arrs[target] is not None:
+            return arrs[target]
+        present = [i for i, s in enumerate(arrs) if s is not None]
+        if len(present) < self.data_shards:
+            raise ReconstructError(
+                f"too few shards: {len(present)} < {self.data_shards}")
+        survivors = tuple(present[: self.data_shards])
+        rows = decode_rows(self.data_shards, self.total_shards,
+                           survivors, (target,))
+        inputs = np.stack([arrs[i] for i in survivors])
+        return self._apply(rows, inputs)[0]
 
     # -- helpers -----------------------------------------------------------
     @staticmethod

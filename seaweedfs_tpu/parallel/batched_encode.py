@@ -971,19 +971,15 @@ def rebuild_matrix(present: list[int], missing: list[int],
     """(survivor_ids, M) with M (len(missing) x data_shards) mapping the
     chosen survivors directly to the missing shards: data rows come from
     the inverted survivor submatrix, parity rows from encode-rows times
-    that inverse (the one-matmul form of klauspost Reconstruct)."""
-    from ..ops import gf256
+    that inverse (the one-matmul form of klauspost Reconstruct).  Row
+    construction lives in ops.rs_numpy.decode_rows — the same cached
+    decode plans the degraded-read path uses — so a rebuild right after
+    an incident's reads pays zero extra inversions."""
+    from ..ops.rs_numpy import decode_rows
 
-    full = gf256.build_matrix(data_shards, total_shards)
     chosen = present[:data_shards]
-    inv = gf256.gf_invert(full[chosen])
-    rows = []
-    for m in missing:
-        if m < data_shards:
-            rows.append(inv[m])
-        else:
-            rows.append(gf256.gf_matmul(full[m:m + 1], inv)[0])
-    return chosen, np.stack(rows).astype(np.uint8)
+    rows = decode_rows(data_shards, total_shards, chosen, tuple(missing))
+    return chosen, np.array(rows, dtype=np.uint8, copy=True)
 
 
 def rebuild_shards(base: str, mesh=None,

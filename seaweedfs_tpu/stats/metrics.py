@@ -306,6 +306,20 @@ EcEncodeStageSeconds = REGISTRY.gauge(
 EcWritebackFlushCounter = REGISTRY.counter(
     "SeaweedFS_volumeServer_ec_writeback_flushes_total",
     "sync_file_range writeback-pacing windows flushed by EC writers")
+EcRecoverStageSeconds = REGISTRY.gauge(
+    "SeaweedFS_volumeServer_ec_recover_stage_seconds",
+    "cumulative busy seconds per degraded-read stage", ("stage",))
+EcRecoverCacheCounter = REGISTRY.counter(
+    "SeaweedFS_volumeServer_ec_recover_cache_total",
+    "recovered-block cache lookups by outcome "
+    "(hit / miss / coalesced)", ("result",))
+EcRecoverSpanCounter = REGISTRY.counter(
+    "SeaweedFS_volumeServer_ec_recover_spans_total",
+    "spans reconstructed on the degraded-read path, by decode mode",
+    ("mode",))
+EcRecoverBytesCounter = REGISTRY.counter(
+    "SeaweedFS_volumeServer_ec_recover_bytes_total",
+    "survivor bytes pushed through degraded-read decodes")
 FilerChunkCacheCounter = REGISTRY.counter(
     "SeaweedFS_filer_chunk_cache_total",
     "filer chunk cache lookups", ("result",))

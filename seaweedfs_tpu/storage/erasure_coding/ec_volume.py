@@ -17,6 +17,7 @@ from __future__ import annotations
 import os
 import struct
 import threading
+import time
 from typing import Callable, Optional
 
 import numpy as np
@@ -27,6 +28,8 @@ from ..needle import Needle, get_actual_size
 from . import (DATA_SHARDS_COUNT, LARGE_BLOCK_SIZE, SMALL_BLOCK_SIZE,
                TOTAL_SHARDS_COUNT, to_ext)
 from .locate import Interval, locate_data
+from .recover import (STATS as RECOVER_STATS, RecoveredBlockCache,
+                      SpanDecodeBatcher, recover_knobs)
 
 _recover_pool_lock = threading.Lock()
 _recover_pool_inst = None
@@ -169,6 +172,11 @@ class EcVolume:
         # lazy: backend selection probes device availability, which must
         # not stall mount/admin paths — only reconstruction needs it
         self._encoder = encoder
+        # degraded-read machinery: per-volume recovered-block LRU (keys
+        # are shard offsets, which only mean anything within one volume)
+        # + the same-survivor-set span-decode batcher
+        self._recover_cache = RecoveredBlockCache()
+        self._recover_batcher = SpanDecodeBatcher(self._decode_span)
         self._ecx_lock = threading.Lock()
         self._ecj_lock = threading.Lock()
         base = self.base_file_name()
@@ -264,17 +272,93 @@ class EcVolume:
             raise EcError(
                 f"short read shard {shard_id} at {offset}+{size}")
         if self.remote_reader is not None:
-            data = self.remote_reader(shard_id, offset, size)
-            if data is not None:
-                if len(data) != size:
-                    raise EcError(f"short remote read shard {shard_id}")
+            try:
+                data = self.remote_reader(shard_id, offset, size)
+            except Exception:
+                data = None  # unreachable holder: degrade, don't fail
+            if data is not None and len(data) == size:
                 return data
+            # a truncated remote answer degrades to reconstruction too:
+            # the holder is damaged, but >=10 survivors can still serve
         return self._recover_span(shard_id, offset, size)
 
+    def recover_stats(self) -> dict:
+        """This volume's recovered-block cache occupancy + the process'
+        cumulative degraded-read stage stats."""
+        out = RECOVER_STATS.snapshot()
+        out["cache_blocks"] = len(self._recover_cache)
+        out["cache_bytes"] = self._recover_cache.size_bytes
+        return out
+
+    # -- degraded reads -------------------------------------------------------
     def _recover_span(self, target_shard: int, offset: int,
                       size: int) -> bytes:
-        """On-the-fly reconstruction of one missing shard's span from >=10
-        other shards (recoverOneRemoteEcShardInterval, store_ec.go:328-382).
+        """Serve a missing shard's span by reconstruction — the fast
+        degraded-read path.  Recovery is block-aligned: the span's
+        covering WEED_EC_RECOVER_BLOCK_KB blocks are recovered (not the
+        exact span), cached in the bounded per-volume LRU, and served
+        from cache for every later read that lands in them.  Concurrent
+        misses on one block are single-flighted; misses on different
+        blocks that picked the same survivors decode in one stacked GF
+        mat-vec (recover.py).  With no local shard to size blocks
+        against (shard_size unknown) the exact span becomes the unit —
+        still coalesced and cached."""
+        t0 = time.perf_counter()
+        self._tls.busy = 0.0
+        cache_bytes, block, coalesce = recover_knobs()
+        shard_size = self.shard_size
+        if block <= 0 or shard_size <= 0:
+            spans = [(offset, size)]
+        else:
+            lo = (offset // block) * block
+            end = max(offset + size, min(shard_size,
+                                         -(-(offset + size) // block) * block))
+            spans = [(s, min(block, end - s)) for s in range(lo, end, block)]
+        parts = []
+        for bstart, blen in spans:
+            key = (target_shard, bstart, blen)
+            parts.append(self._recover_cache.get_or_recover(
+                key, lambda bs=bstart, bl=blen: self._recover_block(
+                    target_shard, bs, bl),
+                cache_bytes, coalesce))
+        blob = parts[0] if len(parts) == 1 else b"".join(parts)
+        out = blob[offset - spans[0][0]:offset - spans[0][0] + size]
+        if len(out) != size:
+            raise EcError(
+                f"recovered span short for shard {target_shard} at "
+                f"{offset}+{size}: got {len(out)}")
+        RECOVER_STATS.add_stage(
+            "serve", max(0.0, time.perf_counter() - t0
+                         - getattr(self._tls, "busy", 0.0)))
+        return out
+
+    # per-thread fetch+decode busy seconds inside the current span, so
+    # the serve stage reports assembly/wait overhead, not a double count
+    _tls = threading.local()
+
+    def _recover_block(self, target_shard: int, offset: int,
+                       size: int) -> bytes:
+        """One block's survivor fan-out + decode (the single-flight
+        leader's job): fetch >=10 survivor spans, then reconstruct ONLY
+        the target row through the decode-plan cache and the span-decode
+        batcher."""
+        blk0 = time.perf_counter()
+        try:
+            fetch0 = time.perf_counter()
+            survivors, inputs = self._fetch_survivors(
+                target_shard, offset, size)
+            RECOVER_STATS.add_stage("fetch", time.perf_counter() - fetch0)
+            out = self._recover_batcher.decode(
+                survivors, target_shard, inputs)
+            return np.ascontiguousarray(out).tobytes()
+        finally:
+            self._tls.busy = (getattr(self._tls, "busy", 0.0)
+                              + (time.perf_counter() - blk0))
+
+    def _fetch_survivors(self, target_shard: int, offset: int,
+                         size: int) -> tuple[tuple, np.ndarray]:
+        """Collect exactly DATA_SHARDS_COUNT survivor spans for one
+        recovery (recoverOneRemoteEcShardInterval, store_ec.go:328-382).
 
         Survivor fetches fan out in PARALLEL like the reference's
         per-shard goroutines: local shards are read synchronously (disk,
@@ -283,24 +367,23 @@ class EcVolume:
         a degraded read during an outage costs ~one RPC round-trip, not
         ten serial ones.  Queued stragglers are cancelled; in-flight
         ones drain on the shared pool (remote_reader RPCs carry their
-        own timeouts)."""
-        shards: list[Optional[np.ndarray]] = [None] * TOTAL_SHARDS_COUNT
-        have = 0
+        own timeouts).  Returns (sorted survivor ids, (10, L) stack in
+        that order) — the decode-plan cache key and its matching input."""
+        shards: dict[int, np.ndarray] = {}
         remote_candidates: list[int] = []
         for sid in range(TOTAL_SHARDS_COUNT):
             if sid == target_shard:
                 continue
             shard = self.shards.get(sid)
             if shard is not None:
-                if have >= DATA_SHARDS_COUNT:
+                if len(shards) >= DATA_SHARDS_COUNT:
                     continue  # reconstruct needs exactly 10 survivors
                 data = shard.read_at(size, offset)
                 if len(data) == size:
                     shards[sid] = np.frombuffer(data, dtype=np.uint8)
-                    have += 1
             elif self.remote_reader is not None:
                 remote_candidates.append(sid)
-        if have < DATA_SHARDS_COUNT and remote_candidates:
+        if len(shards) < DATA_SHARDS_COUNT and remote_candidates:
             import concurrent.futures as cf
 
             pool = _recover_pool()
@@ -315,21 +398,33 @@ class EcVolume:
                     if data is not None and len(data) == size:
                         shards[futs[fut]] = np.frombuffer(data,
                                                           dtype=np.uint8)
-                        have += 1
-                        if have >= DATA_SHARDS_COUNT:
+                        if len(shards) >= DATA_SHARDS_COUNT:
                             break
             finally:
                 for fut in futs:
                     fut.cancel()
-        if have < DATA_SHARDS_COUNT:
+        if len(shards) < DATA_SHARDS_COUNT:
             raise EcError(
                 f"need {DATA_SHARDS_COUNT} shards to recover shard "
-                f"{target_shard}, only {have} available")
-        if self._encoder is None:
-            self._encoder = codec_mod.new_encoder(
-                DATA_SHARDS_COUNT, TOTAL_SHARDS_COUNT - DATA_SHARDS_COUNT)
-        restored = self._encoder.reconstruct(shards)
-        return np.ascontiguousarray(restored[target_shard]).tobytes()
+                f"{target_shard}, only {len(shards)} available")
+        survivors = tuple(sorted(shards))[:DATA_SHARDS_COUNT]
+        return survivors, np.stack([shards[sid] for sid in survivors])
+
+    def _decode_span(self, survivors: tuple, target: int,
+                     inputs: np.ndarray) -> np.ndarray:
+        """The batcher's decode hook: one cached decode row applied to
+        the (possibly multi-span) survivor stack.  An explicitly-pinned
+        encoder backend decodes through reconstruct_one on that backend;
+        the default rides the size-dispatched reconstruct_span."""
+        if self._encoder is not None:
+            shard_list: list[Optional[np.ndarray]] = \
+                [None] * TOTAL_SHARDS_COUNT
+            for i, sid in enumerate(survivors):
+                shard_list[sid] = inputs[i]
+            return self._encoder.reconstruct_one(shard_list, target)
+        return codec_mod.reconstruct_span(
+            survivors, inputs, target,
+            DATA_SHARDS_COUNT, TOTAL_SHARDS_COUNT)
 
     # -- delete (ec_volume_delete.go) -----------------------------------------
     def delete_needle(self, needle_id: int):
@@ -356,6 +451,7 @@ class EcVolume:
         for shard in self.shards.values():
             shard.close()
         self.shards.clear()
+        self._recover_cache.clear()
         if self._ecx:
             self._ecx.close()
             self._ecx = None

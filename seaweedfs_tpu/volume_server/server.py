@@ -573,6 +573,7 @@ class VolumeServer:
         s.add("POST", "/admin/ec/delete_shards", g(self._h_ec_delete_shards))
         s.add("POST", "/admin/ec/to_volume", g(self._h_ec_to_volume))
         s.add("POST", "/admin/ec/scrub", g(self._h_ec_scrub))
+        s.add("GET", "/admin/ec/recover_stats", g(self._h_ec_recover_stats))
         s.add("GET", "/admin/ec/shard_file", self._h_ec_shard_file)
         s.add("GET", "/admin/ec/shard_read", self._h_ec_shard_read)
         s.add("POST", "/admin/volume/configure_replication",
@@ -1346,6 +1347,23 @@ class VolumeServer:
         loc.add_volume(vid, collection)
         self._try_heartbeat()
         return {}
+
+    def _h_ec_recover_stats(self, req: Request):
+        """Degraded-read telemetry: the process-wide stage/cache stats
+        plus each mounted EC volume's recovered-block cache occupancy
+        (same numbers the Prometheus ec_recover_* vectors export)."""
+        from ..storage.erasure_coding.recover import STATS
+
+        out = STATS.snapshot()
+        volumes = {}
+        for loc in self.store.locations:
+            for vid, ev in loc.ec_volumes.items():
+                volumes[str(vid)] = {
+                    "cache_blocks": len(ev._recover_cache),
+                    "cache_bytes": ev._recover_cache.size_bytes,
+                }
+        out["volumes"] = volumes
+        return out
 
     def _h_ec_shard_file(self, req: Request):
         vid = int(req.param("volume", "0"))

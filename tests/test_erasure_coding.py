@@ -237,6 +237,176 @@ class TestEncode:
         ev.close()
 
 
+class TestDegradedReadPath:
+    """The fast degraded-read pipeline: recovered-block cache,
+    single-flight coalescing, and decode-plan integrity under survivor
+    faults (recover.py + ec_volume.py _recover_span)."""
+
+    def _volume_without_local_shards(self, encoded):
+        base, d = encoded
+        shard_bytes = {i: open(base + to_ext(i), "rb").read()
+                       for i in range(TOTAL_SHARDS_COUNT)}
+        ev = EcVolume(d, "", 1, large_block_size=LARGE,
+                      small_block_size=SMALL)
+        return ev, shard_bytes
+
+    def test_single_flight_one_fanout_for_concurrent_readers(self, encoded):
+        """16 concurrent readers of one dead span must trigger ONE
+        survivor fan-out (<= 13 survivor fetches), not sixteen."""
+        import threading
+        import time as _t
+
+        ev, shard_bytes = self._volume_without_local_shards(encoded)
+        survivor_calls = []
+        calls_lock = threading.Lock()
+        gate = threading.Barrier(17)  # 16 readers + main
+
+        def slow_remote(sid, offset, size):
+            if sid == 0:  # the target shard is lost cluster-wide
+                return None
+            with calls_lock:
+                survivor_calls.append(sid)
+            _t.sleep(0.05)  # keep the flight open while followers pile in
+            return shard_bytes[sid][offset:offset + size]
+
+        ev.remote_reader = slow_remote
+        results = [None] * 16
+
+        def reader(i):
+            gate.wait()
+            results[i] = ev.read_shard_span(0, 0, 64)
+
+        threads = [threading.Thread(target=reader, args=(i,))
+                   for i in range(16)]
+        for th in threads:
+            th.start()
+        gate.wait()
+        for th in threads:
+            th.join()
+        assert all(r == shard_bytes[0][:64] for r in results)
+        # one fan-out submits at most the 13 survivor candidates; a
+        # second fan-out would at least double that
+        assert len(survivor_calls) <= TOTAL_SHARDS_COUNT - 1, (
+            f"{len(survivor_calls)} survivor fetches for 16 readers")
+        ev.close()
+
+    def test_recovered_block_cache_hit_skips_refetch(self, encoded):
+        ev, shard_bytes = self._volume_without_local_shards(encoded)
+        calls = []
+
+        def remote(sid, offset, size):
+            if sid == 0:
+                return None
+            calls.append(sid)
+            return shard_bytes[sid][offset:offset + size]
+
+        ev.remote_reader = remote
+        first = ev.read_shard_span(0, 0, 64)
+        n_after_first = len(calls)
+        assert n_after_first >= DATA_SHARDS_COUNT
+        again = ev.read_shard_span(0, 0, 64)
+        assert again == first == shard_bytes[0][:64]
+        assert len(calls) == n_after_first, "cache hit refetched survivors"
+        ev.close()
+
+    def test_short_remote_target_read_degrades_to_recovery(self, encoded):
+        """A truncated answer from the shard's holder must fall through
+        to reconstruction, not fail the read."""
+        ev, shard_bytes = self._volume_without_local_shards(encoded)
+
+        def remote(sid, offset, size):
+            if sid == 0:
+                return shard_bytes[0][offset:offset + size // 2]  # short!
+            return shard_bytes[sid][offset:offset + size]
+
+        ev.remote_reader = remote
+        assert ev.read_shard_span(0, 0, 64) == shard_bytes[0][:64]
+        ev.close()
+
+    def test_raising_remote_target_read_degrades_to_recovery(self, encoded):
+        ev, shard_bytes = self._volume_without_local_shards(encoded)
+
+        def remote(sid, offset, size):
+            if sid == 0:
+                raise OSError("connection reset")
+            return shard_bytes[sid][offset:offset + size]
+
+        ev.remote_reader = remote
+        assert ev.read_shard_span(0, 0, 64) == shard_bytes[0][:64]
+        ev.close()
+
+    def test_faulty_survivor_does_not_poison_plan_cache(self, encoded):
+        """Mid-recovery survivor faults (short data, then a timeout-ish
+        error) must not leave a bad decode plan behind: the winning
+        survivor set keys the plan, and later reads — same or different
+        fault pattern — still answer byte-identical data."""
+        ev, shard_bytes = self._volume_without_local_shards(encoded)
+        faulty = {3: "short", 7: "raise"}
+
+        def flaky_remote(sid, offset, size):
+            if sid == 0:
+                return None
+            mode = faulty.get(sid)
+            if mode == "short":
+                return shard_bytes[sid][offset:offset + max(1, size // 3)]
+            if mode == "raise":
+                raise TimeoutError("survivor fetch timed out")
+            return shard_bytes[sid][offset:offset + size]
+
+        ev.remote_reader = flaky_remote
+        assert ev.read_shard_span(0, 0, 64) == shard_bytes[0][:64]
+        # heal the survivors and read a DIFFERENT span of the same shard:
+        # the fresh fan-out may pick a different survivor set, and any
+        # plan cached from the faulty round must not corrupt it
+        faulty.clear()
+        assert ev.read_shard_span(0, 64, 64) == shard_bytes[0][64:128]
+        # different fault pattern, different offset again
+        faulty[1] = "raise"
+        faulty[9] = "short"
+        assert ev.read_shard_span(0, 128, 32) == shard_bytes[0][128:160]
+        ev.close()
+
+    def test_coalesce_and_cache_knobs_off_still_correct(self, encoded,
+                                                        monkeypatch):
+        monkeypatch.setenv("WEED_EC_RECOVER_CACHE_MB", "0")
+        monkeypatch.setenv("WEED_EC_RECOVER_COALESCE", "0")
+        monkeypatch.setenv("WEED_EC_RECOVER_BLOCK_KB", "0")
+        ev, shard_bytes = self._volume_without_local_shards(encoded)
+        calls = []
+
+        def remote(sid, offset, size):
+            if sid == 0:
+                return None
+            calls.append(sid)
+            return shard_bytes[sid][offset:offset + size]
+
+        ev.remote_reader = remote
+        assert ev.read_shard_span(0, 0, 64) == shard_bytes[0][:64]
+        n_first = len(calls)
+        # caching disabled: the same span refetches
+        assert ev.read_shard_span(0, 0, 64) == shard_bytes[0][:64]
+        assert len(calls) > n_first
+        ev.close()
+
+    def test_block_aligned_recovery_serves_neighbor_spans(self, encoded):
+        """With local survivors and a block size covering the whole
+        (scaled-down) shard, the FIRST recovery warms the cache for
+        every later span on the dead shard."""
+        base, d = encoded
+        ev = EcVolume(d, "", 1, large_block_size=LARGE,
+                      small_block_size=SMALL)
+        for i in range(1, DATA_SHARDS_COUNT + 1):  # shard 0 dead
+            ev.add_shard(EcVolumeShard(d, "", 1, i))
+        shard0 = open(base + to_ext(0), "rb").read()
+        assert ev.read_shard_span(0, 0, 50) == shard0[:50]
+        assert ev.recover_stats()["cache_blocks"] >= 1
+        # a read elsewhere in the same block never re-decodes
+        hits_before = ev.recover_stats()["cache_hits"]
+        assert ev.read_shard_span(0, 60, 40) == shard0[60:100]
+        assert ev.recover_stats()["cache_hits"] > hits_before
+        ev.close()
+
+
 class TestRebuild:
     def test_rebuild_missing_shards(self, encoded):
         base, d = encoded

@@ -114,3 +114,90 @@ class TestOtherGeometries:
         restored = enc.reconstruct(damaged)
         for i in range(d + p):
             assert np.array_equal(restored[i], shards[i])
+
+
+class TestReconstructOne:
+    """The degraded-read primitive: one cached decode row must answer
+    byte-identically to a full Reconstruct, for every loss pattern."""
+
+    def test_equivalent_to_full_reconstruct(self, enc):
+        shards = make_shards(enc, length=257)
+        rng = np.random.default_rng(13)
+        combos = list(itertools.combinations(range(14), 4))
+        for idx in rng.choice(len(combos), size=40, replace=False):
+            missing = combos[idx]
+            damaged = [
+                None if i in missing else shards[i] for i in range(14)
+            ]
+            restored = enc.reconstruct(
+                [None if i in missing else shards[i] for i in range(14)])
+            for target in missing:
+                one = enc.reconstruct_one(list(damaged), target)
+                assert np.array_equal(one, restored[target]), (
+                    f"target {target} of missing {missing}")
+                assert np.array_equal(one, shards[target])
+
+    def test_present_target_returned_as_is(self, enc):
+        shards = make_shards(enc)
+        out = enc.reconstruct_one(list(shards), 3)
+        assert np.array_equal(out, shards[3])
+
+    def test_too_few_shards(self, enc):
+        shards = make_shards(enc)
+        damaged = [None] * 5 + list(shards[5:])
+        with pytest.raises(ReconstructError):
+            enc.reconstruct_one(damaged, 0)
+
+    def test_decode_rows_cached_and_readonly(self, enc):
+        from seaweedfs_tpu.ops.rs_numpy import (decode_plan_cache_info,
+                                                decode_rows)
+
+        survivors = tuple(range(1, 11))
+        before = decode_plan_cache_info().hits
+        r1 = decode_rows(10, 14, survivors, (0,))
+        r2 = decode_rows(10, 14, survivors, (0,))
+        assert r1 is r2  # same cache entry, no re-inversion
+        assert decode_plan_cache_info().hits > before
+        with pytest.raises(ValueError):
+            r1[0, 0] ^= 1  # cached plans are immutable
+
+    def test_reconstruct_span_matches_encoder(self, enc):
+        from seaweedfs_tpu.ops.codec import reconstruct_span
+
+        shards = make_shards(enc, length=300)
+        survivors = (0, 2, 3, 4, 6, 7, 8, 9, 10, 13)
+        inputs = np.stack([shards[i] for i in survivors])
+        for target in (1, 5, 11, 12):
+            out = reconstruct_span(survivors, inputs, target)
+            assert np.array_equal(out, shards[target])
+
+
+class TestParityOnlySkipsInversion:
+    def test_no_invert_when_only_parity_missing(self, enc, monkeypatch):
+        """All data shards present -> the decode submatrix is the
+        identity; regenerating parity must never touch gf_invert."""
+        shards = make_shards(enc)
+
+        def boom(*a, **kw):
+            raise AssertionError("gf_invert called on parity-only repair")
+
+        monkeypatch.setattr(gf256, "gf_invert", boom)
+        damaged = list(shards[:10]) + [None] * 4
+        restored = enc.reconstruct(damaged)
+        for i in range(14):
+            assert np.array_equal(restored[i], shards[i])
+
+    def test_decode_plan_identity_survivors_skip_inversion(self, monkeypatch):
+        from seaweedfs_tpu.ops import rs_numpy
+
+        def boom(*a, **kw):
+            raise AssertionError("gf_invert called for identity survivors")
+
+        monkeypatch.setattr(gf256, "gf_invert", boom)
+        rs_numpy._decode_rows_cached.cache_clear()
+        try:
+            rows = rs_numpy.decode_rows(10, 14, tuple(range(10)), (12,))
+            full = gf256.build_matrix(10, 14)
+            assert np.array_equal(rows[0], full[12])
+        finally:
+            rs_numpy._decode_rows_cached.cache_clear()
