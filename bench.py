@@ -478,13 +478,13 @@ def bench_ec_degraded_read(num_files: int = 2000,
 
         from seaweedfs_tpu.rpc.http_rpc import RpcError
 
-        def call_retry(url, path, **kw):
+        def call_retry(url, path, *args, **kw):
             # earlier bench stages can leave the (shared) box briefly
             # catatonic; a transient connect timeout (RpcError 503
             # "cannot reach") must not kill the whole stage
             for attempt in range(3):
                 try:
-                    return call(url, path, timeout=60, **kw)
+                    return call(url, path, *args, timeout=60, **kw)
                 except RpcError as e:
                     if attempt == 2 or e.status != 503:
                         raise
@@ -508,13 +508,13 @@ def bench_ec_degraded_read(num_files: int = 2000,
         # in the first few 1 MB blocks, i.e. shards 0..ceil(MB)-1; kill
         # 4 so every read reconstructs from the 10 survivors
         kill = [0, 1, 2, 3]
-        call(vs.store.url, "/admin/ec/unmount",
-             {"volume": vid, "shard_ids": kill})
-        call(vs.store.url, "/admin/ec/delete_shards",
-             {"volume": vid, "shard_ids": kill})
+        call_retry(vs.store.url, "/admin/ec/unmount",
+                   {"volume": vid, "shard_ids": kill})
+        call_retry(vs.store.url, "/admin/ec/delete_shards",
+                   {"volume": vid, "shard_ids": kill})
         vs.heartbeat_once()
         # sanity: a read still answers the original bytes
-        got = call(vs.store.url, f"/{fids[0]}")
+        got = call_retry(vs.store.url, f"/{fids[0]}")
         assert got == payload, "degraded read returned wrong bytes"
 
         from seaweedfs_tpu.storage.erasure_coding.recover import \
@@ -530,7 +530,12 @@ def bench_ec_degraded_read(num_files: int = 2000,
         def one(i: int):
             fid = fids[i % len(fids)]
             t0 = time.perf_counter()
-            call(vs.store.url, f"/{fid}")
+            try:
+                call(vs.store.url, f"/{fid}")
+            except RpcError as e:
+                if e.status != 503:
+                    raise
+                call_retry(vs.store.url, f"/{fid}")
             dt = (time.perf_counter() - t0) * 1000.0
             with lat_lock:
                 lat.append(dt)
@@ -542,6 +547,24 @@ def bench_ec_degraded_read(num_files: int = 2000,
         lat.sort()
         p99 = lat[int(len(lat) * 0.99) - 1] if lat else 0.0
         stages = RECOVER_STATS.snapshot(wall=secs)
+
+        # span-derived breakdown: re-run a short fully-sampled probe so
+        # the timed storm above pays zero recorder cost, then read the
+        # fetch/decode/serve split straight out of the trace recorder
+        from seaweedfs_tpu import tracing
+        tracing.RECORDER.reset()
+        prev_sample = os.environ.get("WEED_TRACE_SAMPLE")
+        os.environ["WEED_TRACE_SAMPLE"] = "1"
+        try:
+            with cf.ThreadPoolExecutor(max_workers=16) as pool:
+                list(pool.map(one, range(min(500, read_reqs))))
+        finally:
+            if prev_sample is None:
+                os.environ.pop("WEED_TRACE_SAMPLE", None)
+            else:
+                os.environ["WEED_TRACE_SAMPLE"] = prev_sample
+        stages["trace_spans"] = tracing.RECORDER.aggregate("ec.recover.")
+        tracing.RECORDER.reset()
 
         # native-port degraded reads: C++ reconstructs each span from
         # the 10 local survivors (zero GIL involvement)

@@ -21,8 +21,10 @@ import threading
 import time
 from typing import Optional
 
+from .. import tracing
 from ..rpc.http_rpc import Request, Response, RpcError, RpcServer, call
 from ..security import Guard, gen_read_jwt, gen_write_jwt
+from ..stats import metrics as stats
 from ..storage.needle import PAIR_NAME_PREFIX
 from .entry import Attr, Entry, FileChunk, total_size
 from .filechunk_manifest import (MANIFEST_BATCH, has_chunk_manifest,
@@ -96,7 +98,11 @@ class FilerServer:
 
         self._tcp_client = VolumeTcpClient()
         self._tcp_bad: dict[str, float] = {}
-        self.server = RpcServer(host, port)
+        self.server = RpcServer(host, port, service_name="filer")
+        # observability mounts shadow the matching user paths, like the
+        # /metadata/, /remote/ and /kv/ prefixes below
+        self.server.add("GET", "/metrics", stats.metrics_handler)
+        self.server.add("GET", "/debug/traces", tracing.traces_handler)
         self.server.add("GET", "/metadata/subscribe", self._h_subscribe)
         self.server.add("GET", "/metadata/aggregate", self._h_aggregate)
         self.server.add("POST", "/remote/configure", self._h_remote_configure)
@@ -211,7 +217,9 @@ class FilerServer:
     def _handle(self, method: str, req: Request):
         path = req.path or "/"
         if method in ("GET", "HEAD"):
-            return self._h_read(path, req, method)
+            stats.FilerRequestCounter.labels("read").inc()
+            with stats.FilerRequestHistogram.labels("read").time():
+                return self._h_read(path, req, method)
         # mutations: stamp the caller's replication signature (if any) onto
         # the resulting metadata events so sync loops can break cycles
         sig_header = req.headers.get("X-Sw-Signature", "")
@@ -223,9 +231,13 @@ class FilerServer:
         self.filer.set_event_signatures(sigs)
         try:
             if method in ("POST", "PUT"):
-                return self._h_write(path, req)
+                stats.FilerRequestCounter.labels("write").inc()
+                with stats.FilerRequestHistogram.labels("write").time():
+                    return self._h_write(path, req)
             if method == "DELETE":
-                return self._h_delete(path, req)
+                stats.FilerRequestCounter.labels("delete").inc()
+                with stats.FilerRequestHistogram.labels("delete").time():
+                    return self._h_delete(path, req)
         finally:
             self.filer.set_event_signatures(None)
         raise RpcError(f"unsupported method {method}", 405)
@@ -408,6 +420,11 @@ class FilerServer:
         cluster (doPutAutoChunk, _write_upload.go); per-path rules from
         /etc/seaweedfs/filer.conf pick collection/replication and enforce
         read-only prefixes."""
+        with tracing.span("filer.save", tags={"bytes": len(body)}):
+            return self._save_bytes(path, body, mime, extended)
+
+    def _save_bytes(self, path: str, body: bytes, mime: str = "",
+                    extended: Optional[dict] = None) -> Entry:
         path = self.filer._norm(path)
         rule = self.filer_conf().match_path(path)
         if rule.read_only:
@@ -444,6 +461,9 @@ class FilerServer:
         else:
             offsets = list(range(0, len(body), self.chunk_size))
             failed = threading.Event()
+            # chunk uploads run on pool threads that do not inherit this
+            # thread's trace context: hand them the parent explicitly
+            parent_span = tracing.current()
 
             def upload(off: int) -> FileChunk:
                 if failed.is_set():
@@ -452,8 +472,12 @@ class FilerServer:
                     raise RpcError("aborted: sibling chunk failed", 500)
                 try:
                     piece = body[off:off + self.chunk_size]
-                    chunk = self._upload_blob(piece, rule.replication,
-                                              rule.collection, rule_ttl)
+                    with tracing.span("filer.chunk_upload",
+                                      parent=parent_span,
+                                      tags={"offset": off,
+                                            "bytes": len(piece)}):
+                        chunk = self._upload_blob(piece, rule.replication,
+                                                  rule.collection, rule_ttl)
                 except Exception:
                     failed.set()
                     raise
@@ -564,6 +588,13 @@ class FilerServer:
     def read_bytes(self, entry: Entry, start: int = 0,
                    length: Optional[int] = None) -> bytes:
         """Reassemble [start, start+length) of an entry's content."""
+        with tracing.span("filer.read",
+                          tags={"bytes": length if length is not None
+                                else entry.size() - start}):
+            return self._read_bytes(entry, start, length)
+
+    def _read_bytes(self, entry: Entry, start: int = 0,
+                    length: Optional[int] = None) -> bytes:
         size = entry.size()
         if length is None:
             length = size - start
@@ -586,12 +617,15 @@ class FilerServer:
         keys = {v.fid: v.cipher_key for v in views}
         fids = list(keys)
         failed = threading.Event()
+        parent_span = tracing.current()  # pool threads lack the context
 
         def fetch(fid: str) -> bytes:
             if failed.is_set():
                 raise RpcError("aborted: sibling chunk fetch failed", 500)
             try:
-                data = self._fetch_chunk(fid)
+                with tracing.span("filer.chunk_fetch", parent=parent_span,
+                                  tags={"fid": fid}):
+                    data = self._fetch_chunk(fid)
                 if keys[fid]:
                     # cache holds what the volume stores (ciphertext);
                     # plaintext exists only in flight

@@ -17,6 +17,7 @@ import time
 import urllib.parse
 from typing import Optional
 
+from .. import tracing
 from ..rpc.http_rpc import RpcError, RpcServer, call
 from ..security import Guard, gen_write_jwt
 from ..stats import metrics as stats
@@ -47,7 +48,7 @@ class MasterServer:
         self.default_replication = default_replication
         self.garbage_threshold = garbage_threshold
         self.guard = guard or Guard()
-        self.server = RpcServer(host, port)
+        self.server = RpcServer(host, port, service_name="master")
         self.raft = RaftNode(self.server.address,
                              (peers or []) + [self.server.address],
                              state_dir=raft_dir,
@@ -239,6 +240,7 @@ class MasterServer:
         s.add("GET", "/vol/status", g(lambda r: self.topo.to_dict()))
         s.add("GET", "/ec/lookup", self._handle_ec_lookup)
         s.add("GET", "/metrics", stats.metrics_handler)
+        s.add("GET", "/debug/traces", tracing.traces_handler)
         s.add("POST", "/raft/request_vote",
               lambda r: self.raft.handle_request_vote(r.json()))
         s.add("POST", "/raft/append_entries",

@@ -962,6 +962,18 @@ def _encode_units_host(plans, units, chunk, host_codec,
         stats.EcEncodeStageSeconds.labels(k).set(round(v, 3))
     if pacer.flushes:
         stats.EcWritebackFlushCounter.inc(pacer.flushes)
+    # the stage timers aggregate busy seconds across worker threads, so
+    # they become synthesised child spans of one encode root (recorded
+    # before the root finishes — retention is decided at the root)
+    from .. import tracing
+    root = tracing.start(
+        "ec.encode_volumes",
+        tags={"volumes": len(plans), "workers": nworkers,
+              "writers": nwriters, "items": len(items)})
+    root.start_ts -= wall
+    for k, v in timers.items():
+        tracing.record_span(f"ec.encode.{k}", v, parent=root)
+    root.finish(duration=wall)
     return {p.base: vols[vi].crcs for vi, p in enumerate(plans)}
 
 

@@ -22,6 +22,9 @@ import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
 
+from .. import tracing
+from ..stats import metrics as _stats
+
 
 class RpcError(Exception):
     def __init__(self, message: str, status: int = 500):
@@ -99,9 +102,13 @@ class RpcServer:
     the longest prefix wins.  A default route handles everything else
     (object GET/POST by fid on volume servers)."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 service_name: str = "rpc"):
         self.routes: dict[tuple[str, str], Route] = {}
         self.default_route: Optional[Callable[[str, Request], object]] = None
+        # daemon identity for trace spans and the hop-latency vector
+        # (masters/filers/volume servers/s3 gateways set their own)
+        self.service_name = service_name
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -126,26 +133,51 @@ class RpcServer:
                 length = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(length) if length else b""
                 req = Request(self, path, query, body)
+                route, prefix = outer._match(method, path)
+                # route label for the span name / hop vector: the matched
+                # prefix ("*" = default route), never the raw path — label
+                # cardinality must stay bounded
+                label = prefix if route is not None else "*"
+                service = outer.service_name
+                sp = tracing.from_headers(f"{method} {label}", service,
+                                          self.headers)
+                src = self.headers.get(tracing.SRC_HEADER) or "client"
+                _stats.RpcInflightGauge.labels(service).inc()
+                t0 = time.perf_counter()
+                prev = tracing.swap(sp)
                 try:
-                    route = outer._match(method, path)
-                    if route is None:
-                        if outer.default_route is not None:
-                            result = outer.default_route(method, req)
+                    try:
+                        if route is None:
+                            if outer.default_route is not None:
+                                result = outer.default_route(method, req)
+                            else:
+                                raise RpcError(
+                                    f"no route {method} {path}", 404)
                         else:
-                            raise RpcError(f"no route {method} {path}", 404)
-                    else:
-                        result = route(req)
-                except RpcError as e:
-                    self._reply(Response(
-                        json.dumps({"error": str(e)}).encode(), e.status,
-                        "application/json"))
-                    return
-                except Exception as e:  # surface internal errors as 500 JSON
-                    self._reply(Response(
-                        json.dumps({"error": f"{type(e).__name__}: {e}"}
-                                   ).encode(), 500, "application/json"))
-                    return
-                self._reply(outer._coerce(result))
+                            result = route(req)
+                        resp = outer._coerce(result)
+                    except RpcError as e:
+                        resp = Response(
+                            json.dumps({"error": str(e)}).encode(),
+                            e.status, "application/json")
+                    except Exception as e:  # internal errors as 500 JSON
+                        resp = Response(
+                            json.dumps({"error": f"{type(e).__name__}: {e}"}
+                                       ).encode(), 500, "application/json")
+                    if resp.status >= 400:
+                        sp.status = f"error {resp.status}"
+                    if sp.sampled:
+                        # hand the trace id back so callers can fetch the
+                        # span tree from /debug/traces/<id>
+                        resp.headers.setdefault(tracing.TRACE_HEADER,
+                                                sp.trace_id)
+                    self._reply(resp)
+                finally:
+                    tracing.restore(prev)
+                    sp.finish()
+                    _stats.RpcInflightGauge.labels(service).dec()
+                    _stats.RpcHopHistogram.labels(src, service, label) \
+                        .observe(time.perf_counter() - t0)
 
             def _reply(self, resp: Response):
                 body = resp.body
@@ -256,13 +288,15 @@ class RpcServer:
         self.port = self.httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
 
-    def _match(self, method: str, path: str) -> Optional[Route]:
-        best, best_len = None, -1
+    def _match(self, method: str, path: str
+               ) -> tuple[Optional[Route], str]:
+        """(route, matched prefix); (None, "") when no prefix matches."""
+        best, best_prefix = None, ""
         for (m, prefix), route in self.routes.items():
             if m == method and path.startswith(prefix) and \
-                    len(prefix) > best_len:
-                best, best_len = route, len(prefix)
-        return best
+                    len(prefix) > len(best_prefix):
+                best, best_prefix = route, prefix
+        return best, best_prefix
 
     @staticmethod
     def _coerce(result) -> Response:
@@ -397,7 +431,7 @@ def call(addr: str, path: str, payload: Optional[dict] = None,
     parse=False always returns the raw body — required when fetching
     stored object content whose mime may itself be application/json."""
     data = None
-    req_headers = dict(headers or {})
+    req_headers = tracing.inject(dict(headers or {}))
     if raw is not None:
         data = raw
     elif payload is not None:
@@ -478,7 +512,7 @@ def call_stream(addr: str, path: str, payload: Optional[dict] = None,
     Errors before the first byte raise RpcError like call()."""
     url = f"http://{addr}{path}"
     data = None
-    req_headers = dict(headers or {})
+    req_headers = tracing.inject(dict(headers or {}))
     if payload is not None:
         data = json.dumps(payload).encode()
         req_headers["Content-Type"] = "application/json"

@@ -24,7 +24,9 @@ from ..filer.filechunk_manifest import (has_chunk_manifest,
                                         resolve_chunk_manifest)
 from ..filer.filer_store import NotFoundError
 from ..filer.server import FilerServer
+from .. import tracing
 from ..rpc.http_rpc import Request, Response, RpcError, RpcServer
+from ..stats import metrics as stats
 from .auth import (ACTION_ADMIN, ACTION_LIST, ACTION_READ, ACTION_WRITE,
                    AuthError, Identity, IdentityAccessManagement)
 from .circuit_breaker import CircuitBreaker, SlowDown
@@ -120,7 +122,11 @@ class S3ApiServer:
         self.circuit_breaker = circuit_breaker \
             or CircuitBreaker.load_from_filer(self.filer_server)
         self._cb_checked = time.time()
-        self.server = RpcServer(host, port)
+        self.server = RpcServer(host, port, service_name="s3")
+        # shadow two reserved names in the bucket namespace, like the
+        # filer's /metadata//remote//kv mounts shadow user paths
+        self.server.add("GET", "/metrics", stats.metrics_handler)
+        self.server.add("GET", "/debug/traces", tracing.traces_handler)
         self.server.default_route = self._handle
 
     @property
@@ -146,15 +152,23 @@ class S3ApiServer:
 
     # -- routing -------------------------------------------------------------
     def _handle(self, method: str, req: Request):
-        try:
-            self._maybe_reload_circuit_breaker()
-            return self._route(method, req)
-        except AuthError as e:
-            return _error_xml(e.code, str(e), e.status)
-        except SlowDown as e:
-            return _error_xml("SlowDown", str(e), 503)
-        except NotFoundError as e:
-            return _error_xml("NoSuchKey", str(e), 404)
+        parts = req.path.lstrip("/").split("/", 1)
+        # bounded action label: bucket ops vs object ops by method
+        action = ("%s_%s" % (method, "object" if len(parts) > 1 and
+                             parts[1] else "bucket")).lower()
+        with stats.S3RequestHistogram.labels(action).time():
+            try:
+                self._maybe_reload_circuit_breaker()
+                resp = self._route(method, req)
+            except AuthError as e:
+                resp = _error_xml(e.code, str(e), e.status)
+            except SlowDown as e:
+                resp = _error_xml("SlowDown", str(e), 503)
+            except NotFoundError as e:
+                resp = _error_xml("NoSuchKey", str(e), 404)
+        code = resp.status if isinstance(resp, Response) else 200
+        stats.S3RequestCounter.labels(action, code).inc()
+        return resp
 
     def _route(self, method: str, req: Request):
         path = urllib.parse.unquote(req.path)

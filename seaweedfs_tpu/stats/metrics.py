@@ -22,7 +22,8 @@ def _fmt_labels(names: Sequence[str], values: Sequence[str]) -> str:
     if not names:
         return ""
     inner = ",".join(
-        '%s="%s"' % (n, str(v).replace("\\", "\\\\").replace('"', '\\"'))
+        '%s="%s"' % (n, str(v).replace("\\", "\\\\")
+                     .replace('"', '\\"').replace("\n", "\\n"))
         for n, v in zip(names, values))
     return "{%s}" % inner
 
@@ -331,6 +332,83 @@ S3RequestCounter = REGISTRY.counter(
     "SeaweedFS_s3_request_total", "s3 requests", ("action", "code"))
 S3RequestHistogram = REGISTRY.histogram(
     "SeaweedFS_s3_request_seconds", "s3 request latency", ("action",))
+# cross-hop tracing vectors: observed SERVER-side in RpcServer dispatch
+# (src from the caller's X-Trace-Src header, dst = the serving daemon,
+# route = the matched route prefix — bounded label sets, no addresses)
+RpcHopHistogram = REGISTRY.histogram(
+    "SeaweedFS_rpc_hop_seconds",
+    "cross-daemon request hop latency by source/destination/route",
+    ("src", "dst", "route"))
+RpcInflightGauge = REGISTRY.gauge(
+    "SeaweedFS_rpc_inflight_requests",
+    "requests currently inside a daemon's dispatch", ("service",))
+TraceRetentionCounter = REGISTRY.counter(
+    "SeaweedFS_trace_traces_total",
+    "root-span trace retention decisions (kept / dropped)", ("result",))
+
+
+# -- process self-metrics (the reference's Go runtime collectors:
+# prometheus.NewGoCollector/NewProcessCollector) -----------------------------
+_PROCESS_START = time.time()
+try:
+    import resource as _resource
+except ImportError:  # non-POSIX fallback
+    _resource = None
+
+
+def _proc_rss_bytes() -> float:
+    try:
+        with open("/proc/self/statm") as f:
+            pages = int(f.read().split()[1])
+        import os as _os
+
+        return float(pages * _os.sysconf("SC_PAGE_SIZE"))
+    except (OSError, ValueError, IndexError):
+        if _resource is not None:
+            # ru_maxrss is KiB on Linux (peak, not current — still
+            # better than nothing where /proc is unavailable)
+            return float(
+                _resource.getrusage(_resource.RUSAGE_SELF).ru_maxrss * 1024)
+        return 0.0
+
+
+def _proc_open_fds() -> float:
+    try:
+        import os as _os
+
+        return float(len(_os.listdir("/proc/self/fd")))
+    except OSError:
+        return 0.0
+
+
+def _proc_gc_collections() -> float:
+    import gc
+
+    return float(sum(s.get("collections", 0) for s in gc.get_stats()))
+
+
+ProcessResidentMemoryGauge = REGISTRY.gauge(
+    "SeaweedFS_process_resident_memory_bytes",
+    "resident set size of this process", fn=_proc_rss_bytes)
+ProcessOpenFdsGauge = REGISTRY.gauge(
+    "SeaweedFS_process_open_fds",
+    "open file descriptors in this process", fn=_proc_open_fds)
+ProcessThreadsGauge = REGISTRY.gauge(
+    "SeaweedFS_process_threads",
+    "live Python threads in this process",
+    fn=lambda: float(threading.active_count()))
+ProcessGcCollectionsGauge = REGISTRY.gauge(
+    "SeaweedFS_process_gc_collections",
+    "cumulative GC collections across generations",
+    fn=_proc_gc_collections)
+ProcessUptimeGauge = REGISTRY.gauge(
+    "SeaweedFS_process_uptime_seconds",
+    "seconds since this process registered its metrics",
+    fn=lambda: time.time() - _PROCESS_START)
+ProcessStartTimeGauge = REGISTRY.gauge(
+    "SeaweedFS_process_start_time_seconds",
+    "unix time the process registered its metrics",
+    fn=lambda: _PROCESS_START)
 
 
 def metrics_handler(req):
@@ -347,9 +425,11 @@ def start_metrics_server(host: str = "127.0.0.1",
     -metricsPort; stats/metrics.go StartMetricsServer).  Daemons whose
     main port serves a user namespace (filer paths, s3 buckets) cannot
     mount /metrics there without shadowing user data."""
+    from .. import tracing
     from ..rpc.http_rpc import RpcServer
 
-    server = RpcServer(host, port)
+    server = RpcServer(host, port, service_name="metrics")
     server.add("GET", "/metrics", metrics_handler)
+    server.add("GET", "/debug/traces", tracing.traces_handler)
     server.start()
     return server

@@ -27,6 +27,7 @@ from .. import types as t
 from ..needle import Needle, get_actual_size
 from . import (DATA_SHARDS_COUNT, LARGE_BLOCK_SIZE, SMALL_BLOCK_SIZE,
                TOTAL_SHARDS_COUNT, to_ext)
+from ... import tracing
 from .locate import Interval, locate_data
 from .recover import (STATS as RECOVER_STATS, RecoveredBlockCache,
                       SpanDecodeBatcher, recover_knobs)
@@ -303,32 +304,39 @@ class EcVolume:
         mat-vec (recover.py).  With no local shard to size blocks
         against (shard_size unknown) the exact span becomes the unit —
         still coalesced and cached."""
-        t0 = time.perf_counter()
         self._tls.busy = 0.0
-        cache_bytes, block, coalesce = recover_knobs()
-        shard_size = self.shard_size
-        if block <= 0 or shard_size <= 0:
-            spans = [(offset, size)]
-        else:
-            lo = (offset // block) * block
-            end = max(offset + size, min(shard_size,
-                                         -(-(offset + size) // block) * block))
-            spans = [(s, min(block, end - s)) for s in range(lo, end, block)]
-        parts = []
-        for bstart, blen in spans:
-            key = (target_shard, bstart, blen)
-            parts.append(self._recover_cache.get_or_recover(
-                key, lambda bs=bstart, bl=blen: self._recover_block(
-                    target_shard, bs, bl),
-                cache_bytes, coalesce))
-        blob = parts[0] if len(parts) == 1 else b"".join(parts)
-        out = blob[offset - spans[0][0]:offset - spans[0][0] + size]
-        if len(out) != size:
-            raise EcError(
-                f"recovered span short for shard {target_shard} at "
-                f"{offset}+{size}: got {len(out)}")
+        with tracing.span(
+                "ec.recover.serve",
+                tags={"shard": target_shard, "offset": offset,
+                      "size": size}) as sp:
+            cache_bytes, block, coalesce = recover_knobs()
+            shard_size = self.shard_size
+            if block <= 0 or shard_size <= 0:
+                spans = [(offset, size)]
+            else:
+                lo = (offset // block) * block
+                end = max(offset + size,
+                          min(shard_size,
+                              -(-(offset + size) // block) * block))
+                spans = [(s, min(block, end - s))
+                         for s in range(lo, end, block)]
+            parts = []
+            for bstart, blen in spans:
+                key = (target_shard, bstart, blen)
+                parts.append(self._recover_cache.get_or_recover(
+                    key, lambda bs=bstart, bl=blen: self._recover_block(
+                        target_shard, bs, bl),
+                    cache_bytes, coalesce))
+            blob = parts[0] if len(parts) == 1 else b"".join(parts)
+            out = blob[offset - spans[0][0]:offset - spans[0][0] + size]
+            if len(out) != size:
+                raise EcError(
+                    f"recovered span short for shard {target_shard} at "
+                    f"{offset}+{size}: got {len(out)}")
+        # the span measured the whole degraded read; the serve stage is
+        # that wall minus this thread's fetch+decode busy seconds
         RECOVER_STATS.add_stage(
-            "serve", max(0.0, time.perf_counter() - t0
+            "serve", max(0.0, (sp.duration or 0.0)
                          - getattr(self._tls, "busy", 0.0)))
         return out
 
@@ -344,10 +352,12 @@ class EcVolume:
         batcher."""
         blk0 = time.perf_counter()
         try:
-            fetch0 = time.perf_counter()
-            survivors, inputs = self._fetch_survivors(
-                target_shard, offset, size)
-            RECOVER_STATS.add_stage("fetch", time.perf_counter() - fetch0)
+            with tracing.span(
+                    "ec.recover.fetch",
+                    tags={"shard": target_shard, "bytes": size}) as fsp:
+                survivors, inputs = self._fetch_survivors(
+                    target_shard, offset, size)
+            RECOVER_STATS.add_stage("fetch", fsp.duration or 0.0)
             out = self._recover_batcher.decode(
                 survivors, target_shard, inputs)
             return np.ascontiguousarray(out).tobytes()

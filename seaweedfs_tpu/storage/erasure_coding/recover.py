@@ -37,6 +37,8 @@ from typing import Callable, Optional
 
 import numpy as np
 
+from ... import tracing
+
 
 def recover_knobs() -> tuple[int, int, bool]:
     """(cache_bytes, block_bytes, coalesce) from the WEED_EC_RECOVER_*
@@ -328,7 +330,8 @@ class SpanDecodeBatcher:
 
     def _decode_batch(self, survivors: tuple, target: int,
                       batch: list[_DecodeReq]) -> list[np.ndarray]:
-        t0 = time.perf_counter()
+        sp = tracing.start("ec.recover.decode", tags={"spans": len(batch)})
+        prev = tracing.swap(sp)
         try:
             if len(batch) == 1:
                 stacked = batch[0].inputs
@@ -347,8 +350,11 @@ class SpanDecodeBatcher:
         except BaseException as e:
             for r in batch:
                 r.error = e
+            sp.status = f"error: {type(e).__name__}"
             raise
         finally:
-            self.stats.add_stage("decode", time.perf_counter() - t0)
+            tracing.restore(prev)
+            sp.finish()
+            self.stats.add_stage("decode", sp.duration or 0.0)
             for r in batch:
                 r.event.set()

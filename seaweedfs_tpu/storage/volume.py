@@ -30,6 +30,7 @@ from .needle import (CURRENT_VERSION, Needle, NeedleError, get_actual_size,
 from .needle_map import NeedleMap, new_needle_map
 from .super_block import SUPER_BLOCK_SIZE, ReplicaPlacement, SuperBlock
 from .ttl import EMPTY_TTL, TTL
+from .. import tracing
 
 
 class VolumeError(Exception):
@@ -401,7 +402,8 @@ class Volume:
         if self.fsync:
             # outside the lock: other writers append while this one waits
             # for the shared group-commit fsync
-            self._fsync_batcher().wait_durable()
+            with tracing.span("fsync.group_commit", tags={"vid": self.id}):
+                self._fsync_batcher().wait_durable()
         return offset, n.size, False
 
     def delete_needle(self, n: Needle) -> int:
@@ -420,7 +422,8 @@ class Volume:
             self.last_append_at_ns = n.append_at_ns
             self.nm.delete(n.id, offset)
         if self.fsync:
-            self._fsync_batcher().wait_durable()
+            with tracing.span("fsync.group_commit", tags={"vid": self.id}):
+                self._fsync_batcher().wait_durable()
         return size
 
     # -- read ----------------------------------------------------------------

@@ -23,6 +23,7 @@ import threading
 import time
 from typing import Optional
 
+from .. import tracing
 from ..rpc.http_rpc import (Request, Response, RpcError, RpcServer, call,
                             call_stream, stream_file)
 from ..security import Guard, gen_write_jwt, token_from_request
@@ -178,7 +179,7 @@ class VolumeServer:
 
             for conf in tier_backends:
                 tier.register_tier_backend(conf)
-        self.server = RpcServer(host, port)
+        self.server = RpcServer(host, port, service_name="volume")
         # the configured seed list survives leader redirects so a dead
         # leader never strands the heartbeat loop
         self._seed_masters = [m for m in master_address.split(",") if m]
@@ -586,6 +587,7 @@ class VolumeServer:
         s.add("POST", "/admin/leave", g(self._h_leave))
         s.add("POST", "/query", self._h_query)
         s.add("GET", "/metrics", self._h_metrics)
+        s.add("GET", "/debug/traces", tracing.traces_handler)
         s.add("GET", "/ui", self._h_ui)
         s.default_route = self._handle_object
 
@@ -762,7 +764,9 @@ class VolumeServer:
                     raise RpcError(str(e), 401)
             stats.VolumeServerRequestCounter.labels("read").inc()
             with stats.VolumeServerRequestHistogram.labels("read").time():
-                return self._read_object(vid, nid, cookie, method, req, fid)
+                with tracing.span("needle.read", tags={"fid": fid}):
+                    return self._read_object(
+                        vid, nid, cookie, method, req, fid)
         if method in ("POST", "PUT"):
             # JWT check before any byte is written
             # (volume_server_handlers_write.go:30-38)
@@ -775,13 +779,17 @@ class VolumeServer:
             try:
                 with stats.VolumeServerRequestHistogram.labels(
                         "write").time():
-                    return self._write_object(vid, nid, cookie, req)
+                    with tracing.span(
+                            "needle.write",
+                            tags={"fid": fid, "bytes": n_bytes}):
+                        return self._write_object(vid, nid, cookie, req)
             finally:
                 self.upload_gate.release(n_bytes)
         if method == "DELETE":
             self._check_write_auth(req, fid)
             stats.VolumeServerRequestCounter.labels("delete").inc()
-            return self._delete_object(vid, nid, cookie, req)
+            with tracing.span("needle.delete", tags={"fid": fid}):
+                return self._delete_object(vid, nid, cookie, req)
         raise RpcError(f"unsupported method {method}", 405)
 
     def _check_write_auth(self, req: Request, fid: str):
@@ -982,9 +990,13 @@ class VolumeServer:
             # replicas share security.toml; re-sign for the fan-out hop
             headers["Authorization"] = "BEARER " + gen_write_jwt(
                 self.guard.signing, fid)
-        for url in others:
-            call(url, f"/{fid}?type=replicate", method=method, raw=body,
-                 headers=headers, timeout=30)
+        if not others:
+            return
+        with tracing.span("needle.replicate",
+                          tags={"fid": fid, "replicas": len(others)}):
+            for url in others:
+                call(url, f"/{fid}?type=replicate", method=method, raw=body,
+                     headers=headers, timeout=30)
 
     # -- admin ---------------------------------------------------------------
     def _h_assign_volume(self, req: Request):

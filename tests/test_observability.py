@@ -1,0 +1,200 @@
+"""End-to-end observability smoke: an in-process master + volume +
+filer cluster serves one chunked PUT and one GET with tracing sampled
+at 1.0, then every daemon's /metrics is scraped and the /debug/traces
+endpoints must return full cross-daemon span trees — including the
+degraded-EC read path.  Also pins the Grafana dashboard to the metric
+registry so a renamed metric cannot silently blank a panel."""
+
+import json
+import os
+import re
+
+import pytest
+
+from seaweedfs_tpu import tracing
+from seaweedfs_tpu.rpc.http_rpc import call
+from seaweedfs_tpu.stats import metrics as stats
+
+PAYLOAD = bytes(range(256)) * 20  # 5120 B: > INLINE_LIMIT, 5 x 1 KB chunks
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def flatten(tree):
+    """(depth, node) pairs for every span in a /debug/traces/<id> tree."""
+    out = []
+
+    def walk(node, depth):
+        out.append((depth, node))
+        for child in node["children"]:
+            walk(child, depth + 1)
+
+    for root in tree["tree"]:
+        walk(root, 0)
+    return out
+
+
+@pytest.fixture
+def cluster(tmp_path, monkeypatch):
+    monkeypatch.setenv("WEED_TRACE_SAMPLE", "1")
+    from seaweedfs_tpu.filer.server import FilerServer
+    from seaweedfs_tpu.master.server import MasterServer
+    from seaweedfs_tpu.volume_server.server import VolumeServer
+
+    master = MasterServer(port=0, pulse_seconds=0.2)
+    master.start()
+    d = tmp_path / "v0"
+    d.mkdir()
+    vs = VolumeServer([str(d)], master.address, port=0, pulse_seconds=0.2)
+    vs.start()
+    vs.heartbeat_once()
+    # chunk cache off so every GET actually crosses to the volume server
+    filer = FilerServer(master.address, port=0, chunk_size=1024,
+                        chunk_cache_bytes=0)
+    filer.start()
+    yield master, vs, filer
+    filer.stop()
+    vs.stop()
+    master.stop()
+
+
+class TestTraceAcceptance:
+    def test_filer_put_and_get_traces(self, cluster):
+        master, vs, filer = cluster
+        tracing.RECORDER.reset()
+        resp = call(filer.address, "/docs/data.bin", raw=PAYLOAD,
+                    method="POST",
+                    headers={"Content-Type": "application/x-binary"})
+        assert resp["size"] == len(PAYLOAD)
+        assert call(filer.address, "/docs/data.bin") == PAYLOAD
+
+        idx = call(filer.address, "/debug/traces")["traces"]
+        for verb, extra_span in (("POST", "filer.chunk_upload"),
+                                 ("GET", "filer.chunk_fetch")):
+            cands = [t for t in idx if t["root"].startswith(verb)
+                     and "filer" in t["services"]]
+            assert cands, f"no kept {verb} trace"
+            trace = cands[0]
+            tree = call(filer.address,
+                        f"/debug/traces/{trace['trace_id']}")
+            spans = flatten(tree)
+            names = {n["name"] for _, n in spans}
+            services = {n["service"] for _, n in spans if n["service"]}
+            # the ISSUE acceptance bar: >=3 spans across >=2 daemons,
+            # all with real durations, stitched into one tree
+            assert tree["spans"] >= 3
+            assert len(services) >= 2
+            assert extra_span in names
+            assert len(tree["tree"]) == 1, "spans not stitched to 1 root"
+            assert all(n["duration_ms"] > 0 for _, n in spans)
+            by_id = {n["span_id"]: n for _, n in spans}
+            for _, n in spans:
+                if n["parent_id"] is not None:
+                    assert n["parent_id"] in by_id
+
+    def test_degraded_ec_get_trace(self, cluster):
+        from seaweedfs_tpu.shell import commands as sh
+
+        master, vs, filer = cluster
+        resp = call(filer.address, "/ec/data.bin", raw=PAYLOAD,
+                    method="POST")
+        assert resp["size"] == len(PAYLOAD)
+        entry = filer.filer.store.find_entry("/ec/data.bin")
+        vids = sorted({int(c.fid.split(",")[0]) for c in entry.chunks})
+        env = sh.CommandEnv(master.address)
+        for vid in vids:
+            sh.ec_encode(env, vid)
+        vs.heartbeat_once()
+        for vid in vids:
+            call(vs.store.url, "/admin/ec/unmount",
+                 {"volume": vid, "shard_ids": [0, 1, 2, 3]})
+            call(vs.store.url, "/admin/ec/delete_shards",
+                 {"volume": vid, "shard_ids": [0, 1, 2, 3]})
+        vs.heartbeat_once()
+
+        tracing.RECORDER.reset()
+        assert call(filer.address, "/ec/data.bin") == PAYLOAD
+
+        idx = call(filer.address, "/debug/traces")["traces"]
+        cands = [t for t in idx if t["root"].startswith("GET")
+                 and "filer" in t["services"] and "volume" in t["services"]]
+        assert cands, "no kept degraded GET trace"
+        tree = call(filer.address, f"/debug/traces/{cands[0]['trace_id']}")
+        spans = flatten(tree)
+        names = [n["name"] for _, n in spans]
+        services = {n["service"] for _, n in spans if n["service"]}
+        assert tree["spans"] >= 3
+        assert len(services) >= 2
+        # the recover pipeline surfaced as spans under the volume hop,
+        # parented beneath needle.read
+        assert "ec.recover.serve" in names
+        by_id = {n["span_id"]: n for _, n in spans}
+        serve = next(n for _, n in spans if n["name"] == "ec.recover.serve")
+        assert by_id[serve["parent_id"]]["name"] == "needle.read"
+        assert all(n["duration_ms"] > 0 for _, n in spans)
+
+
+class TestMetricsScrape:
+    def test_every_daemon_exports_required_families(self, cluster):
+        master, vs, filer = cluster
+        call(filer.address, "/docs/m.bin", raw=PAYLOAD, method="POST")
+        assert call(filer.address, "/docs/m.bin") == PAYLOAD
+        required_everywhere = (
+            "SeaweedFS_rpc_hop_seconds",
+            "SeaweedFS_rpc_inflight_requests",
+            "SeaweedFS_trace_traces_total",
+            "SeaweedFS_process_resident_memory_bytes",
+            "SeaweedFS_process_open_fds",
+            "SeaweedFS_process_threads",
+            "SeaweedFS_process_gc_collections",
+            "SeaweedFS_process_uptime_seconds",
+        )
+        per_daemon = {
+            master.address: ("SeaweedFS_master_received_heartbeats",),
+            vs.store.url: ("SeaweedFS_volumeServer_request_total",
+                           "SeaweedFS_volumeServer_request_seconds"),
+            filer.address: ("SeaweedFS_filer_request_total",
+                            "SeaweedFS_filer_request_seconds"),
+        }
+        for addr, extra in per_daemon.items():
+            text = call(addr, "/metrics")
+            if isinstance(text, (bytes, bytearray)):
+                text = text.decode()
+            for family in required_everywhere + extra:
+                assert f"# TYPE {family} " in text, (addr, family)
+        # hop histogram recorded the filer->volume chunk hops
+        assert re.search(
+            r'SeaweedFS_rpc_hop_seconds_count\{src="filer",dst="volume"',
+            text)
+        # process gauges sample real values at scrape time
+        rss = re.search(
+            r"SeaweedFS_process_resident_memory_bytes (\d+)", text)
+        assert rss and int(rss.group(1)) > 1 << 20
+        fds = re.search(r"SeaweedFS_process_open_fds (\d+)", text)
+        assert fds and int(fds.group(1)) > 0
+
+    def test_sample_zero_keeps_nothing_fast(self, cluster, monkeypatch):
+        monkeypatch.setenv("WEED_TRACE_SAMPLE", "0")
+        monkeypatch.setenv("WEED_TRACE_SLOW_MS", "60000")
+        master, vs, filer = cluster
+        tracing.RECORDER.reset()
+        call(filer.address, "/docs/z.bin", raw=PAYLOAD, method="POST")
+        assert call(filer.address, "/docs/z.bin") == PAYLOAD
+        assert call(filer.address, "/debug/traces")["traces"] == []
+
+
+class TestGrafanaDashboard:
+    def test_dashboard_references_only_registry_metrics(self):
+        path = os.path.join(REPO_ROOT, "grafana",
+                            "grafana_seaweedfs_tpu.json")
+        with open(path) as f:
+            dashboard = json.load(f)
+        exprs = [t.get("expr", "") for p in dashboard["panels"]
+                 for t in p.get("targets", [])]
+        assert exprs, "dashboard has no queries"
+        registered = set(stats.REGISTRY._metrics)
+        for expr in exprs:
+            for token in re.findall(r"SeaweedFS_\w+", expr):
+                base = re.sub(r"_(bucket|sum|count)$", "", token)
+                assert base in registered, (
+                    f"dashboard references unknown metric {token}")

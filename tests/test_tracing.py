@@ -1,0 +1,174 @@
+"""Unit tests for the tracing layer: span context, header propagation,
+and the recorder's retention policy (sampling, slow-trace promotion,
+drop-at-root, bounded memory)."""
+
+import threading
+
+import pytest
+
+from seaweedfs_tpu import tracing
+
+
+@pytest.fixture
+def fresh_recorder(monkeypatch):
+    rec = tracing.Recorder()
+    monkeypatch.setattr(tracing, "RECORDER", rec)
+    # default: sampling off, nothing slow enough to promote
+    monkeypatch.setenv("WEED_TRACE_SAMPLE", "0")
+    monkeypatch.setenv("WEED_TRACE_SLOW_MS", "250")
+    yield rec
+
+
+class TestSpanContext:
+    def test_child_inherits_trace(self, fresh_recorder, monkeypatch):
+        monkeypatch.setenv("WEED_TRACE_SAMPLE", "1")
+        with tracing.span("root", service="a") as root:
+            with tracing.span("child") as child:
+                assert tracing.current() is child
+            assert tracing.current() is root
+        assert tracing.current() is None
+        assert child.trace_id == root.trace_id
+        assert child.parent_id == root.span_id
+        assert child.service == "a"  # inherited
+        assert not child.is_root and root.is_root
+
+    def test_explicit_parent_crosses_threads(self, fresh_recorder,
+                                             monkeypatch):
+        monkeypatch.setenv("WEED_TRACE_SAMPLE", "1")
+        got = {}
+
+        with tracing.span("root", service="a") as root:
+            def work():
+                # pool threads do not inherit the request thread's
+                # context; the explicit parent= form must still attach
+                assert tracing.current() is None
+                with tracing.span("pool", parent=root) as sp:
+                    got["span"] = sp
+            t = threading.Thread(target=work)
+            t.start()
+            t.join()
+        assert got["span"].trace_id == root.trace_id
+        assert got["span"].parent_id == root.span_id
+
+    def test_exception_marks_error_status(self, fresh_recorder,
+                                          monkeypatch):
+        monkeypatch.setenv("WEED_TRACE_SAMPLE", "1")
+        with pytest.raises(ValueError):
+            with tracing.span("boom", service="a") as sp:
+                raise ValueError("x")
+        assert sp.status.startswith("error")
+        assert sp.duration is not None
+
+    def test_record_span_synthesises_duration(self, fresh_recorder,
+                                              monkeypatch):
+        monkeypatch.setenv("WEED_TRACE_SAMPLE", "1")
+        root = tracing.start("enc", service="a")
+        child = tracing.record_span("enc.stage", 1.5, parent=root)
+        root.finish()
+        assert child.duration == 1.5
+        assert child.parent_id == root.span_id
+        tree = fresh_recorder.get(root.trace_id)
+        names = {n["name"] for n in tree["tree"][0]["children"]}
+        assert "enc.stage" in names
+
+
+class TestHeaderPropagation:
+    def test_inject_extract_roundtrip(self, fresh_recorder, monkeypatch):
+        monkeypatch.setenv("WEED_TRACE_SAMPLE", "1")
+        with tracing.span("client", service="filer") as sp:
+            headers = tracing.inject({})
+        assert headers[tracing.TRACE_HEADER] == sp.trace_id
+        assert headers[tracing.SPAN_HEADER] == sp.span_id
+        assert headers[tracing.SAMPLED_HEADER] == "1"
+        assert headers[tracing.SRC_HEADER] == "filer"
+        server = tracing.from_headers("GET /x", "volume", headers)
+        assert server.trace_id == sp.trace_id
+        assert server.parent_id == sp.span_id
+        assert server.sampled and not server.is_root
+
+    def test_inject_noop_without_span(self, fresh_recorder):
+        assert tracing.inject({}) == {}
+
+    def test_extract_without_headers_opens_root(self, fresh_recorder):
+        sp = tracing.from_headers("GET /x", "volume", {})
+        assert sp.is_root and sp.parent_id is None
+
+
+class TestRetention:
+    def test_fast_unsampled_trace_dropped_at_root(self, fresh_recorder):
+        root = tracing.start("r", service="a")
+        tracing.record_span("c", 0.001, parent=root)
+        root.finish(duration=0.001)
+        assert fresh_recorder.get(root.trace_id) is None
+        assert fresh_recorder.index() == []
+
+    def test_sampled_trace_kept(self, fresh_recorder, monkeypatch):
+        monkeypatch.setenv("WEED_TRACE_SAMPLE", "1")
+        root = tracing.start("r", service="a")
+        tracing.record_span("c", 0.001, parent=root)
+        root.finish(duration=0.001)
+        tree = fresh_recorder.get(root.trace_id)
+        assert tree is not None and tree["spans"] == 2
+        idx = fresh_recorder.index()
+        assert idx[0]["trace_id"] == root.trace_id
+        assert idx[0]["root"] == "r"
+
+    def test_slow_span_promotes_unsampled_trace(self, fresh_recorder,
+                                                monkeypatch):
+        monkeypatch.setenv("WEED_TRACE_SLOW_MS", "10")
+        root = tracing.start("r", service="a")
+        tracing.record_span("slow", 0.5, parent=root)  # 500 ms >= 10 ms
+        root.finish(duration=0.6)
+        tree = fresh_recorder.get(root.trace_id)
+        assert tree is not None and tree["slow"]
+        assert fresh_recorder.index()[0]["slow"]
+
+    def test_trace_count_bounded_lru(self, fresh_recorder, monkeypatch):
+        monkeypatch.setenv("WEED_TRACE_SAMPLE", "1")
+        monkeypatch.setenv("WEED_TRACE_MAX_TRACES", "4")
+        ids = []
+        for _ in range(10):
+            root = tracing.start("r", service="a")
+            root.finish(duration=0.001)
+            ids.append(root.trace_id)
+        assert len(fresh_recorder.index()) == 4
+        assert fresh_recorder.get(ids[0]) is None   # evicted
+        assert fresh_recorder.get(ids[-1]) is not None
+
+    def test_span_count_bounded_per_trace(self, fresh_recorder,
+                                          monkeypatch):
+        monkeypatch.setenv("WEED_TRACE_SAMPLE", "1")
+        monkeypatch.setenv("WEED_TRACE_MAX_SPANS", "5")
+        root = tracing.start("r", service="a")
+        for i in range(20):
+            tracing.record_span(f"c{i}", 0.001, parent=root)
+        root.finish(duration=0.1)
+        tree = fresh_recorder.get(root.trace_id)
+        assert tree["spans"] == 5
+        assert tree["truncated"] == 16  # 20 children + root - 5 stored
+
+    def test_aggregate_prefix_filter(self, fresh_recorder, monkeypatch):
+        monkeypatch.setenv("WEED_TRACE_SAMPLE", "1")
+        root = tracing.start("r", service="a")
+        tracing.record_span("ec.recover.fetch", 0.25, parent=root)
+        tracing.record_span("ec.recover.fetch", 0.25, parent=root)
+        tracing.record_span("other", 9.0, parent=root)
+        root.finish(duration=1.0)
+        agg = fresh_recorder.aggregate("ec.recover.")
+        assert set(agg) == {"ec.recover.fetch"}
+        assert agg["ec.recover.fetch"]["count"] == 2
+        assert agg["ec.recover.fetch"]["seconds"] == pytest.approx(0.5)
+
+    def test_orphan_parent_surfaces_as_root(self, fresh_recorder,
+                                            monkeypatch):
+        monkeypatch.setenv("WEED_TRACE_SAMPLE", "1")
+        # a server-side span whose parent lives in another process
+        sp = tracing.from_headers(
+            "GET /x", "volume",
+            {tracing.TRACE_HEADER: "t" * 16,
+             tracing.SPAN_HEADER: "remotespan",
+             tracing.SAMPLED_HEADER: "1"})
+        sp.finish(duration=0.001)
+        tree = fresh_recorder.get("t" * 16)
+        assert len(tree["tree"]) == 1
+        assert tree["tree"][0]["name"] == "GET /x"
