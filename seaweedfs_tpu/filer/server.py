@@ -22,7 +22,9 @@ import time
 from typing import Optional
 
 from .. import tracing
+from ..rpc import policy
 from ..rpc.http_rpc import Request, Response, RpcError, RpcServer, call
+from ..util import faults
 from ..security import Guard, gen_read_jwt, gen_write_jwt
 from ..stats import metrics as stats
 from ..storage.needle import PAIR_NAME_PREFIX
@@ -103,6 +105,7 @@ class FilerServer:
         # /metadata/, /remote/ and /kv/ prefixes below
         self.server.add("GET", "/metrics", stats.metrics_handler)
         self.server.add("GET", "/debug/traces", tracing.traces_handler)
+        faults.mount(self.server)
         self.server.add("GET", "/metadata/subscribe", self._h_subscribe)
         self.server.add("GET", "/metadata/aggregate", self._h_aggregate)
         self.server.add("POST", "/remote/configure", self._h_remote_configure)
@@ -178,13 +181,21 @@ class FilerServer:
             # per-path TTL rules land chunks on TTL volume layouts the
             # master expires wholesale (filer_conf.go -> assign ttl)
             query += f"&ttl={ttl}"
-        return call(self.master_address, f"/dir/assign?{query}", timeout=30)
+        return policy.call_policy(
+            self.master_address, f"/dir/assign?{query}", timeout=30,
+            idempotent=True)
+
+    def _lookup_urls(self, fid: str) -> list[str]:
+        """All replica holders of a fid's volume, via the policy layer
+        (lookup GETs retry with jittered backoff on a flaky master)."""
+        vid = fid.split(",")[0]
+        found = policy.call_policy(
+            self.master_address, f"/dir/lookup?volumeId={vid}",
+            timeout=10)
+        return [l["url"] for l in found["locations"]]
 
     def _lookup_url(self, fid: str) -> str:
-        vid = fid.split(",")[0]
-        found = call(self.master_address, f"/dir/lookup?volumeId={vid}",
-                     timeout=10)
-        return found["locations"][0]["url"]
+        return self._lookup_urls(fid)[0]
 
     def _delete_chunks(self, chunks: list[FileChunk],
                        exclude_fids: Optional[set] = None):
@@ -404,8 +415,12 @@ class FilerServer:
                 # forward the assign-minted write JWT (jwt-enabled
                 # cluster)
                 headers["Authorization"] = "BEARER " + assign["auth"]
-            up = call(url, f"/{fid}", raw=payload, method="POST",
-                      headers=headers, timeout=60)
+            # re-POSTing the same fid+payload dedups on the volume
+            # server (unchanged-content check), so the chunk upload is
+            # safely retryable and rides the breaker for its target
+            up = policy.call_policy(
+                url, f"/{fid}", raw=payload, method="POST",
+                headers=headers, timeout=60, idempotent=True)
         # size is the PLAINTEXT length: interval math over the logical
         # file must not see the nonce/tag overhead
         return FileChunk(fid=fid, offset=0, size=len(piece),
@@ -531,16 +546,30 @@ class FilerServer:
             FilerChunkCacheCounter.inc(labels=("hit",))
             return cached
         FilerChunkCacheCounter.inc(labels=("miss",))
-        url = self._lookup_url(fid)
+        urls = self._lookup_urls(fid)
+        if not urls:
+            raise RpcError(f"chunk {fid} has no locations", 404)
         jwt = (gen_read_jwt(self.guard.read_signing, fid)
                if self.guard.read_signing else "")
-        data = self._fetch_chunk_tcp(url, fid, jwt)
+        data = self._fetch_chunk_tcp(urls[0], fid, jwt) if urls else None
         if data is None:
             headers = {"Authorization": "BEARER " + jwt} if jwt else {}
-            data = call(url, f"/{fid}", headers=headers, timeout=60)
-            if isinstance(data, dict):
-                raise RpcError(f"chunk {fid} fetch failed", 500)
-            data = bytes(data)
+
+            def fetch(url):
+                def attempt():
+                    got = call(url, f"/{fid}", headers=headers,
+                               timeout=60)
+                    if isinstance(got, dict):
+                        raise RpcError(f"chunk {fid} fetch failed", 500,
+                                       addr=url, route=f"/{fid}")
+                    return bytes(got)
+                return attempt
+
+            # hedged replica read: when the volume is replicated, a slow
+            # holder is raced by the next replica after the adaptive p95
+            # delay; on single-copy volumes this degenerates to one call
+            data = policy.hedged(
+                "/chunk_fetch", [fetch(u) for u in urls])
         self.chunk_cache.put(fid, data)
         return data
 

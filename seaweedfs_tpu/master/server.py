@@ -24,6 +24,7 @@ from ..stats import metrics as stats
 from ..storage import types as t
 from ..storage.super_block import ReplicaPlacement
 from ..storage.ttl import TTL
+from ..util import faults
 from . import volume_growth
 from .raft import RaftNode
 from .topology import Topology
@@ -241,6 +242,7 @@ class MasterServer:
         s.add("GET", "/ec/lookup", self._handle_ec_lookup)
         s.add("GET", "/metrics", stats.metrics_handler)
         s.add("GET", "/debug/traces", tracing.traces_handler)
+        faults.mount(s)
         s.add("POST", "/raft/request_vote",
               lambda r: self.raft.handle_request_vote(r.json()))
         s.add("POST", "/raft/append_entries",
@@ -363,7 +365,13 @@ class MasterServer:
             self._grow(collection, rp, ttl, only_if_needed=True)
         picked = self.topo.pick_for_write(collection, rp_byte, ttl_u32)
         if picked is None:
-            raise RpcError("no writable volumes", 404)
+            # assign drought is a transient overload (growth may still
+            # be racing ahead), not a missing resource: shed with 503 +
+            # Retry-After so policy-aware writers back off and retry
+            raise RpcError(
+                "no writable volumes", 503,
+                headers={"Retry-After": str(max(
+                    1, int(self.topo.pulse_seconds)))})
         vid, locations = picked
         key, _ = self.topo.assign_file_id(count)
         cookie = random.getrandbits(32)

@@ -286,6 +286,10 @@ class Topology:
                     if now - n.last_seen > timeout]
         for nid in dead:
             self.unregister_node(nid)
+        if dead:
+            from ..stats import metrics as stats
+
+            stats.TopologyDeadNodesCounter.inc(len(dead))
         return dead
 
     # -- layouts / lookup ----------------------------------------------------

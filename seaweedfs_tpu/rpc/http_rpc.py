@@ -24,12 +24,72 @@ from typing import Callable, Optional
 
 from .. import tracing
 from ..stats import metrics as _stats
+from ..util import faults as _faults
 
 
 class RpcError(Exception):
-    def __init__(self, message: str, status: int = 500):
+    """RPC failure carrying enough context for retry policy: the remote
+    HTTP status (or 503 for transport failures), the destination and
+    route, whether the error is a TRANSPORT failure (peer unreachable /
+    connection died — the request may never have been delivered) vs a
+    REMOTE response (the peer answered with >= 400), and optional extra
+    response headers (Retry-After on shed responses)."""
+
+    def __init__(self, message: str, status: int = 500, *,
+                 addr: str = "", route: str = "",
+                 transport: bool = False,
+                 headers: Optional[dict] = None):
         super().__init__(message)
         self.status = status
+        self.addr = addr
+        self.route = route
+        self.transport = transport
+        self.headers = headers or {}
+
+
+# -- deadline propagation ----------------------------------------------------
+
+DEADLINE_HEADER = "X-Deadline"  # absolute wall-clock epoch seconds
+
+_deadline_local = threading.local()
+
+
+def current_deadline() -> Optional[float]:
+    """The absolute (epoch seconds) deadline pinned on this thread, or
+    None.  Set by deadline_scope() on clients and by the dispatch loop
+    on servers, so nested outbound calls inherit the caller's budget."""
+    return getattr(_deadline_local, "value", None)
+
+
+def set_deadline(value: Optional[float]) -> Optional[float]:
+    prev = getattr(_deadline_local, "value", None)
+    _deadline_local.value = value
+    return prev
+
+
+class deadline_scope:
+    """Context manager pinning an absolute deadline for everything this
+    thread calls: `with deadline_scope(2.0): ...` caps all nested RPC
+    timeouts and is forwarded in X-Deadline.  Never EXTENDS an already
+    tighter inherited deadline."""
+
+    def __init__(self, timeout: Optional[float] = None,
+                 absolute: Optional[float] = None):
+        dl = absolute if absolute is not None else (
+            time.time() + timeout if timeout is not None else None)
+        inherited = current_deadline()
+        if dl is None or (inherited is not None and inherited < dl):
+            dl = inherited
+        self._dl = dl
+        self._prev: Optional[float] = None
+
+    def __enter__(self):
+        self._prev = set_deadline(self._dl)
+        return self._dl
+
+    def __exit__(self, *exc):
+        set_deadline(self._prev)
+        return False
 
 
 class Request:
@@ -145,8 +205,31 @@ class RpcServer:
                 _stats.RpcInflightGauge.labels(service).inc()
                 t0 = time.perf_counter()
                 prev = tracing.swap(sp)
+                # honor the caller's propagated deadline: work it has
+                # already abandoned is rejected, not executed, and the
+                # remaining budget is pinned for nested outbound calls
+                deadline = None
+                dl_header = self.headers.get(DEADLINE_HEADER)
+                if dl_header:
+                    try:
+                        deadline = float(dl_header)
+                    except ValueError:
+                        deadline = None
+                prev_dl = set_deadline(deadline)
                 try:
                     try:
+                        if deadline is not None and \
+                                time.time() >= deadline:
+                            raise RpcError(
+                                f"deadline exceeded before {method} "
+                                f"{label} started", 504)
+                        if _faults.ACTIVE:
+                            try:
+                                _faults.on_rpc("server", outer.address,
+                                               path)
+                            except _faults.FaultInjected as f:
+                                raise RpcError(str(f), f.status) \
+                                    from None
                         if route is None:
                             if outer.default_route is not None:
                                 result = outer.default_route(method, req)
@@ -159,7 +242,8 @@ class RpcServer:
                     except RpcError as e:
                         resp = Response(
                             json.dumps({"error": str(e)}).encode(),
-                            e.status, "application/json")
+                            e.status, "application/json",
+                            headers=dict(e.headers))
                     except Exception as e:  # internal errors as 500 JSON
                         resp = Response(
                             json.dumps({"error": f"{type(e).__name__}: {e}"}
@@ -173,6 +257,7 @@ class RpcServer:
                                                 sp.trace_id)
                     self._reply(resp)
                 finally:
+                    set_deadline(prev_dl)
                     tracing.restore(prev)
                     sp.finish()
                     _stats.RpcInflightGauge.labels(service).dec()
@@ -422,6 +507,10 @@ class _ConnPool:
 
 _POOL = _ConnPool()
 
+# pick up a WEED_FAULTS spec set before process start; daemons/tests
+# that set it later reconfigure via faults.REGISTRY or /debug/faults
+_faults.load_env()
+
 
 def call(addr: str, path: str, payload: Optional[dict] = None,
          method: Optional[str] = None, timeout: float = 30.0,
@@ -439,6 +528,32 @@ def call(addr: str, path: str, payload: Optional[dict] = None,
         req_headers["Content-Type"] = "application/json"
     if method is None:
         method = "POST" if data is not None else "GET"
+    # propagate the thread's deadline: cap this hop's timeout by the
+    # remaining budget and forward the absolute value downstream
+    deadline = current_deadline()
+    if deadline is not None and DEADLINE_HEADER not in req_headers:
+        remaining = deadline - time.time()
+        if remaining <= 0:
+            raise RpcError(
+                f"deadline exceeded before call to {addr}{path}", 504,
+                addr=addr, route=path)
+        timeout = min(timeout, remaining)
+        req_headers[DEADLINE_HEADER] = f"{deadline:.6f}"
+    if _faults.ACTIVE:
+        try:
+            short = _faults.on_rpc("client", addr, path)
+        except _faults.FaultInjected as f:
+            if f.kind == "reset":
+                raise RpcError(
+                    f"cannot reach {addr}: injected connection reset",
+                    503, addr=addr, route=path, transport=True) \
+                    from None
+            raise RpcError(str(f), f.status, addr=addr,
+                           route=path) from None
+        if short is not None:
+            raise RpcError(
+                f"truncated response from {addr}: injected short read",
+                502, addr=addr, route=path, transport=True) from None
     # one retry, ONLY for a pooled connection the server closed while it
     # sat idle (keep-alive reap, restart): those fail with a reset /
     # disconnect before any response.  Timeouts and errors on fresh
@@ -464,11 +579,15 @@ def call(addr: str, path: str, payload: Optional[dict] = None,
             conn.close()
             if attempt == 0 and not fresh:
                 continue
-            raise RpcError(f"cannot reach {addr}: {e}", 503) from None
+            raise RpcError(f"cannot reach {addr}: {e}", 503,
+                           addr=addr, route=path,
+                           transport=True) from None
         except (http.client.HTTPException, ConnectionError,
                 socket.timeout, TimeoutError, OSError) as e:
             conn.close()
-            raise RpcError(f"cannot reach {addr}: {e}", 503) from None
+            raise RpcError(f"cannot reach {addr}: {e}", 503,
+                           addr=addr, route=path,
+                           transport=True) from None
         try:
             # RECEIVE phase: the request reached the server and may have
             # EXECUTED even though the response was lost — only
@@ -482,11 +601,15 @@ def call(addr: str, path: str, payload: Optional[dict] = None,
             conn.close()
             if attempt == 0 and not fresh and method in ("GET", "HEAD"):
                 continue
-            raise RpcError(f"cannot reach {addr}: {e}", 503) from None
+            raise RpcError(f"cannot reach {addr}: {e}", 503,
+                           addr=addr, route=path,
+                           transport=True) from None
         except (http.client.HTTPException, ConnectionError,
                 socket.timeout, TimeoutError, OSError) as e:
             conn.close()
-            raise RpcError(f"cannot reach {addr}: {e}", 503) from None
+            raise RpcError(f"cannot reach {addr}: {e}", 503,
+                           addr=addr, route=path,
+                           transport=True) from None
         if keep:
             _POOL.put(addr, conn)
         else:
@@ -496,7 +619,7 @@ def call(addr: str, path: str, payload: Optional[dict] = None,
                 message = json.loads(body).get("error", body.decode())
             except Exception:
                 message = body.decode(errors="replace")
-            raise RpcError(message, status)
+            raise RpcError(message, status, addr=addr, route=path)
         if parse and "application/json" in ctype:
             return json.loads(body) if body else {}
         return body
@@ -518,6 +641,27 @@ def call_stream(addr: str, path: str, payload: Optional[dict] = None,
         req_headers["Content-Type"] = "application/json"
     if method is None:
         method = "POST" if data is not None else "GET"
+    deadline = current_deadline()
+    if deadline is not None and DEADLINE_HEADER not in req_headers:
+        remaining = deadline - time.time()
+        if remaining <= 0:
+            raise RpcError(
+                f"deadline exceeded before call to {addr}{path}", 504,
+                addr=addr, route=path)
+        timeout = min(timeout, remaining)
+        req_headers[DEADLINE_HEADER] = f"{deadline:.6f}"
+    short_rule = None
+    if _faults.ACTIVE:
+        try:
+            short_rule = _faults.on_rpc("client", addr, path)
+        except _faults.FaultInjected as f:
+            if f.kind == "reset":
+                raise RpcError(
+                    f"cannot reach {addr}: injected connection reset",
+                    503, addr=addr, route=path, transport=True) \
+                    from None
+            raise RpcError(str(f), f.status, addr=addr,
+                           route=path) from None
     req = urllib.request.Request(url, data=data, method=method,
                                  headers=req_headers)
     try:
@@ -528,14 +672,23 @@ def call_stream(addr: str, path: str, payload: Optional[dict] = None,
             message = json.loads(body).get("error", body.decode())
         except Exception:
             message = body.decode(errors="replace")
-        raise RpcError(message, e.code) from None
+        raise RpcError(message, e.code, addr=addr, route=path) from None
     except (urllib.error.URLError, socket.timeout, ConnectionError) as e:
-        raise RpcError(f"cannot reach {addr}: {e}", 503) from None
+        raise RpcError(f"cannot reach {addr}: {e}", 503, addr=addr,
+                       route=path, transport=True) from None
 
     try:
         expected = int(resp.headers.get("Content-Length", ""))
     except ValueError:
         expected = -1  # absent or malformed: length unknown, no check
+
+    # an injected short read truncates the body partway: the advertised
+    # length check below then fails the stream exactly like a real
+    # prematurely-closed transfer
+    cut = None
+    if short_rule is not None:
+        cut = short_rule.nbytes or (
+            expected // 2 if expected > 0 else 1)
 
     def gen():
         got = 0
@@ -545,10 +698,17 @@ def call_stream(addr: str, path: str, payload: Optional[dict] = None,
                     chunk = resp.read(chunk_size)
                 except Exception as e:  # IncompleteRead, socket errors
                     raise RpcError(
-                        f"stream from {addr} broke mid-body: {e}", 502)
+                        f"stream from {addr} broke mid-body: {e}", 502,
+                        addr=addr, route=path, transport=True)
                 if not chunk:
                     break
                 got += len(chunk)
+                if cut is not None and got >= cut:
+                    yield chunk[:max(0, len(chunk) - (got - cut))]
+                    raise RpcError(
+                        f"stream from {addr} broke mid-body: "
+                        f"injected short read [{short_rule.id}]", 502,
+                        addr=addr, route=path, transport=True)
                 yield chunk
             # a prematurely-closed connection can look like EOF on
             # incremental reads; enforce the advertised length so a
@@ -556,7 +716,8 @@ def call_stream(addr: str, path: str, payload: Optional[dict] = None,
             if 0 <= expected != got:
                 raise RpcError(
                     f"truncated stream from {addr}: "
-                    f"{got} of {expected} bytes", 502)
+                    f"{got} of {expected} bytes", 502,
+                    addr=addr, route=path, transport=True)
         finally:
             resp.close()
 

@@ -27,6 +27,7 @@ from ..filer.server import FilerServer
 from .. import tracing
 from ..rpc.http_rpc import Request, Response, RpcError, RpcServer
 from ..stats import metrics as stats
+from ..util import faults
 from .auth import (ACTION_ADMIN, ACTION_LIST, ACTION_READ, ACTION_WRITE,
                    AuthError, Identity, IdentityAccessManagement)
 from .circuit_breaker import CircuitBreaker, SlowDown
@@ -100,11 +101,15 @@ def _build(parent, children):
         parent.text = "" if children is None else str(children)
 
 
-def _error_xml(code: str, message: str, status: int) -> Response:
+def _error_xml(code: str, message: str, status: int,
+               headers: Optional[dict] = None) -> Response:
     root = ET.Element("Error")
     ET.SubElement(root, "Code").text = code
     ET.SubElement(root, "Message").text = message
-    return Response(ET.tostring(root), status, "application/xml")
+    resp = Response(ET.tostring(root), status, "application/xml")
+    if headers:
+        resp.headers.update(headers)
+    return resp
 
 
 class S3ApiServer:
@@ -127,6 +132,7 @@ class S3ApiServer:
         # filer's /metadata//remote//kv mounts shadow user paths
         self.server.add("GET", "/metrics", stats.metrics_handler)
         self.server.add("GET", "/debug/traces", tracing.traces_handler)
+        faults.mount(self.server)
         self.server.default_route = self._handle
 
     @property
@@ -163,7 +169,9 @@ class S3ApiServer:
             except AuthError as e:
                 resp = _error_xml(e.code, str(e), e.status)
             except SlowDown as e:
-                resp = _error_xml("SlowDown", str(e), 503)
+                # retryable shed: tell SDK retry layers when to come back
+                resp = _error_xml("SlowDown", str(e), 503,
+                                  headers={"Retry-After": "1"})
             except NotFoundError as e:
                 resp = _error_xml("NoSuchKey", str(e), 404)
         code = resp.status if isinstance(resp, Response) else 200

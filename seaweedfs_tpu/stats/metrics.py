@@ -345,6 +345,31 @@ RpcInflightGauge = REGISTRY.gauge(
 TraceRetentionCounter = REGISTRY.counter(
     "SeaweedFS_trace_traces_total",
     "root-span trace retention decisions (kept / dropped)", ("result",))
+# fault-tolerance layer vectors: retries/hedges observed CLIENT-side in
+# rpc/policy.py, breaker state per destination, injected faults from
+# util/faults.py, and master-side dead-node reaps
+RpcRetryCounter = REGISTRY.counter(
+    "SeaweedFS_rpc_retries_total",
+    "outbound retry decisions by route and reason "
+    "(retry / budget_dry / deadline)", ("route", "reason"))
+RpcHedgeCounter = REGISTRY.counter(
+    "SeaweedFS_rpc_hedges_total",
+    "hedged idempotent reads by route (fired / win)",
+    ("route", "outcome"))
+BreakerStateGauge = REGISTRY.gauge(
+    "SeaweedFS_breaker_state",
+    "per-destination circuit breaker state "
+    "(0=closed 1=open 2=half-open)", ("dst",))
+FaultsInjectedCounter = REGISTRY.counter(
+    "SeaweedFS_faults_injected_total",
+    "faults fired by the deterministic injection registry",
+    ("kind", "rule"))
+TopologyDeadNodesCounter = REGISTRY.counter(
+    "SeaweedFS_topology_dead_nodes_total",
+    "volume servers reaped by the master after missed heartbeats")
+VolumeReadonlyDemotions = REGISTRY.counter(
+    "SeaweedFS_volume_readonly_demotions_total",
+    "volumes auto-demoted to read-only after disk write failures")
 
 
 # -- process self-metrics (the reference's Go runtime collectors:
@@ -427,9 +452,11 @@ def start_metrics_server(host: str = "127.0.0.1",
     mount /metrics there without shadowing user data."""
     from .. import tracing
     from ..rpc.http_rpc import RpcServer
+    from ..util import faults
 
     server = RpcServer(host, port, service_name="metrics")
     server.add("GET", "/metrics", metrics_handler)
     server.add("GET", "/debug/traces", tracing.traces_handler)
+    faults.mount(server)
     server.start()
     return server

@@ -10,9 +10,15 @@ from __future__ import annotations
 
 import os
 
+from ..util import faults as _faults
+
 
 class DiskFile:
-    """Positional-IO wrapper over one OS file (backend/disk_file.go)."""
+    """Positional-IO wrapper over one OS file (backend/disk_file.go).
+    Every operation passes the fault-injection disk hook first (a no-op
+    module-bool check while no rules are loaded), so chaos tests can
+    make a specific .dat file start throwing EIO and watch the volume
+    demote itself to read-only."""
 
     def __init__(self, path: str, create: bool = False):
         self.path = path
@@ -22,13 +28,19 @@ class DiskFile:
         self._fd = os.open(path, flags, 0o644)
 
     def read_at(self, size: int, offset: int) -> bytes:
+        if _faults.ACTIVE:
+            _faults.on_disk(self.path, "read")
         return os.pread(self._fd, size, offset)
 
     def write_at(self, data: bytes, offset: int) -> int:
+        if _faults.ACTIVE:
+            _faults.on_disk(self.path, "write")
         return os.pwrite(self._fd, data, offset)
 
     def append(self, data: bytes) -> int:
         """Write at EOF; returns the offset the data landed at."""
+        if _faults.ACTIVE:
+            _faults.on_disk(self.path, "write")
         end = self.size()
         os.pwrite(self._fd, data, end)
         return end
@@ -40,6 +52,8 @@ class DiskFile:
         return os.fstat(self._fd).st_size
 
     def sync(self):
+        if _faults.ACTIVE:
+            _faults.on_disk(self.path, "sync")
         os.fsync(self._fd)
 
     def close(self):
@@ -93,12 +107,16 @@ class MmapFile:
         return bytes(self._map[offset:min(end, len(self._map))])
 
     def write_at(self, data: bytes, offset: int) -> int:
+        if _faults.ACTIVE:
+            _faults.on_disk(self.path, "write")
         n = os.pwrite(self._fd, data, offset)
         if self._map is not None and offset + n <= len(self._map):
             self._remap()  # overwrite within the mapped range: refresh
         return n
 
     def append(self, data: bytes) -> int:
+        if _faults.ACTIVE:
+            _faults.on_disk(self.path, "write")
         end = os.fstat(self._fd).st_size
         os.pwrite(self._fd, data, end)
         return end

@@ -396,8 +396,22 @@ class EcVolume:
         if len(shards) < DATA_SHARDS_COUNT and remote_candidates:
             import concurrent.futures as cf
 
+            from ...rpc.http_rpc import current_deadline, set_deadline
+
+            # pool workers don't share this thread's locals: pin the
+            # caller's propagated deadline on each fetch so survivor
+            # RPCs stay inside the budget the client handed us
+            dl = current_deadline()
+
+            def fetch(sid: int):
+                prev = set_deadline(dl)
+                try:
+                    return self.remote_reader(sid, offset, size)
+                finally:
+                    set_deadline(prev)
+
             pool = _recover_pool()
-            futs = {pool.submit(self.remote_reader, sid, offset, size): sid
+            futs = {pool.submit(fetch, sid): sid
                     for sid in remote_candidates}
             try:
                 for fut in cf.as_completed(futs):

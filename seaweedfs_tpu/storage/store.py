@@ -47,6 +47,10 @@ class Store:
         self.volume_size_limit = 0
         self.lock = threading.RLock()
         self.ec_encoder_backend = ec_encoder_backend
+        # called with the vid after a disk-failure read-only demotion so
+        # the owning daemon can push a heartbeat immediately (the master
+        # must stop assigning writes before the next pulse)
+        self.on_demote: Optional[Callable[[int], None]] = None
 
     @property
     def url(self) -> str:
@@ -110,8 +114,38 @@ class Store:
         v = self.find_volume(vid)
         if v is None:
             raise NotFoundError(f"volume {vid} not found")
-        _, size, unchanged = v.write_needle(n, check_cookie=check_cookie)
+        try:
+            _, size, unchanged = v.write_needle(
+                n, check_cookie=check_cookie)
+        except OSError as e:
+            # a failing disk write demotes the volume to read-only on
+            # the spot: reads still serve, the next heartbeat reports
+            # read_only and the master stops assigning writes here
+            # (store.go MarkVolumeReadonly on write error)
+            self._demote_readonly(vid, v, e)
+            raise VolumeError(
+                f"volume {vid} demoted read-only: "
+                f"disk write failed: {e}") from e
         return size, unchanged
+
+    def _demote_readonly(self, vid: int, v: Volume, err: Exception):
+        from ..stats import metrics as stats
+        from ..util import glog
+
+        try:
+            v.read_only = True
+        except Exception:
+            # even flag persistence may fail on a dead disk; the
+            # in-memory flag below is what gates writes
+            v._read_only = True
+        stats.VolumeReadonlyDemotions.inc()
+        glog.errorf("volume %d demoted read-only after disk error: %s",
+                    vid, err)
+        if self.on_demote is not None:
+            try:
+                self.on_demote(vid)
+            except Exception:
+                pass  # heartbeat push is best-effort
 
     def read_needle(self, vid: int, nid: int,
                     cookie: Optional[int] = None) -> Needle:

@@ -24,8 +24,10 @@ import time
 from typing import Optional
 
 from .. import tracing
+from ..rpc import policy
 from ..rpc.http_rpc import (Request, Response, RpcError, RpcServer, call,
                             call_stream, stream_file)
+from ..util import faults
 from ..security import Guard, gen_write_jwt, token_from_request
 from ..stats import metrics as stats
 from ..storage import types as t
@@ -97,6 +99,41 @@ class _InflightGate:
             self._cond.notify_all()
 
 
+class _RequestShedder:
+    """Bounded-inflight load shedding for the object API: unlike the
+    byte gates above (which QUEUE callers), excess requests are shed
+    immediately with 503 + Retry-After so clients back off instead of
+    piling onto a saturated server.  Zero limit = off; the limit is
+    re-read per request (WEED_VS_MAX_INFLIGHT) so it can be flipped
+    live."""
+
+    def __init__(self, limit: int = 0):
+        self.limit = limit
+        self._current = 0
+        self._lock = threading.Lock()
+
+    def _effective_limit(self) -> int:
+        env = os.environ.get("WEED_VS_MAX_INFLIGHT", "")
+        return int(env) if env else self.limit
+
+    def try_acquire(self) -> bool:
+        limit = self._effective_limit()
+        with self._lock:
+            if limit > 0 and self._current >= limit:
+                return False
+            self._current += 1
+            return True
+
+    def release(self):
+        with self._lock:
+            self._current -= 1
+
+    @property
+    def current(self) -> int:
+        with self._lock:
+            return self._current
+
+
 def _remove_quiet(*paths: str):
     """Best-effort unlink for rollback paths."""
     for path in paths:
@@ -164,12 +201,14 @@ class VolumeServer:
                  guard: Optional[Guard] = None, tier_backends=None,
                  enable_tcp: bool = False, read_mode: str = "proxy",
                  needle_map_kind: str = "memory", fsync: bool = False,
-                 upload_limit_mb: int = 0, download_limit_mb: int = 0):
+                 upload_limit_mb: int = 0, download_limit_mb: int = 0,
+                 max_inflight_requests: int = 0):
         if read_mode not in ("local", "proxy", "redirect"):
             raise ValueError(f"unknown readMode {read_mode!r}")
         self.read_mode = read_mode
         self.upload_gate = _InflightGate(upload_limit_mb << 20)
         self.download_gate = _InflightGate(download_limit_mb << 20)
+        self.request_shedder = _RequestShedder(max_inflight_requests)
         self.enable_tcp = enable_tcp
         self._tcp_sock = None
         # tier backends must be registered before Store discovery so
@@ -192,6 +231,10 @@ class VolumeServer:
             data_center=data_center, rack=rack,
             ec_encoder_backend=ec_encoder_backend,
             needle_map_kind=needle_map_kind, fsync=fsync)
+        # a disk-failure demotion must reach the master NOW, not at the
+        # next pulse: assigns in the gap would keep landing on the
+        # demoted volume (the heartbeat reports read_only per volume)
+        self.store.on_demote = lambda vid: self._try_heartbeat()
         self._stop = threading.Event()
         # per-volume-id copy locks: concurrent copies of the SAME vid must
         # not race each other's temp files / exists-checks, but a slow copy
@@ -503,22 +546,18 @@ class VolumeServer:
         hb = self.store.collect_heartbeat()
         targets = [self.master_address] + [
             m for m in self._seed_masters if m != self.master_address]
-        last_err = None
-        for target in targets:
-            try:
-                resp = call(target, "/api/heartbeat", hb, timeout=10)
-            except RpcError as e:
-                last_err = e
-                continue
-            self.master_address = target
-            self.store.volume_size_limit = resp.get("volume_size_limit", 0)
-            # raft leader failover (volume_grpc_client_to_master.go:46-76):
-            # keep heartbeating the leader so assigns see our volumes
-            leader = resp.get("leader_address")
-            if leader and not resp.get("leader", True):
-                self.master_address = leader
-            return resp
-        raise last_err or RpcError("no master reachable", 503)
+        # shared failover policy: per-master breakers skip a dead seed,
+        # full-jitter backoff separates rounds (was a hand-rolled loop)
+        resp, winner = policy.failover_call(
+            targets, "/api/heartbeat", payload=hb, timeout=10, rounds=1)
+        self.master_address = winner
+        self.store.volume_size_limit = resp.get("volume_size_limit", 0)
+        # raft leader failover (volume_grpc_client_to_master.go:46-76):
+        # keep heartbeating the leader so assigns see our volumes
+        leader = resp.get("leader_address")
+        if leader and not resp.get("leader", True):
+            self.master_address = leader
+        return resp
 
     def _heartbeat_loop(self):
         while not self._stop.is_set():
@@ -588,6 +627,7 @@ class VolumeServer:
         s.add("POST", "/query", self._h_query)
         s.add("GET", "/metrics", self._h_metrics)
         s.add("GET", "/debug/traces", tracing.traces_handler)
+        faults.mount(s)
         s.add("GET", "/ui", self._h_ui)
         s.default_route = self._handle_object
 
@@ -748,6 +788,17 @@ class VolumeServer:
 
     # -- public object API ---------------------------------------------------
     def _handle_object(self, method: str, req: Request):
+        if not self.request_shedder.try_acquire():
+            stats.VolumeServerThrottleRejects.labels("inflight").inc()
+            raise RpcError(
+                "too many requests: inflight limit", 503,
+                headers={"Retry-After": "1"})
+        try:
+            return self._handle_object_inner(method, req)
+        finally:
+            self.request_shedder.release()
+
+    def _handle_object_inner(self, method: str, req: Request):
         fid = req.path.lstrip("/").replace("/", ",", 1)
         if not fid or "," not in fid:
             raise RpcError(f"invalid fid path {req.path!r}", 400)
@@ -876,8 +927,9 @@ class VolumeServer:
             # until threads exhaust)
             raise RpcError(f"volume {vid} not found at proxy target", 404)
         try:
-            lookup = call(self.master_address,
-                          f"/dir/lookup?volumeId={vid}", timeout=10)
+            lookup = policy.call_policy(
+                self.master_address, f"/dir/lookup?volumeId={vid}",
+                timeout=10)
         except RpcError:
             lookup = {}
         others = [loc for loc in lookup.get("locations", [])
@@ -973,8 +1025,9 @@ class VolumeServer:
         """Fan out to the other replicas (store_replicate.go:24-114);
         any replica failure fails the request, as in the reference."""
         try:
-            lookup = call(self.master_address, f"/dir/lookup?volumeId={vid}",
-                          timeout=10)
+            lookup = policy.call_policy(
+                self.master_address, f"/dir/lookup?volumeId={vid}",
+                timeout=10)
         except RpcError:
             return  # master unreachable: single-copy write stands
         others = [loc["url"] for loc in lookup.get("locations", [])
@@ -995,8 +1048,14 @@ class VolumeServer:
         with tracing.span("needle.replicate",
                           tags={"fid": fid, "replicas": len(others)}):
             for url in others:
-                call(url, f"/{fid}?type=replicate", method=method, raw=body,
-                     headers=headers, timeout=30)
+                # breaker-guarded, retried fan-out: type=replicate is
+                # idempotent (unchanged-content writes dedup), so a
+                # flaky replica gets jittered retries and a dead one
+                # fails fast once its breaker opens
+                policy.call_policy(
+                    url, f"/{fid}?type=replicate", method=method,
+                    raw=body, headers=headers, timeout=30,
+                    idempotent=True)
 
     # -- admin ---------------------------------------------------------------
     def _h_assign_volume(self, req: Request):
@@ -1414,23 +1473,36 @@ class VolumeServer:
         def remote_reader(shard_id: int, offset: int,
                           size: int) -> Optional[bytes]:
             locations = self._ec_shard_locations(vid).get(shard_id, [])
-            for url in locations:
-                if url == self.store.url:
-                    continue
-                try:
+            candidates = [u for u in locations if u != self.store.url]
+            if not candidates:
+                self._note_ec_lookup_error(vid)
+                return None
+
+            def fetch(url):
+                def attempt():
                     data = call(
                         url,
                         f"/admin/ec/shard_read?volume={vid}"
                         f"&shard={shard_id}&offset={offset}&size={size}",
                         timeout=30)
-                    if isinstance(data, (bytes, bytearray)):
-                        return bytes(data)
-                except RpcError:
-                    continue
-            # all candidates failed: demote the cache entry to the
-            # error tier so the next read re-resolves quickly
-            self._note_ec_lookup_error(vid)
-            return None
+                    if not isinstance(data, (bytes, bytearray)):
+                        raise RpcError(
+                            f"unexpected shard_read reply from {url}",
+                            502, addr=url, transport=True)
+                    return bytes(data)
+                return attempt
+
+            # hedged survivor fetch: a slow holder stops gating the
+            # whole degraded read once the adaptive p95 delay elapses —
+            # the next holder races it and the first answer wins
+            try:
+                return policy.hedged("/admin/ec/shard_read",
+                                     [fetch(u) for u in candidates])
+            except Exception:
+                # all candidates failed: demote the cache entry to the
+                # error tier so the next read re-resolves quickly
+                self._note_ec_lookup_error(vid)
+                return None
         return remote_reader
 
     def _note_ec_lookup_error(self, vid: int):
@@ -1454,8 +1526,9 @@ class VolumeServer:
             if now - fetched_at < ttl:
                 return locations
         try:
-            resp = call(self.master_address, f"/ec/lookup?volumeId={vid}",
-                        timeout=10)
+            resp = policy.call_policy(
+                self.master_address, f"/ec/lookup?volumeId={vid}",
+                timeout=10)
             locations = {
                 e["shard_id"]: [loc["url"] for loc in e["locations"]]
                 for e in resp.get("shard_id_locations", [])

@@ -12,7 +12,8 @@ import random
 import threading
 from typing import Optional
 
-from ..rpc.http_rpc import RpcError, call
+from ..rpc import policy
+from ..rpc.http_rpc import RpcError
 from ..util import glog
 
 
@@ -99,19 +100,16 @@ class MasterClient:
     def _call_any(self, path: str, payload: Optional[dict] = None,
                   timeout: float = 30):
         """Try current master first, fail over through the list
-        (masterclient.go tryAllMasters)."""
+        (masterclient.go tryAllMasters) — via the shared policy layer:
+        per-master circuit breakers skip known-dead peers, full-jitter
+        backoff separates failover rounds, and the propagated deadline
+        caps the whole sweep."""
         masters = [self.current_master] + [
             m for m in self.masters if m != self.current_master]
-        last_err: Optional[RpcError] = None
-        for m in masters:
-            try:
-                result = call(m, path, payload, timeout=timeout)
-                self.current_master = m
-                return result
-            except RpcError as e:
-                last_err = e
-                continue
-        raise last_err or RpcError("no master reachable", 503)
+        result, winner = policy.failover_call(
+            masters, path, payload=payload, timeout=timeout)
+        self.current_master = winner
+        return result
 
     # -- keep-connected watch loop (masterclient.go KeepConnected) -----------
     def start(self):
@@ -124,11 +122,17 @@ class MasterClient:
     def _watch_loop(self):
         while not self._stop.is_set():
             try:
-                r = call(self.current_master,
-                         f"/dir/watch?since={self._seq}&timeout=15",
-                         timeout=20)
+                r = policy.call_policy(
+                    self.current_master,
+                    f"/dir/watch?since={self._seq}&timeout=15",
+                    timeout=20, retries=0)
             except RpcError:
-                self.current_master = random.choice(self.masters)
+                # re-aim at a master whose breaker isn't open (the
+                # failed poll just fed that breaker via call_policy)
+                healthy = [m for m in self.masters
+                           if policy.BREAKERS.get(m).state
+                           != policy.OPEN] or self.masters
+                self.current_master = random.choice(healthy)
                 self._stop.wait(1.0)
                 continue
             feed_id = r.get("feed_id", "")

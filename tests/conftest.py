@@ -40,3 +40,12 @@ def reference_fixture(relpath):
     or None when the reference is not mounted (tests should skip)."""
     p = os.path.join(REFERENCE_ROOT, relpath)
     return p if os.path.exists(p) else None
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running tests, excluded from tier-1 "
+        "(-m 'not slow')")
+    config.addinivalue_line(
+        "markers", "chaos: fault-injection soak tests over a live "
+        "mini-cluster")

@@ -1,0 +1,138 @@
+"""Chaos suite: a fast deterministic-replay slice over a live
+mini-cluster (tier-1) plus a fault-injection soak (-m chaos, slow).
+
+The soak is the acceptance drill for the robustness layer: one volume
+server dies, 5% of client RPCs to volume servers fail and some crawl,
+yet every read must come back byte-identical, the client-visible error
+rate stays under 1%, and no read outlives its propagated deadline."""
+
+import os
+import time
+
+import pytest
+
+from seaweedfs_tpu.master.server import MasterServer
+from seaweedfs_tpu.rpc import policy
+from seaweedfs_tpu.rpc.http_rpc import RpcError, call, deadline_scope
+from seaweedfs_tpu.util import faults
+from seaweedfs_tpu.volume_server.server import VolumeServer
+
+
+@pytest.fixture(autouse=True)
+def clean_state():
+    faults.REGISTRY.clear()
+    policy.BREAKERS.reset()
+    yield
+    faults.REGISTRY.clear()
+    policy.BREAKERS.reset()
+
+
+def test_deterministic_replay_over_live_cluster(tmp_path):
+    """Same spec + seed => the same reads fail with the same injected
+    faults, replayed via POST /debug/faults {"reset": true}."""
+    master = MasterServer(port=0, pulse_seconds=0.2)
+    master.start()
+    d = tmp_path / "v"
+    d.mkdir()
+    vs = VolumeServer([str(d)], master.address, port=0, pulse_seconds=0.2)
+    vs.start()
+    vs.heartbeat_once()
+    try:
+        fids = []
+        for i in range(8):
+            a = call(master.address, "/dir/assign")
+            call(a["url"], f"/{a['fid']}", raw=b"x" * (100 + i),
+                 method="POST")
+            fids.append((a["url"], a["fid"]))
+
+        # object routes only ("/<vid>,..."): assigns/heartbeats unharmed
+        call(master.address, "/debug/faults",
+             {"spec": "error,status=503,pct=50,side=client,"
+                      "route=/[0-9]*", "seed": 1234})
+
+        def read_pattern():
+            pattern = []
+            for url, fid in fids * 3:
+                try:
+                    call(url, f"/{fid}")
+                    pattern.append(True)
+                except RpcError as e:
+                    assert e.status == 503
+                    pattern.append(False)
+            return pattern
+
+        first = read_pattern()
+        assert False in first and True in first
+        log_first = call(master.address, "/debug/faults")["log"]
+        assert log_first
+
+        call(master.address, "/debug/faults", {"reset": True})
+        assert read_pattern() == first
+        assert call(master.address, "/debug/faults")["log"] == log_first
+    finally:
+        vs.stop()
+        master.stop()
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_soak_replicated_reads_survive_faults(tmp_path):
+    master = MasterServer(port=0, volume_size_limit_mb=64,
+                          pulse_seconds=0.2)
+    master.start()
+    servers = []
+    for i in range(3):
+        d = tmp_path / f"vs{i}"
+        d.mkdir()
+        vs = VolumeServer([str(d)], master.address, port=0,
+                          rack=f"rack{i % 2}", pulse_seconds=0.2)
+        vs.start()
+        vs.heartbeat_once()
+        servers.append(vs)
+    try:
+        stored = {}
+        for i in range(40):
+            a = call(master.address, "/dir/assign?replication=010")
+            payload = os.urandom(600 + i)
+            call(a["url"], f"/{a['fid']}", raw=payload, method="POST")
+            stored[a["fid"]] = payload
+
+        # kill one replica holder, then let the storm begin: 5% errors
+        # and a sprinkling of 50 ms stalls on all object RPCs
+        victim = servers[0]
+        victim.stop()
+        faults.REGISTRY.configure(
+            "error,status=503,pct=5,side=client,route=/[0-9]*;"
+            "latency,ms=50,pct=10,side=client,route=/[0-9]*", seed=99)
+
+        failures = 0
+        for fid, payload in stored.items():
+            vid = int(fid.split(",")[0])
+            found = call(master.address, f"/dir/lookup?volumeId={vid}")
+            urls = [loc["url"] for loc in found["locations"]]
+            assert urls
+            t0 = time.monotonic()
+            body = None
+            with deadline_scope(timeout=10.0):
+                for url in urls:  # policy retries, then replica failover
+                    try:
+                        body = policy.call_policy(url, f"/{fid}",
+                                                  method="GET",
+                                                  idempotent=True)
+                        break
+                    except RpcError:
+                        continue
+            elapsed = time.monotonic() - t0
+            assert elapsed <= 10.5, \
+                f"read of {fid} outlived its deadline: {elapsed:.1f}s"
+            if body is None:
+                failures += 1
+            else:
+                assert body == payload  # byte-identical under chaos
+        assert failures / len(stored) < 0.01, \
+            f"{failures}/{len(stored)} reads failed"
+        assert faults.REGISTRY.snapshot()["rules"][0]["fires"] > 0
+    finally:
+        for vs in servers[1:]:
+            vs.stop()
+        master.stop()

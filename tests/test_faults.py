@@ -1,0 +1,241 @@
+"""Deterministic fault injection: spec parsing, glob matching, replayable
+decisions, hook-site error enrichment, the /debug/faults control
+endpoint, and disk-fault read-only demotion."""
+
+import time
+
+import pytest
+
+from seaweedfs_tpu.master.server import MasterServer
+from seaweedfs_tpu.rpc import policy
+from seaweedfs_tpu.rpc.http_rpc import RpcError, call
+from seaweedfs_tpu.stats import metrics as stats
+from seaweedfs_tpu.util import faults
+from seaweedfs_tpu.util.faults import FaultInjected, parse_spec
+from seaweedfs_tpu.volume_server.server import VolumeServer
+
+
+@pytest.fixture(autouse=True)
+def clean_state():
+    faults.REGISTRY.clear()
+    policy.BREAKERS.reset()
+    yield
+    faults.REGISTRY.clear()
+    policy.BREAKERS.reset()
+
+
+def fire_pattern(n, side="client", dst="a:1", route="/x"):
+    """True per event where the registry injected an error."""
+    pattern = []
+    for _ in range(n):
+        try:
+            faults.REGISTRY.on_rpc(side, dst, route)
+            pattern.append(False)
+        except FaultInjected:
+            pattern.append(True)
+    return pattern
+
+
+class TestSpecAndMatching:
+    def test_parse_spec(self):
+        rules = parse_spec(
+            "error,status=429,pct=5,dst=127.0.0.1:8080,route=/dir/*;"
+            "latency,ms=50,side=server,times=3,id=slow")
+        assert len(rules) == 2
+        e, l = rules
+        assert (e.kind, e.status, e.pct, e.dst, e.route) == \
+            ("error", 429, 5.0, "127.0.0.1:8080", "/dir/*")
+        assert e.id == "error#0"  # stable default id
+        assert (l.kind, l.ms, l.side, l.times, l.id) == \
+            ("latency", 50.0, "server", 3, "slow")
+
+    def test_unknown_kind_rejected(self):
+        with pytest.raises(ValueError):
+            parse_spec("explode,pct=1")
+
+    def test_glob_and_side_matching(self):
+        faults.REGISTRY.configure(
+            "error,dst=127.0.0.1:*,route=/dir/lookup*,side=client")
+        # matching event fires
+        with pytest.raises(FaultInjected):
+            faults.REGISTRY.on_rpc("client", "127.0.0.1:9333",
+                                   "/dir/lookup?volumeId=3")
+        # wrong side / dst / route all pass through
+        faults.REGISTRY.on_rpc("server", "127.0.0.1:9333", "/dir/lookup")
+        faults.REGISTRY.on_rpc("client", "10.0.0.1:9333", "/dir/lookup")
+        faults.REGISTRY.on_rpc("client", "127.0.0.1:9333", "/dir/assign")
+
+    def test_times_cap(self):
+        faults.REGISTRY.configure("error,times=2")
+        assert fire_pattern(10).count(True) == 2
+
+    def test_short_read_rule_returned_not_raised(self):
+        faults.REGISTRY.configure("short_read,bytes=3")
+        rule = faults.REGISTRY.on_rpc("client", "a:1", "/x")
+        assert rule is not None and rule.nbytes == 3
+
+    def test_latency_uses_injectable_sleep(self):
+        slept = []
+        faults.REGISTRY.configure("latency,ms=50")
+        faults.REGISTRY.sleep = slept.append
+        faults.REGISTRY.on_rpc("client", "a:1", "/x")
+        assert slept == [0.05]
+
+    def test_active_flag_tracks_rules(self):
+        assert not faults.ACTIVE
+        faults.REGISTRY.configure("error,pct=1")
+        assert faults.ACTIVE
+        faults.REGISTRY.clear()
+        assert not faults.ACTIVE
+
+
+class TestDeterminism:
+    def test_same_seed_replays_identical_sequence(self):
+        faults.REGISTRY.configure("error,pct=50", seed=42)
+        first = fire_pattern(200)
+        log_first = faults.REGISTRY.snapshot()["log"]
+        assert 0 < first.count(True) < 200  # actually probabilistic
+        faults.REGISTRY.reset_counters()
+        assert fire_pattern(200) == first
+        assert faults.REGISTRY.snapshot()["log"] == log_first
+
+    def test_different_seed_differs(self):
+        faults.REGISTRY.configure("error,pct=50", seed=1)
+        a = fire_pattern(200)
+        faults.REGISTRY.configure("error,pct=50", seed=2)
+        assert fire_pattern(200) != a
+
+    def test_rules_decide_independently(self):
+        """Interleaving events of OTHER rules must not perturb a rule's
+        own fire sequence (the whole point of hashed decisions)."""
+        faults.REGISTRY.configure("error,pct=50,route=/a", seed=9)
+        a_alone = fire_pattern(100, route="/a")
+        faults.REGISTRY.configure(
+            "error,pct=50,route=/a;error,pct=50,route=/b", seed=9)
+        interleaved = []
+        for _ in range(100):
+            try:
+                faults.REGISTRY.on_rpc("client", "a:1", "/b")
+            except FaultInjected:
+                pass
+            try:
+                faults.REGISTRY.on_rpc("client", "a:1", "/a")
+                interleaved.append(False)
+            except FaultInjected:
+                interleaved.append(True)
+        assert interleaved == a_alone
+
+
+class TestHookEnrichment:
+    def test_injected_error_carries_status_addr_route(self):
+        faults.REGISTRY.configure("error,status=418,dst=127.0.0.1:19999")
+        with pytest.raises(RpcError) as e:
+            call("127.0.0.1:19999", "/x")
+        assert e.value.status == 418
+        assert e.value.addr == "127.0.0.1:19999"
+        assert e.value.route == "/x"
+        assert not e.value.transport
+
+    def test_injected_reset_is_transport(self):
+        faults.REGISTRY.configure("reset,dst=127.0.0.1:19999")
+        with pytest.raises(RpcError) as e:
+            call("127.0.0.1:19999", "/x")
+        assert e.value.transport and e.value.status == 503
+
+    def test_real_unreachable_is_transport(self):
+        with pytest.raises(RpcError) as e:
+            call("127.0.0.1:1", "/x", timeout=2)
+        assert e.value.transport
+        assert e.value.addr == "127.0.0.1:1"
+
+    def test_remote_4xx_is_not_transport(self):
+        master = MasterServer(port=0, pulse_seconds=0.2)
+        master.start()
+        try:
+            with pytest.raises(RpcError) as e:
+                call(master.address, "/no/such/route")
+            assert e.value.status == 404
+            assert not e.value.transport
+            assert e.value.addr == master.address
+            assert e.value.route == "/no/such/route"
+        finally:
+            master.stop()
+
+    def test_server_side_fault(self):
+        master = MasterServer(port=0, pulse_seconds=0.2)
+        master.start()
+        try:
+            faults.REGISTRY.configure(
+                "error,status=503,side=server,route=/dir/lookup*")
+            with pytest.raises(RpcError) as e:
+                call(master.address, "/dir/lookup?volumeId=1")
+            assert e.value.status == 503 and not e.value.transport
+        finally:
+            master.stop()
+
+
+class TestDebugEndpoint:
+    def test_inspect_and_flip_rules_live(self):
+        master = MasterServer(port=0, pulse_seconds=0.2)
+        master.start()
+        try:
+            snap = call(master.address, "/debug/faults")
+            assert snap["rules"] == []
+            # route-scoped so the control-plane calls below stay clean
+            snap = call(master.address, "/debug/faults",
+                        {"spec": "error,pct=50,id=x,route=/t/*",
+                         "seed": 7})
+            assert snap["seed"] == 7
+            assert [r["id"] for r in snap["rules"]] == ["x"]
+            fire_pattern(10, route="/t/1")
+            snap = call(master.address, "/debug/faults")
+            assert snap["rules"][0]["matches"] == 10
+            assert len(snap["log"]) == snap["rules"][0]["fires"]
+            snap = call(master.address, "/debug/faults", {"reset": True})
+            assert snap["rules"][0]["matches"] == 0 and snap["log"] == []
+            snap = call(master.address, "/debug/faults", {"clear": True})
+            assert snap["rules"] == [] and not faults.ACTIVE
+        finally:
+            master.stop()
+
+
+class TestDiskFaults:
+    def test_disk_write_fault_demotes_volume_readonly(self, tmp_path,
+                                                      monkeypatch):
+        # the native engine appends off-Python, below the fault hooks;
+        # force the DiskFile write path so injected EIO is seen
+        from seaweedfs_tpu.storage import native_engine
+        monkeypatch.setattr(native_engine, "available", lambda: False)
+        master = MasterServer(port=0, pulse_seconds=0.2)
+        master.start()
+        d = tmp_path / "v"
+        d.mkdir()
+        vs = VolumeServer([str(d)], master.address, port=0,
+                          pulse_seconds=0.2)
+        vs.start()
+        vs.heartbeat_once()
+        try:
+            a = call(master.address, "/dir/assign")
+            call(a["url"], f"/{a['fid']}", raw=b"healthy", method="POST")
+            demotions = sum(stats.VolumeReadonlyDemotions._values
+                            .values()) or 0.0
+
+            faults.REGISTRY.configure(
+                f"disk_error,side=disk,dst={d}/*,route=write")
+            b = call(master.address, "/dir/assign")
+            with pytest.raises(RpcError) as e:
+                call(b["url"], f"/{b['fid']}", raw=b"doomed",
+                     method="POST")
+            assert "read-only" in str(e.value)
+
+            # the volume the doomed write hit is the one demoted
+            v = vs.store.find_volume(int(b["fid"].split(",")[0]))
+            assert v is not None and v.read_only
+            assert sum(stats.VolumeReadonlyDemotions._values.values()) \
+                == demotions + 1
+            # the healthy needle still reads after demotion
+            faults.REGISTRY.clear()
+            assert call(a["url"], f"/{a['fid']}") == b"healthy"
+        finally:
+            vs.stop()
+            master.stop()
