@@ -586,16 +586,34 @@ def bench_ec_degraded_read(num_files: int = 2000,
         shutil.rmtree(workdir, ignore_errors=True)
 
 
+def _stage_fractions(spans: dict, roots: tuple) -> dict:
+    """Render a RECORDER.aggregate() dict as per-stage fractions of the
+    named root spans' total seconds (the gateway stage breakdown)."""
+    total = sum(spans.get(r, {}).get("seconds", 0.0) for r in roots)
+    out = {}
+    for name, s in sorted(spans.items()):
+        frac = (s["seconds"] / total) if total else 0.0
+        out[name] = {"count": s["count"],
+                     "seconds": round(s["seconds"], 4),
+                     "fraction": round(frac, 3)}
+    return out
+
+
 def bench_s3_gateway(num_objects: int = 5000) -> dict:
     """Small-object data plane through the S3 gateway vs the filer's own
     HTTP API — the gateway's overhead is auth + XML + key mapping on top
     of the same save_bytes/read_bytes machinery (object bytes ride the
     filer's chunk paths, which use the native fast path when available).
     1 KB objects, keep-alive connections, 8 concurrent workers.
+    The client is a hand-rolled HTTP/1.1 loop over raw sockets: client
+    and daemons share one interpreter here, and http.client's
+    email-parser header machinery costs as much GIL time per request as
+    the entire gateway — the lean client measures the gateway, not the
+    measurement.
     Returns {s3_put_rps, s3_get_rps, filer_put_rps, filer_get_rps}."""
     from seaweedfs_tpu.storage import native_engine  # noqa: F401
 
-    import http.client
+    import socket
     import tempfile
     from concurrent.futures import ThreadPoolExecutor
 
@@ -603,6 +621,14 @@ def bench_s3_gateway(num_objects: int = 5000) -> dict:
     from seaweedfs_tpu.master.server import MasterServer
     from seaweedfs_tpu.s3api.server import S3ApiServer
     from seaweedfs_tpu.volume_server.server import VolumeServer
+
+    # earlier bench phases leave hundreds of thousands of live objects
+    # (needle maps, filer entries); without a freeze every gen-2 GC pass
+    # walks them all mid-request and the allocation-heavy gateway loop
+    # triggers those passes constantly
+    import gc
+    gc.collect()
+    gc.freeze()
 
     workdir = tempfile.mkdtemp(prefix="swbench_s3_")
     master = MasterServer(port=0, pulse_seconds=1.0,
@@ -623,16 +649,35 @@ def bench_s3_gateway(num_objects: int = 5000) -> dict:
         def phase(address, method, path_of, nreq, body, workers=8):
             def worker(span):
                 host, port = address.rsplit(":", 1)
-                conn = http.client.HTTPConnection(host, int(port),
-                                                 timeout=30)
+                sock = socket.create_connection((host, int(port)),
+                                                timeout=30)
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                rfile = sock.makefile("rb", buffering=65536)
+                head = f"{method} ".encode()
+                tail = (f" HTTP/1.1\r\nHost: {host}\r\n"
+                        f"Content-Length: {len(body or b'')}\r\n\r\n"
+                        ).encode() + (body or b"")
                 ok = 0
+                readline = rfile.readline
+                read = rfile.read
                 for i in span:
-                    conn.request(method, path_of(i), body=body)
-                    resp = conn.getresponse()
-                    resp.read()
-                    if resp.status in (200, 201, 204):
+                    sock.sendall(head + path_of(i).encode() + tail)
+                    line = readline()
+                    if not line:
+                        break  # server dropped the connection
+                    clen = 0
+                    while True:
+                        h = readline()
+                        if h in (b"\r\n", b"\n", b""):
+                            break
+                        if h[:15].lower() == b"content-length:":
+                            clen = int(h[15:])
+                    if clen:
+                        read(clen)
+                    if line[9:12] in (b"200", b"201", b"204"):
                         ok += 1
-                conn.close()
+                rfile.close()
+                sock.close()
                 return ok
 
             spans = [range(w, nreq, workers) for w in range(workers)]
@@ -659,6 +704,37 @@ def bench_s3_gateway(num_objects: int = 5000) -> dict:
         out["filer_get_rps"] = phase(
             filer.address, "GET", lambda i: f"/bench2/o{i}", num_objects,
             None)
+
+        # span-derived stage breakdown (assign / upload / meta-save for
+        # puts; lookup / fetch / read for gets): a short fully-sampled
+        # probe with 8 KB bodies — past the inline limit, so the chunk
+        # path and the fid lease are exercised — run AFTER the timed
+        # phases, which therefore pay zero recorder cost
+        from seaweedfs_tpu import tracing
+        probe_payload = b"p" * 8192
+        prev_sample = os.environ.get("WEED_TRACE_SAMPLE")
+        os.environ["WEED_TRACE_SAMPLE"] = "1"
+        try:
+            tracing.RECORDER.reset()
+            phase(filer.address, "PUT", lambda i: f"/probe/o{i}", 400,
+                  probe_payload)
+            put_spans = tracing.RECORDER.aggregate("filer.")
+            tracing.RECORDER.reset()
+            phase(filer.address, "GET", lambda i: f"/probe/o{i}", 400,
+                  None)
+            get_spans = tracing.RECORDER.aggregate("filer.")
+            tracing.RECORDER.reset()
+        finally:
+            if prev_sample is None:
+                os.environ.pop("WEED_TRACE_SAMPLE", None)
+            else:
+                os.environ["WEED_TRACE_SAMPLE"] = prev_sample
+        out["gateway_stages"] = {
+            "put": _stage_fractions(put_spans, ("filer.save",)),
+            "get": _stage_fractions(
+                get_spans,
+                ("filer.lookup", "filer.read", "filer.stream")),
+        }
         return out
     finally:
         s3.stop()
@@ -666,6 +742,7 @@ def bench_s3_gateway(num_objects: int = 5000) -> dict:
         vs.stop()
         master.stop()
         shutil.rmtree(workdir, ignore_errors=True)
+        gc.unfreeze()
 
 
 def bench_small_file_secured(num_files: int) -> tuple[float, float]:
@@ -944,9 +1021,15 @@ def main():
     except Exception as e:
         print(f"note: small-file bench failed: {e}", file=sys.stderr)
 
+    # policy state (breakers / retry budget / hedge rings) is process-
+    # global and keyed by ephemeral addresses; a breaker opened by one
+    # phase's teardown must not shed load in the next phase
+    from seaweedfs_tpu.rpc import policy as _policy
+
     # -- small files under production config: JWT + replication 001 ----------
     sec_write_rps = sec_read_rps = 0.0
     try:
+        _policy.reset_state()
         sec_write_rps, sec_read_rps = bench_small_file_secured(50_000)
     except Exception as e:
         print(f"note: secured small-file bench failed: {e}",
@@ -955,15 +1038,21 @@ def main():
     # -- degraded EC reads (4 shards dead, reconstruct per read) -------------
     deg_rps = deg_p99 = deg_native_rps = 0.0
     deg_stages: dict = {}
+    deg_err = ""
     try:
+        _policy.reset_state()
         deg_rps, deg_p99, deg_native_rps, deg_stages = \
             bench_ec_degraded_read()
+        if deg_rps <= 0.0:
+            deg_err = "bench returned 0 rps without raising"
     except Exception as e:
+        deg_err = f"{type(e).__name__}: {e}"
         print(f"note: degraded-read bench failed: {e}", file=sys.stderr)
 
     # -- S3 gateway vs filer data plane --------------------------------------
     s3_stats: dict = {}
     try:
+        _policy.reset_state()
         s3_stats = bench_s3_gateway()
     except Exception as e:
         print(f"note: s3 bench failed: {e}", file=sys.stderr)
@@ -1020,6 +1109,7 @@ def main():
         "ec_degraded_read_p99_ms": round(deg_p99, 2),
         "ec_degraded_read_native_rps": round(deg_native_rps, 1),
         "ec_degraded_read_stages": deg_stages,
+        "ec_degraded_read_error": deg_err,
         "s3_put_rps": round(s3_stats.get("s3_put_rps", 0.0), 1),
         "s3_get_rps": round(s3_stats.get("s3_get_rps", 0.0), 1),
         "filer_put_rps": round(s3_stats.get("filer_put_rps", 0.0), 1),
@@ -1027,6 +1117,7 @@ def main():
         "s3_vs_filer_get": (
             round(s3_stats["s3_get_rps"] / s3_stats["filer_get_rps"], 2)
             if s3_stats.get("filer_get_rps") else 0.0),
+        "gateway_stages": s3_stats.get("gateway_stages", {}),
         "smallfile_secured_vs_plain_write": (
             round(sec_write_rps / sf_write_rps, 2) if sf_write_rps
             else 0.0),

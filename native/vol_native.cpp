@@ -41,6 +41,7 @@
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <deque>
 #include <map>
 #include <memory>
 #include <mutex>
@@ -465,6 +466,26 @@ std::mutex g_jwt_mu;
 std::string g_jwt_write_key, g_jwt_read_key;
 int g_jwt_expire_s = 10;
 
+// Signature-verification memo: a count>N assign shares ONE token across
+// all N chunk writes (plus every replica forward re-verifies it), so
+// the same (key, token) pair is HMAC'd over and over on the hottest
+// write path.  Only successful signature checks are cached and `exp` is
+// re-evaluated on every lookup, so a hit can never outlive the token.
+// Cleared whenever a signing key changes.
+struct JwtVerified {
+    std::string fid;
+    int64_t exp = 0;
+    bool has_exp = false;
+};
+std::mutex g_jwt_cache_mu;
+std::unordered_map<std::string, JwtVerified> g_jwt_cache;
+constexpr size_t kJwtCacheMax = 4096;
+
+void jwt_cache_clear() {
+    std::lock_guard<std::mutex> lk(g_jwt_cache_mu);
+    g_jwt_cache.clear();
+}
+
 // Replica fan-out registry: vid -> peer fast-path addresses.
 std::shared_mutex g_replica_mu;
 std::unordered_map<uint32_t, std::vector<std::string>> g_replicas;
@@ -689,10 +710,15 @@ int svn_set_replicas(uint32_t vid, const char* csv) {
 // shutting down must not also clear the volume server's read key.
 int svn_server_set_jwt(const char* write_key, const char* read_key,
                        int expire_s) {
-    std::lock_guard<std::mutex> lk(g_jwt_mu);
-    if (write_key) g_jwt_write_key = write_key;
-    if (read_key) g_jwt_read_key = read_key;
-    if (expire_s > 0) g_jwt_expire_s = expire_s;
+    {
+        std::lock_guard<std::mutex> lk(g_jwt_mu);
+        if (write_key) g_jwt_write_key = write_key;
+        if (read_key) g_jwt_read_key = read_key;
+        if (expire_s > 0) g_jwt_expire_s = expire_s;
+    }
+    // verified signatures are key-dependent: a rotated/cleared key must
+    // not keep honoring tokens minted under the old one
+    if (write_key || read_key) jwt_cache_clear();
     return 0;
 }
 
@@ -1268,29 +1294,53 @@ bool json_num_claim(const std::string& json, const char* name,
 // read tokens compare exactly (verify_read:151).
 bool jwt_verify(const std::string& key, const std::string& token,
                 const std::string& fid, bool write_semantics) {
-    size_t d1 = token.find('.');
-    if (d1 == std::string::npos) return false;
-    size_t d2 = token.find('.', d1 + 1);
-    if (d2 == std::string::npos) return false;
-    uint8_t mac[32];
-    hmac_sha256(key, token.substr(0, d2), mac);
-    std::string sig;
-    if (!b64url_decode(token.substr(d2 + 1), &sig) || sig.size() != 32)
-        return false;
-    // constant-time compare
-    uint8_t diff = 0;
-    for (int i = 0; i < 32; i++) diff |= mac[i] ^ (uint8_t)sig[i];
-    if (diff) return false;
-    std::string payload;
-    if (!b64url_decode(token.substr(d1 + 1, d2 - d1 - 1), &payload))
-        return false;
-    int64_t exp;
-    if (json_num_claim(payload, "exp", &exp)) {
-        int64_t now = (int64_t)(now_unix_ns() / 1000000000ull);
-        if (now > exp) return false;
+    // cache the expensive part (HMAC + base64 + claim parse) keyed by
+    // (key, token); the per-fid claim check and exp re-check below stay
+    // per call
+    std::string cache_key;
+    cache_key.reserve(key.size() + 1 + token.size());
+    cache_key.append(key).push_back('\0');
+    cache_key.append(token);
+    JwtVerified entry;
+    bool cached = false;
+    {
+        std::lock_guard<std::mutex> lk(g_jwt_cache_mu);
+        auto it = g_jwt_cache.find(cache_key);
+        if (it != g_jwt_cache.end()) {
+            entry = it->second;
+            cached = true;
+        }
     }
-    std::string claim_fid;
-    if (!json_str_claim(payload, "fid", &claim_fid)) return false;
+    if (!cached) {
+        size_t d1 = token.find('.');
+        if (d1 == std::string::npos) return false;
+        size_t d2 = token.find('.', d1 + 1);
+        if (d2 == std::string::npos) return false;
+        uint8_t mac[32];
+        hmac_sha256(key, token.substr(0, d2), mac);
+        std::string sig;
+        if (!b64url_decode(token.substr(d2 + 1), &sig) || sig.size() != 32)
+            return false;
+        // constant-time compare
+        uint8_t diff = 0;
+        for (int i = 0; i < 32; i++) diff |= mac[i] ^ (uint8_t)sig[i];
+        if (diff) return false;
+        std::string payload;
+        if (!b64url_decode(token.substr(d1 + 1, d2 - d1 - 1), &payload))
+            return false;
+        int64_t exp;
+        entry.has_exp = json_num_claim(payload, "exp", &exp);
+        if (entry.has_exp) entry.exp = exp;
+        if (!json_str_claim(payload, "fid", &entry.fid)) return false;
+        std::lock_guard<std::mutex> lk(g_jwt_cache_mu);
+        if (g_jwt_cache.size() >= kJwtCacheMax) g_jwt_cache.clear();
+        g_jwt_cache.emplace(std::move(cache_key), entry);
+    }
+    if (entry.has_exp) {
+        int64_t now = (int64_t)(now_unix_ns() / 1000000000ull);
+        if (now > entry.exp) return false;
+    }
+    const std::string& claim_fid = entry.fid;
     if (!write_semantics) return claim_fid == fid;
     if (claim_fid == fid.substr(0, fid.find('_'))) return true;
     return !claim_fid.empty() && claim_fid.back() == ',' &&
@@ -1495,10 +1545,6 @@ Reply handle_read(uint32_t vid, uint64_t nid, uint32_t cookie,
 // replicate ('R') never fans out again.
 // ---------------------------------------------------------------------------
 
-// tiny pooled TCP client for peer fast-path ports
-std::mutex g_fwd_mu;
-std::unordered_map<std::string, std::vector<int>> g_fwd_idle;
-
 int fwd_connect(const std::string& addr) {
     size_t colon = addr.rfind(':');
     if (colon == std::string::npos) return -1;
@@ -1530,29 +1576,6 @@ int fwd_connect(const std::string& addr) {
     return fd;
 }
 
-int fwd_take(const std::string& addr) {
-    {
-        std::lock_guard<std::mutex> lk(g_fwd_mu);
-        auto it = g_fwd_idle.find(addr);
-        if (it != g_fwd_idle.end() && !it->second.empty()) {
-            int fd = it->second.back();
-            it->second.pop_back();
-            return fd;
-        }
-    }
-    return fwd_connect(addr);
-}
-
-void fwd_put(const std::string& addr, int fd) {
-    std::lock_guard<std::mutex> lk(g_fwd_mu);
-    auto& pool = g_fwd_idle[addr];
-    if (pool.size() >= 8) {
-        close(fd);
-        return;
-    }
-    pool.push_back(fd);
-}
-
 bool fwd_send_all(int fd, const char* data, size_t n) {
     size_t sent = 0;
     while (sent < n) {
@@ -1563,38 +1586,118 @@ bool fwd_send_all(int fd, const char* data, size_t n) {
     return true;
 }
 
-bool fwd_recv_all(int fd, uint8_t* out, size_t n) {
-    size_t got = 0;
-    while (got < n) {
-        ssize_t r = recv(fd, out + got, n - got, 0);
-        if (r <= 0) return false;
-        got += (size_t)r;
-    }
-    return true;
+// Group-commit forward mux: concurrent forwards to one peer coalesce
+// into a single pipelined batch on a shared connection (one send +
+// in-order reply reads per batch, like the fsync ticket batching),
+// instead of 2 syscalls each way per write on per-thread pooled
+// sockets.  The peer's serve_conn drains pipelined frames from its
+// buffered recv, so a batch of N costs O(1) wakeups on both sides.
+struct FwdItem {
+    const std::string* frame;
+    uint32_t status = 0;
+    bool reached = false;
+    bool done = false;
+};
+
+struct FwdMux {
+    std::mutex mu;
+    std::condition_variable cv;
+    std::deque<FwdItem*> queue;
+    bool leader = false;  // a thread is running a batch on fd
+    int fd = -1;          // only the leader touches the socket
+};
+
+std::mutex g_fwd_mu;
+std::unordered_map<std::string, std::unique_ptr<FwdMux>> g_fwd_muxes;
+
+FwdMux* fwd_mux(const std::string& addr) {
+    std::lock_guard<std::mutex> lk(g_fwd_mu);
+    auto& m = g_fwd_muxes[addr];
+    if (!m) m.reset(new FwdMux());
+    return m.get();
 }
 
-// One framed request/reply on a pooled peer connection; retries once on
-// a stale pooled socket.  Returns false only when the peer is
-// unreachable; otherwise *status carries the peer's reply code.
+// Send every queued frame in one write, then read the replies back in
+// order (replies on a fast-path connection are strictly sequential).
+// Retries the whole batch once on a stale socket — safe for the same
+// reason the old per-frame retry was: replicate writes/deletes are
+// idempotent, and the Python fallback dedups identical rewrites.
+void fwd_run_batch(FwdMux* mux, const std::string& addr,
+                   std::vector<FwdItem*>& batch) {
+    std::string out;
+    size_t total = 0;
+    for (FwdItem* it : batch) total += it->frame->size();
+    out.reserve(total);
+    for (FwdItem* it : batch) out += *it->frame;
+    for (int attempt = 0; attempt < 2; attempt++) {
+        if (mux->fd < 0) mux->fd = fwd_connect(addr);
+        if (mux->fd < 0) return;  // peer unreachable: all stay !reached
+        if (!fwd_send_all(mux->fd, out.data(), out.size())) {
+            close(mux->fd);
+            mux->fd = -1;
+            continue;  // stale pooled socket: reconnect, resend batch
+        }
+        // buffered in-order reply parse: one recv drains many replies,
+        // instead of two exact-size recvs per reply
+        std::string rbuf;
+        size_t off = 0;
+        auto ensure = [&](size_t n) -> bool {
+            while (rbuf.size() - off < n) {
+                char tmp[16384];
+                ssize_t r = recv(mux->fd, tmp, sizeof(tmp), 0);
+                if (r <= 0) return false;
+                rbuf.append(tmp, (size_t)r);
+            }
+            return true;
+        };
+        size_t i = 0;
+        for (; i < batch.size(); i++) {
+            if (!ensure(8)) break;
+            const uint8_t* hdr = (const uint8_t*)rbuf.data() + off;
+            uint32_t plen = get_be32(hdr + 4);
+            batch[i]->status = get_be32(hdr);
+            off += 8;
+            if (plen && !ensure(plen)) break;
+            off += plen;
+            batch[i]->reached = true;
+        }
+        if (i == batch.size()) return;
+        close(mux->fd);  // mid-batch drop: reconnect and retry once
+        mux->fd = -1;
+        for (FwdItem* it : batch) it->reached = false;
+    }
+}
+
+// One framed request/reply against a peer fast-path port; returns false
+// only when the peer is unreachable, otherwise *status carries the
+// peer's reply code.  Requests riding in concurrently batch together.
 bool fwd_request(const std::string& addr, const std::string& frame,
                  uint32_t* status) {
-    for (int attempt = 0; attempt < 2; attempt++) {
-        int fd = fwd_take(addr);
-        if (fd < 0) return false;
-        uint8_t hdr[8];
-        if (fwd_send_all(fd, frame.data(), frame.size()) &&
-            fwd_recv_all(fd, hdr, 8)) {
-            *status = get_be32(hdr);
-            uint32_t plen = get_be32(hdr + 4);
-            std::vector<uint8_t> payload(plen);
-            if (plen == 0 || fwd_recv_all(fd, payload.data(), plen)) {
-                fwd_put(addr, fd);
-                return true;
-            }
+    FwdMux* mux = fwd_mux(addr);
+    FwdItem item;
+    item.frame = &frame;
+    std::unique_lock<std::mutex> lk(mux->mu);
+    mux->queue.push_back(&item);
+    while (!item.done) {
+        if (!mux->leader) {
+            mux->leader = true;
+            std::vector<FwdItem*> batch(mux->queue.begin(),
+                                        mux->queue.end());
+            mux->queue.clear();
+            lk.unlock();
+            fwd_run_batch(mux, addr, batch);
+            lk.lock();
+            for (FwdItem* it : batch) it->done = true;
+            mux->leader = false;
+            mux->cv.notify_all();
+        } else {
+            mux->cv.wait(lk, [&] {
+                return item.done || !mux->leader;
+            });
         }
-        close(fd);  // stale/broken: retry with a fresh connection
     }
-    return false;
+    *status = item.status;
+    return item.reached;
 }
 
 // Fan a verified local write/delete out to the vid's other locations.
@@ -2116,35 +2219,6 @@ bool serve_http_request(Server* srv, int fd, const std::string& method,
                            head, "");
 }
 
-bool send_reply(int fd, uint32_t status, const std::string& payload) {
-    uint8_t hdr[8];
-    put_be32(hdr, status);
-    put_be32(hdr + 4, (uint32_t)payload.size());
-    struct iovec iov[2] = {{hdr, 8},
-                           {(void*)payload.data(), payload.size()}};
-    size_t total = 8 + payload.size();
-    size_t sent = 0;
-    int iovcnt = payload.empty() ? 1 : 2;
-    while (sent < total) {
-        ssize_t r = writev(fd, iov, iovcnt);
-        if (r <= 0) return false;
-        sent += (size_t)r;
-        // advance iov
-        size_t skip = (size_t)r;
-        for (int i = 0; i < iovcnt; i++) {
-            if (skip >= iov[i].iov_len) {
-                skip -= iov[i].iov_len;
-                iov[i].iov_len = 0;
-            } else {
-                iov[i].iov_base = (uint8_t*)iov[i].iov_base + skip;
-                iov[i].iov_len -= skip;
-                break;
-            }
-        }
-    }
-    return true;
-}
-
 bool recv_some(int fd, std::string& buf) {
     char tmp[16384];
     ssize_t r = recv(fd, tmp, sizeof(tmp), 0);
@@ -2153,14 +2227,42 @@ bool recv_some(int fd, std::string& buf) {
     return true;
 }
 
+// Reply outbox: framed replies accumulate and go out in one send just
+// before the connection would block on recv.  A pipelined batch (the
+// replica side of the forward mux) then costs one reply syscall and
+// one peer wakeup instead of one per frame; unpipelined clients see a
+// flush per request, exactly like the old per-reply writev.
+struct Outbox {
+    int fd;
+    std::string pending;
+
+    bool queue(uint32_t status, const std::string& payload) {
+        size_t n = pending.size();
+        pending.resize(n + 8);
+        put_be32((uint8_t*)&pending[n], status);
+        put_be32((uint8_t*)&pending[n] + 4, (uint32_t)payload.size());
+        pending += payload;
+        if (pending.size() >= 131072) return flush();
+        return true;
+    }
+
+    bool flush() {
+        if (pending.empty()) return true;
+        bool ok = fwd_send_all(fd, pending.data(), pending.size());
+        pending.clear();
+        return ok;
+    }
+};
+
 void serve_conn(Server* srv, int fd) {
     int one = 1;
     setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
     std::string buf;
+    Outbox ob{fd};
     while (!srv->stop.load()) {
         size_t nl;
         while ((nl = buf.find('\n')) == std::string::npos) {
-            if (!recv_some(fd, buf)) goto done;
+            if (!ob.flush() || !recv_some(fd, buf)) goto done;
             if (srv->stop.load()) goto done;
         }
         {
@@ -2178,7 +2280,7 @@ void serve_conn(Server* srv, int fd) {
                 i = j;
             }
             if (parts.empty()) {
-                if (!send_reply(fd, 400, "bad request")) goto done;
+                if (!ob.queue(400, "bad request")) goto done;
                 continue;
             }
             const std::string& op = parts[0];
@@ -2188,14 +2290,15 @@ void serve_conn(Server* srv, int fd) {
             if ((op == "GET" || op == "HEAD") && parts.size() == 3) {
                 // plain HTTP clients may hit the fast-path port too
                 g_stat_http_reads.fetch_add(1);
-                if (!serve_http_request(srv, fd, op, parts[1], buf))
+                if (!ob.flush() ||
+                    !serve_http_request(srv, fd, op, parts[1], buf))
                     goto done;
             } else if (op == "G"
                        && (parts.size() == 2 || parts.size() == 3)) {
                 if (!parse_fid(parts[1], &vid, &nid, &cookie)) {
                     g_stat_reads.fetch_add(1);
                     g_stat_errors.fetch_add(1);
-                    if (!send_reply(fd, 400, "bad fid")) goto done;
+                    if (!ob.queue(400, "bad fid")) goto done;
                     continue;
                 }
                 std::string rkey = jwt_key(false);
@@ -2205,7 +2308,7 @@ void serve_conn(Server* srv, int fd) {
                                 parts[1], false)) {
                     g_stat_reads.fetch_add(1);
                     count_reply(401);
-                    if (!send_reply(fd, 401, "unauthorized")) goto done;
+                    if (!ob.queue(401, "unauthorized")) goto done;
                     continue;
                 }
                 bool was_ec = false;
@@ -2214,7 +2317,7 @@ void serve_conn(Server* srv, int fd) {
                 // read/ec_read by the path that served them
                 (was_ec ? g_stat_ec_reads : g_stat_reads).fetch_add(1);
                 count_reply(r.status);
-                if (!send_reply(fd, r.status, r.payload)) goto done;
+                if (!ob.queue(r.status, r.payload)) goto done;
             } else if (op == "W" && parts.size() >= 3
                        && parts.size() <= 5) {
                 errno = 0;
@@ -2222,17 +2325,17 @@ void serve_conn(Server* srv, int fd) {
                 if (errno || blen < 0 || blen > INT32_MAX) {
                     // body length unknowable: the stream cannot be
                     // resynchronized, so reply and drop the connection
-                    send_reply(fd, 400, "bad length");
+                    ob.queue(400, "bad length");
                     goto done;
                 }
                 while (buf.size() < (size_t)blen) {
-                    if (!recv_some(fd, buf)) goto done;
+                    if (!ob.flush() || !recv_some(fd, buf)) goto done;
                 }
                 std::string body = buf.substr(0, (size_t)blen);
                 buf.erase(0, (size_t)blen);
                 if (!parse_fid(parts[1], &vid, &nid, &cookie)) {
                     // body already drained: framing stays intact
-                    if (!send_reply(fd, 400, "bad fid")) goto done;
+                    if (!ob.queue(400, "bad fid")) goto done;
                     continue;
                 }
                 // optional trailing tokens: a write JWT and/or the
@@ -2247,30 +2350,30 @@ void serve_conn(Server* srv, int fd) {
                 Reply r = handle_write(vid, nid, cookie, body, parts[1],
                                        is_replicate, jwt);
                 count_reply(r.status);
-                if (!send_reply(fd, r.status, r.payload)) goto done;
+                if (!ob.queue(r.status, r.payload)) goto done;
             } else if (op == "A" && parts.size() <= 2) {
                 long long count = 1;
                 if (parts.size() == 2) {
                     errno = 0;
                     count = strtoll(parts[1].c_str(), nullptr, 10);
                     if (errno || count <= 0 || count > 1000000) {
-                        if (!send_reply(fd, 400, "bad count")) goto done;
+                        if (!ob.queue(400, "bad count")) goto done;
                         continue;
                     }
                 }
                 std::string out = assign_take(count);
                 if (out.empty()) {
                     // no live lease: the client retries /dir/assign
-                    if (!send_reply(fd, 503, "no assign lease"))
+                    if (!ob.queue(503, "no assign lease"))
                         goto done;
                     continue;
                 }
-                if (!send_reply(fd, 0, out)) goto done;
+                if (!ob.queue(0, out)) goto done;
             } else if (op == "D" && parts.size() >= 2
                        && parts.size() <= 4) {
                 g_stat_deletes.fetch_add(1);
                 if (!parse_fid(parts[1], &vid, &nid, &cookie)) {
-                    if (!send_reply(fd, 400, "bad fid")) goto done;
+                    if (!ob.queue(400, "bad fid")) goto done;
                     continue;
                 }
                 std::string jwt;
@@ -2282,13 +2385,14 @@ void serve_conn(Server* srv, int fd) {
                 Reply r = handle_delete(vid, nid, cookie, parts[1],
                                         is_replicate, jwt);
                 count_reply(r.status);
-                if (!send_reply(fd, r.status, r.payload)) goto done;
+                if (!ob.queue(r.status, r.payload)) goto done;
             } else {
-                if (!send_reply(fd, 400, "bad request")) goto done;
+                if (!ob.queue(400, "bad request")) goto done;
             }
         }
     }
 done:
+    ob.flush();  // best effort: drop queued replies with the conn
     close(fd);
     {
         std::lock_guard<std::mutex> lk(srv->conns_mu);

@@ -17,9 +17,11 @@ from __future__ import annotations
 
 import hashlib
 import json
+import os
 import threading
 import time
-from typing import Optional
+from concurrent.futures import ThreadPoolExecutor
+from typing import Iterator, Optional
 
 from .. import tracing
 from ..rpc import policy
@@ -40,6 +42,18 @@ from .reader_cache import ChunkCache
 
 DEFAULT_CHUNK_SIZE = 4 * 1024 * 1024  # filer -maxMB default (4MB)
 INLINE_LIMIT = 2048  # small-content inlining threshold
+_DEFAULT_PREFETCH = 4
+
+
+def prefetch_chunks() -> int:
+    """Streaming-GET look-ahead window K; 0 disables streaming."""
+    raw = os.environ.get("WEED_FILER_PREFETCH_CHUNKS", "")
+    if not raw:
+        return _DEFAULT_PREFETCH
+    try:
+        return max(0, int(raw))
+    except ValueError:
+        return _DEFAULT_PREFETCH
 
 
 class FilerServer:
@@ -100,6 +114,19 @@ class FilerServer:
 
         self._tcp_client = VolumeTcpClient()
         self._tcp_bad: dict[str, float] = {}
+        # amortized fid leasing: one /dir/assign?count=N master call
+        # hands out N fids locally (WEED_FILER_ASSIGN_LEASE)
+        from ..wdclient import fid_lease
+
+        self._fid_lease = fid_lease.FidLeaseCache(
+            lambda n, repl, coll, t: self._assign(
+                count=n, replication=repl, collection=coll, ttl=t),
+            name=f"filer:{port}")
+        # shared chunk I/O pool: upload fan-out, buffered-read fan-in and
+        # the streaming-GET prefetch window all ride these threads instead
+        # of paying a ThreadPoolExecutor spin-up per request
+        self._io_pool = ThreadPoolExecutor(
+            max_workers=16, thread_name_prefix="filer-io")
         self.server = RpcServer(host, port, service_name="filer")
         # observability mounts shadow the matching user paths, like the
         # /metadata/, /remote/ and /kv/ prefixes below
@@ -144,6 +171,7 @@ class FilerServer:
         self.filer.store.close()
         self.chunk_cache.close()  # tiered cache drops its disk segments
         self._tcp_client.close()
+        self._io_pool.shutdown(wait=False)
 
     # -- per-path configuration (filer_conf.go, 1s refresh) ------------------
     def filer_conf(self) -> FilerConf:
@@ -386,21 +414,23 @@ class FilerServer:
                 {"Content-Range": f"bytes {start}-{stop - 1}/{size}"})
         return Response(data, 200, "application/octet-stream")
 
-    def _upload_blob(self, piece: bytes, replication: str = "",
-                     collection: str = "", ttl: str = "") -> FileChunk:
-        """Assign a fid and upload one blob to the volume cluster; with
-        -encryptVolumeData the volume only ever sees AES-GCM ciphertext
-        and the per-chunk key rides the chunk record (fs.encrypt,
-        filer_server_handlers_write_cipher.go)."""
-        key = b""
-        payload = piece
-        if self.cipher:
-            from ..util.cipher import encrypt, gen_cipher_key
+    def _assign_leased(self, replication: str = "", collection: str = "",
+                       ttl: str = "") -> dict:
+        """Assign one fid, preferring the lease cache (batched master
+        calls); the cache keys on the EFFECTIVE placement parameters so
+        per-path rules and server defaults cannot alias."""
+        from ..wdclient import fid_lease
 
-            key = gen_cipher_key()
-            payload = encrypt(piece, key)
-        assign = self._assign(replication=replication,
-                              collection=collection, ttl=ttl)
+        repl = replication or self.replication
+        coll = collection or self.collection
+        if fid_lease.lease_count() <= 1:
+            return self._assign(replication=repl, collection=coll, ttl=ttl)
+        return self._fid_lease.get(replication=repl, collection=coll,
+                                   ttl=ttl)
+
+    def _upload_assigned(self, assign: dict, payload: bytes) -> dict:
+        """Push one blob at its assigned fid; TCP fast path when the
+        cluster is unauthenticated, HTTP otherwise/on fallback."""
         fid, url = assign["fid"], assign["url"]
         up = None
         if not assign.get("auth"):
@@ -421,9 +451,42 @@ class FilerServer:
             up = policy.call_policy(
                 url, f"/{fid}", raw=payload, method="POST",
                 headers=headers, timeout=60, idempotent=True)
+        return up
+
+    def _upload_blob(self, piece: bytes, replication: str = "",
+                     collection: str = "", ttl: str = "") -> FileChunk:
+        """Assign a fid and upload one blob to the volume cluster; with
+        -encryptVolumeData the volume only ever sees AES-GCM ciphertext
+        and the per-chunk key rides the chunk record (fs.encrypt,
+        filer_server_handlers_write_cipher.go)."""
+        key = b""
+        payload = piece
+        if self.cipher:
+            from ..util.cipher import encrypt, gen_cipher_key
+
+            key = gen_cipher_key()
+            payload = encrypt(piece, key)
+        with tracing.span("filer.assign"):
+            assign = self._assign_leased(replication=replication,
+                                         collection=collection, ttl=ttl)
+        try:
+            up = self._upload_assigned(assign, payload)
+        except RpcError as e:
+            # a leased fid can go stale between master calls (volume
+            # recycled/full, expired write JWT): drop the batch and
+            # retry exactly once with a fresh direct assign
+            if not assign.get("leased") or \
+                    e.status not in (401, 403, 404, 500, 503):
+                raise
+            stats.FilerFidLeaseCounter.labels("stale_retry").inc()
+            self._fid_lease.invalidate(reason=f"upload {e.status}")
+            with tracing.span("filer.assign"):
+                assign = self._assign(replication=replication,
+                                      collection=collection, ttl=ttl)
+            up = self._upload_assigned(assign, payload)
         # size is the PLAINTEXT length: interval math over the logical
         # file must not see the nonce/tag overhead
-        return FileChunk(fid=fid, offset=0, size=len(piece),
+        return FileChunk(fid=assign["fid"], offset=0, size=len(piece),
                          etag=up.get("eTag", ""),
                          modified_ts_ns=time.time_ns(),
                          cipher_key=key)
@@ -505,23 +568,22 @@ class FilerServer:
                 # upload chunks concurrently (the reference fans chunk
                 # uploads out per goroutine, _write_upload.go): a large
                 # body otherwise pays one serial assign+POST round trip
-                # per chunk.  On failure the fan-out aborts and the
+                # per chunk.  The shared I/O pool overlaps the
+                # slice/encrypt work of later chunks with the uploads of
+                # earlier ones.  On failure the fan-out aborts and the
                 # already-uploaded siblings are best-effort DELETEd:
                 # vacuum only compacts deleted needles, so a
                 # never-referenced upload would otherwise leak until its
                 # volume is removed
-                from concurrent.futures import ThreadPoolExecutor
-
-                workers = min(8, len(offsets))
-                with ThreadPoolExecutor(max_workers=workers) as pool:
-                    futures = [pool.submit(upload, off) for off in offsets]
-                    uploaded, first_err = [], None
-                    for f in futures:
-                        try:
-                            uploaded.append(f.result())
-                        except Exception as e:  # noqa: BLE001 — re-raised
-                            if first_err is None:
-                                first_err = e
+                futures = [self._io_pool.submit(upload, off)
+                           for off in offsets]
+                uploaded, first_err = [], None
+                for f in futures:
+                    try:
+                        uploaded.append(f.result())
+                    except Exception as e:  # noqa: BLE001 — re-raised
+                        if first_err is None:
+                            first_err = e
                 if first_err is not None:
                     try:
                         self._delete_chunks(uploaded)
@@ -533,7 +595,8 @@ class FilerServer:
                 lambda blob: self._upload_blob(blob, rule.replication,
                                                rule.collection, rule_ttl),
                 entry.chunks, self.manifest_batch)
-        self.filer.create_entry(entry)
+        with tracing.span("filer.meta_save"):
+            self.filer.create_entry(entry)
         return entry
 
     def _fetch_chunk(self, fid: str) -> bytes:
@@ -578,12 +641,23 @@ class FilerServer:
         to HTTP (no native port, replicated/TTL volume, error)."""
         import json as _json
 
+        from ..wdclient.volume_tcp_client import VolumeTcpError
+
         now = time.time()
         if now < self._tcp_bad.get(url, 0.0):
             return None
         try:
             raw = self._tcp_client.write_needle(url, fid, payload)
             return _json.loads(raw)
+        except VolumeTcpError as e:
+            if e.status == 404:
+                # the fid itself is bad (stale lease / recycled volume):
+                # the port works fine — raise so the lease retry path
+                # can re-assign instead of blacklisting the fast path
+                raise RpcError(f"chunk {fid} upload: volume gone",
+                               404) from None
+            self._tcp_bad[url] = now + 60.0
+            return None
         except Exception:
             # 307 already fell back to HTTP inside the client; anything
             # surfacing here means the port itself is unusable
@@ -669,11 +743,7 @@ class FilerServer:
         if len(fids) <= 1:
             blobs = {fid: fetch(fid) for fid in fids}
         else:
-            from concurrent.futures import ThreadPoolExecutor
-
-            with ThreadPoolExecutor(
-                    max_workers=min(8, len(fids))) as pool:
-                blobs = dict(zip(fids, pool.map(fetch, fids)))
+            blobs = dict(zip(fids, self._io_pool.map(fetch, fids)))
         parts = [blobs[v.fid][v.offset_in_chunk:
                               v.offset_in_chunk + v.size]
                  for v in views]
@@ -706,6 +776,105 @@ class FilerServer:
         threading.Thread(target=fetch, daemon=True,
                          name=f"prefetch-{nxt.fid}").start()
 
+    # -- streamed read -------------------------------------------------------
+    def read_stream(self, entry: Entry, start: int = 0,
+                    length: Optional[int] = None
+                    ) -> Optional[tuple[Iterator[bytes], int]]:
+        """Bounded-window streaming read: a (chunk iterator, byte count)
+        pair for [start, start+length), or None when the buffered path
+        is the right answer (inline content, remote mounts, single-chunk
+        bodies, or streaming disabled via WEED_FILER_PREFETCH_CHUNKS=0).
+
+        Up to K chunk fetches run ahead of the reply cursor on the
+        shared I/O pool — chunks complete out of order, bytes are
+        yielded in order — so first-byte latency is one chunk fetch
+        regardless of object size.  The first chunk is fetched before
+        this returns: common failures (missing chunk, no locations)
+        still surface as a proper error status instead of a truncated
+        200."""
+        if prefetch_chunks() <= 0:
+            return None
+        size = entry.size()
+        if length is None:
+            length = size - start
+        if entry.content or not entry.chunks or \
+                (entry.remote_entry and not entry.chunks):
+            return None
+        chunks = entry.chunks
+        if has_chunk_manifest(chunks):
+            chunks = resolve_chunk_manifest(self._fetch_chunk, chunks)
+        views = read_chunk_views(chunks, start, length)
+        if len({v.fid for v in views}) <= 1:
+            return None  # nothing to pipeline; buffered path is simpler
+        span = tracing.start("filer.stream", tags={"bytes": length})
+        gen = self._stream_views(views, span)
+        try:
+            first = next(gen)
+        except StopIteration:
+            span.finish()
+            return iter(()), 0
+        except BaseException:
+            span.finish(status="error")
+            raise
+
+        def run():
+            try:
+                yield first
+                yield from gen
+            finally:
+                span.finish()
+
+        return run(), length
+
+    def _stream_views(self, views, parent_span) -> Iterator[bytes]:
+        keys = {v.fid: v.cipher_key for v in views}
+        order = list(keys)  # unique fids in first-use order
+        pos = {fid: i for i, fid in enumerate(order)}
+        last_use: dict[str, int] = {}
+        for i, v in enumerate(views):
+            last_use[v.fid] = i
+        window = max(1, prefetch_chunks())
+
+        def fetch(fid: str) -> bytes:
+            with tracing.span("filer.chunk_fetch", parent=parent_span,
+                              tags={"fid": fid}):
+                data = self._fetch_chunk(fid)
+            if keys[fid]:
+                from ..util.cipher import decrypt
+
+                data = decrypt(data, keys[fid])
+            return data
+
+        futures: dict[str, object] = {}
+        submitted = 0
+
+        def pump(cursor: int):
+            # keep fetches in flight for the window ahead of the cursor
+            nonlocal submitted
+            while submitted < len(order) and submitted <= cursor + window:
+                fid = order[submitted]
+                futures[fid] = self._io_pool.submit(fetch, fid)
+                submitted += 1
+
+        blobs: dict[str, bytes] = {}
+        try:
+            for i, v in enumerate(views):
+                cursor = pos[v.fid]
+                pump(cursor)
+                stats.FilerPrefetchWindowGauge.set(
+                    submitted - cursor - 1)
+                blob = blobs.get(v.fid)
+                if blob is None:
+                    blob = futures.pop(v.fid).result()
+                    blobs[v.fid] = blob
+                yield blob[v.offset_in_chunk:v.offset_in_chunk + v.size]
+                if last_use[v.fid] == i:
+                    blobs.pop(v.fid, None)  # free as the cursor passes
+        finally:
+            stats.FilerPrefetchWindowGauge.set(0)
+            for f in futures.values():
+                f.cancel()
+
     # -- read ----------------------------------------------------------------
     def _h_read(self, path: str, req: Request, method: str):
         proxy_chunk = req.param("proxyChunkId")
@@ -714,7 +883,8 @@ class FilerServer:
             # reach volume servers (filer_server_handlers_proxy.go)
             return self._proxy_chunk(proxy_chunk, req)
         try:
-            entry = self.filer.find_entry(path)
+            with tracing.span("filer.lookup"):
+                entry = self.filer.find_entry(path)
         except NotFoundError:
             raise RpcError(f"{path} not found", 404)
         if "tagging" in req.query:
@@ -765,6 +935,15 @@ class FilerServer:
             headers["Content-Length"] = str(length)
             return Response(b"", status, content_type, headers)
 
+        streamed = self.read_stream(entry, start, length)
+        if streamed is not None:
+            body_iter, n = streamed
+            # a known length keeps the reply on raw writes (no chunked
+            # framing) while _reply_stream flushes chunk by chunk
+            headers["Content-Length"] = str(n)
+            stats.FilerStreamedReadCounter.labels("streamed").inc()
+            return Response(body_iter, status, content_type, headers)
+        stats.FilerStreamedReadCounter.labels("buffered").inc()
         return Response(self.read_bytes(entry, start, length), status,
                         content_type, headers)
 

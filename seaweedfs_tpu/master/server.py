@@ -385,6 +385,11 @@ class MasterServer:
         if self.guard.signing:
             # JWT scoped to the assigned fid (master_server_handlers.go:150)
             result["auth"] = gen_write_jwt(self.guard.signing, fid)
+            # let fid-lease caches cap their lease lifetime to the
+            # token's, so a leased fid never outlives its write JWT
+            if self.guard.signing.expires_after_seconds > 0:
+                result["authExpiresSeconds"] = \
+                    self.guard.signing.expires_after_seconds
         return result
 
     def _grow(self, collection: str, rp: ReplicaPlacement, ttl: TTL,

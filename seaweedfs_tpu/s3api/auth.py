@@ -14,8 +14,10 @@ import base64
 import hashlib
 import hmac
 import json
+import threading
 import time
 import urllib.parse
+from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -582,15 +584,40 @@ class IdentityAccessManagement:
             "\n".join(header_lines) + "\n",
             ";".join(signed_headers), payload_hash])
 
-    @staticmethod
-    def _signing_key(secret, datestamp, region, service) -> bytes:
+    # derived-key memo: the 4-chained-HMAC key derivation depends only
+    # on (secret, datestamp, region, service) — constant for a client
+    # all day — so every request after the first skips it.  Keyed by the
+    # full tuple, an evicted/rotated secret simply misses.
+    _key_cache_lock = threading.Lock()
+    _key_cache: "OrderedDict[tuple, bytes]" = OrderedDict()
+    _KEY_CACHE_MAX = 512
+
+    @classmethod
+    def _signing_key(cls, secret, datestamp, region, service) -> bytes:
+        from ..stats.metrics import S3SigV4KeyCacheCounter
+
+        ck = (secret, datestamp, region, service)
+        with cls._key_cache_lock:
+            cached = cls._key_cache.get(ck)
+            if cached is not None:
+                cls._key_cache.move_to_end(ck)
+        S3SigV4KeyCacheCounter.labels(
+            "hit" if cached is not None else "miss").inc()
+        if cached is not None:
+            return cached
+
         def h(key, msg):
             return hmac.new(key, msg.encode(), hashlib.sha256).digest()
 
         k_date = h(("AWS4" + secret).encode(), datestamp)
         k_region = h(k_date, region)
         k_service = h(k_region, service)
-        return h(k_service, "aws4_request")
+        k_signing = h(k_service, "aws4_request")
+        with cls._key_cache_lock:
+            cls._key_cache[ck] = k_signing
+            while len(cls._key_cache) > cls._KEY_CACHE_MAX:
+                cls._key_cache.popitem(last=False)
+        return k_signing
 
     @classmethod
     def _signature(cls, secret, datestamp, region, service,

@@ -685,6 +685,14 @@ class S3ApiServer:
         if method == "HEAD":
             headers["Content-Length"] = str(length)
             return Response(b"", status, content_type, headers)
+        # multi-chunk objects stream through the filer's bounded-window
+        # prefetch pipeline: first byte goes out after one chunk fetch
+        # regardless of object size
+        streamed = self.filer_server.read_stream(entry, start, length)
+        if streamed is not None:
+            body_iter, n = streamed
+            headers["Content-Length"] = str(n)
+            return Response(body_iter, status, content_type, headers)
         body = self.filer_server.read_bytes(entry, start, length)
         return Response(body, status, content_type, headers)
 

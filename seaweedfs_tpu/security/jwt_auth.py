@@ -15,8 +15,24 @@ import hashlib
 import hmac
 import ipaddress
 import json
+import os
+import threading
 import time
+from collections import OrderedDict
 from typing import Optional
+
+_DEFAULT_JWT_CACHE = 4096
+
+
+def jwt_cache_size() -> int:
+    """Entries in the signature-verification LRU; 0 disables caching."""
+    raw = os.environ.get("WEED_JWT_CACHE_SIZE", "")
+    if not raw:
+        return _DEFAULT_JWT_CACHE
+    try:
+        return max(0, int(raw))
+    except ValueError:
+        return _DEFAULT_JWT_CACHE
 
 
 def _b64url(data: bytes) -> str:
@@ -27,29 +43,80 @@ def _unb64url(text: str) -> bytes:
     return base64.urlsafe_b64decode(text + "=" * (-len(text) % 4))
 
 
+# HMAC-SHA256 key schedules, precomputed once per key and copied per
+# call: hashing the padded key blocks dominates HMAC cost for the short
+# signing inputs JWTs use, and the key set is tiny (one or two per
+# daemon)
+_mac_lock = threading.Lock()
+_mac_templates: dict[bytes, "hmac.HMAC"] = {}
+
+
+def _sign(key: bytes, msg: bytes) -> bytes:
+    with _mac_lock:
+        tmpl = _mac_templates.get(key)
+        if tmpl is None:
+            if len(_mac_templates) >= 64:
+                _mac_templates.clear()
+            tmpl = _mac_templates[key] = hmac.new(
+                key, digestmod=hashlib.sha256)
+        mac = tmpl.copy()
+    mac.update(msg)
+    return mac.digest()
+
+
 def encode_jwt(key: bytes, claims: dict) -> str:
     header = _b64url(json.dumps(
         {"alg": "HS256", "typ": "JWT"}, separators=(",", ":")).encode())
     payload = _b64url(json.dumps(claims, separators=(",", ":")).encode())
     signing_input = ("%s.%s" % (header, payload)).encode()
-    sig = hmac.new(key, signing_input, hashlib.sha256).digest()
-    return "%s.%s.%s" % (header, payload, _b64url(sig))
+    return "%s.%s.%s" % (header, payload, _b64url(_sign(key, signing_input)))
+
+
+# signature-keyed verification LRU: a count>N assign shares one token
+# across N chunk writes, so the volume/filer side re-verifies the same
+# (key, token) pair over and over.  Only SUCCESSFUL signature checks are
+# cached, and `exp` is re-evaluated on every call, so a cache hit can
+# never outlive the token itself.
+_verify_lock = threading.Lock()
+_verified: "OrderedDict[tuple[bytes, str], dict]" = OrderedDict()
+
+
+def _jwt_cache_clear():
+    with _verify_lock:
+        _verified.clear()
 
 
 def decode_jwt(key: bytes, token: str) -> dict:
     """Verify signature + exp; returns claims. Raises ValueError on failure."""
-    try:
-        header_b64, payload_b64, sig_b64 = token.split(".")
-    except ValueError:
-        raise ValueError("malformed token")
-    header = json.loads(_unb64url(header_b64))
-    if header.get("alg") != "HS256":
-        raise ValueError("unexpected algorithm %r" % header.get("alg"))
-    signing_input = ("%s.%s" % (header_b64, payload_b64)).encode()
-    expect = hmac.new(key, signing_input, hashlib.sha256).digest()
-    if not hmac.compare_digest(expect, _unb64url(sig_b64)):
-        raise ValueError("bad signature")
-    claims = json.loads(_unb64url(payload_b64))
+    size = jwt_cache_size()
+    claims = None
+    ck = (key, token)
+    if size > 0:
+        with _verify_lock:
+            claims = _verified.get(ck)
+            if claims is not None:
+                _verified.move_to_end(ck)
+        from ..stats.metrics import JwtCacheCounter
+
+        JwtCacheCounter.labels("hit" if claims is not None else "miss").inc()
+    if claims is None:
+        try:
+            header_b64, payload_b64, sig_b64 = token.split(".")
+        except ValueError:
+            raise ValueError("malformed token")
+        header = json.loads(_unb64url(header_b64))
+        if header.get("alg") != "HS256":
+            raise ValueError("unexpected algorithm %r" % header.get("alg"))
+        signing_input = ("%s.%s" % (header_b64, payload_b64)).encode()
+        if not hmac.compare_digest(_sign(key, signing_input),
+                                   _unb64url(sig_b64)):
+            raise ValueError("bad signature")
+        claims = json.loads(_unb64url(payload_b64))
+        if size > 0:
+            with _verify_lock:
+                _verified[ck] = claims
+                while len(_verified) > size:
+                    _verified.popitem(last=False)
     exp = claims.get("exp")
     if exp is not None and time.time() > float(exp):
         raise ValueError("token expired")

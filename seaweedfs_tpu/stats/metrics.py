@@ -324,6 +324,29 @@ EcRecoverBytesCounter = REGISTRY.counter(
 FilerChunkCacheCounter = REGISTRY.counter(
     "SeaweedFS_filer_chunk_cache_total",
     "filer chunk cache lookups", ("result",))
+# gateway fast-path vectors: fid leasing on the write path, streamed
+# chunk prefetch on the read path, and the signature caches that keep
+# per-request crypto off the hot path
+FilerFidLeaseCounter = REGISTRY.counter(
+    "SeaweedFS_filer_fid_lease_total",
+    "fid lease cache outcomes on the filer assign path "
+    "(hit / miss / refill / expired / invalidated / stale_retry)",
+    ("event",))
+FilerPrefetchWindowGauge = REGISTRY.gauge(
+    "SeaweedFS_filer_read_prefetch_window",
+    "chunk fetches in flight ahead of the streaming GET cursor")
+FilerStreamedReadCounter = REGISTRY.counter(
+    "SeaweedFS_filer_read_reply_total",
+    "filer GET replies by delivery mode (streamed / buffered)",
+    ("mode",))
+JwtCacheCounter = REGISTRY.counter(
+    "SeaweedFS_security_jwt_cache_total",
+    "JWT signature-verification cache lookups (hit / miss)",
+    ("result",))
+S3SigV4KeyCacheCounter = REGISTRY.counter(
+    "SeaweedFS_s3_sigv4_key_cache_total",
+    "SigV4 derived signing-key cache lookups (hit / miss)",
+    ("result",))
 FilerRequestCounter = REGISTRY.counter(
     "SeaweedFS_filer_request_total", "filer requests", ("type",))
 FilerRequestHistogram = REGISTRY.histogram(

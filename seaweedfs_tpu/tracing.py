@@ -15,9 +15,9 @@ slow reply to the hop or kernel stage that caused it.  Here:
   * a process-wide bounded recorder keeps whole traces: every sampled
     trace (probability ``WEED_TRACE_SAMPLE``), plus — always on — any
     trace containing a span slower than ``WEED_TRACE_SLOW_MS``.  Fast
-    unsampled traces buffer only until their root span finishes, then
-    vanish, so the steady-state cost with sampling off is one short-lived
-    dict entry per request;
+    unsampled spans bypass the recorder entirely, so the steady-state
+    cost with sampling off is just the duration measurement; a slow
+    span promotes its trace from that span onward;
   * ``GET /debug/traces`` (recent index) and ``GET /debug/traces/<id>``
     (full span tree) are mounted on every daemon.
 
@@ -34,12 +34,12 @@ Knobs (env, read live so daemons/tests flip them without restarts):
 
 from __future__ import annotations
 
+import itertools
 import os
 import random
 import threading
 import time
 from collections import OrderedDict
-from contextlib import contextmanager
 from typing import Optional
 
 from .stats import metrics as _stats
@@ -50,31 +50,55 @@ SAMPLED_HEADER = "X-Trace-Sampled"
 SRC_HEADER = "X-Trace-Src"
 
 
-def sample_rate() -> float:
-    raw = os.environ.get("WEED_TRACE_SAMPLE", "")
+# The knobs below are read on every span, which on the gateway hot path
+# means several os.environ round-trips (str encode + wrapper dict) per
+# request.  They must stay *live* (tests flip them mid-process), so the
+# parse is memoized against the raw env value: same raw -> cached parse,
+# changed raw -> reparse.  CPython keeps the authoritative bytes mapping
+# in os.environ._data and os.environ.__setitem__ writes through to it,
+# so a direct .get() there is live and one C dict lookup.
+_ENV_DATA = getattr(os.environ, "_data", None)
+_env_memo: dict = {}
+
+
+def _env_live(key: str, key_b: bytes, parse, default):
+    if _ENV_DATA is not None:
+        raw = _ENV_DATA.get(key_b)
+    else:  # non-CPython fallback
+        raw = os.environ.get(key)
+    memo = _env_memo.get(key)
+    if memo is not None and memo[0] == raw:
+        return memo[1]
     try:
-        return min(1.0, max(0.0, float(raw))) if raw else 0.01
+        val = parse(raw) if raw else default
     except ValueError:
-        return 0.01
+        val = default
+    _env_memo[key] = (raw, val)
+    return val
+
+
+def sample_rate() -> float:
+    return _env_live("WEED_TRACE_SAMPLE", b"WEED_TRACE_SAMPLE",
+                     lambda raw: min(1.0, max(0.0, float(raw))), 0.01)
 
 
 def slow_ms() -> float:
-    raw = os.environ.get("WEED_TRACE_SLOW_MS", "")
-    try:
-        return float(raw) if raw else 250.0
-    except ValueError:
-        return 250.0
+    return _env_live("WEED_TRACE_SLOW_MS", b"WEED_TRACE_SLOW_MS",
+                     float, 250.0)
 
 
 def _env_int(name: str, default: int) -> int:
-    try:
-        return int(os.environ.get(name, "") or default)
-    except ValueError:
-        return default
+    return _env_live(name, name.encode(), int, default)
+
+
+# Sequential ids from a random 63-bit start: unique within the process
+# (cross-process traces already share ids via the propagation headers)
+# and much cheaper than 64 fresh random bits per span.
+_ids = itertools.count(random.getrandbits(62))
 
 
 def _new_id() -> str:
-    return f"{random.getrandbits(64):016x}"
+    return f"{next(_ids):016x}"
 
 
 class Span:
@@ -190,23 +214,36 @@ def inject(headers: dict, span: Optional[Span] = None) -> dict:
     return headers
 
 
-@contextmanager
+class _SpanCtx:
+    """Class-based context manager: @contextmanager allocates a
+    generator + _GeneratorContextManager per use, which shows up on the
+    request hot path (two spans per gateway request)."""
+
+    __slots__ = ("sp", "prev")
+
+    def __init__(self, sp: Span):
+        self.sp = sp
+
+    def __enter__(self) -> Span:
+        self.prev = swap(self.sp)
+        return self.sp
+
+    def __exit__(self, exc_type, exc, tb):
+        sp = self.sp
+        if exc_type is not None:
+            sp.status = f"error: {exc_type.__name__}"
+        restore(self.prev)
+        sp.finish()
+        return False
+
+
 def span(name: str, service: str = "", parent: Optional[Span] = None,
-         tags: Optional[dict] = None):
+         tags: Optional[dict] = None) -> _SpanCtx:
     """Open a child span of the thread's current (or explicit `parent`)
     span for the duration of the block.  Pass `parent` explicitly when
     the work runs on a pool thread that did not inherit the request
     thread's context (chunk fan-outs)."""
-    sp = start(name, service, parent, tags)
-    prev = swap(sp)
-    try:
-        yield sp
-    except BaseException as e:
-        sp.status = f"error: {type(e).__name__}"
-        raise
-    finally:
-        restore(prev)
-        sp.finish()
+    return _SpanCtx(start(name, service, parent, tags))
 
 
 def record_span(name: str, duration: float, service: str = "",
@@ -240,8 +277,18 @@ class Recorder:
                 self.max_spans or _env_int("WEED_TRACE_MAX_SPANS", 512))
 
     def record(self, span: Span):
-        max_traces, max_spans = self._caps()
         slow = (span.duration or 0.0) * 1000.0 >= slow_ms()
+        if not span.sampled and not slow and \
+                span.trace_id not in self._traces:
+            # Fast path for the steady state with sampling off: the
+            # span can neither start nor join a kept trace, so skip
+            # the lock + buffer entirely.  A later slow span still
+            # promotes its trace from that point on; the pre-slow fast
+            # spans of such a trace are the (deliberate) fidelity cost.
+            if span.is_root:
+                _stats.TraceRetentionCounter.labels("dropped").inc()
+            return
+        max_traces, max_spans = self._caps()
         kept = dropped = False
         with self._lock:
             rec = self._traces.get(span.trace_id)

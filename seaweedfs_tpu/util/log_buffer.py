@@ -10,6 +10,7 @@ newer than what has been flushed.
 from __future__ import annotations
 
 import threading
+from collections import deque
 from typing import Callable, Optional
 
 FlushFn = Callable[[int, int, list], None]
@@ -22,7 +23,10 @@ class LogBuffer:
         self.flush_fn = flush_fn
         self.flush_interval = flush_interval
         self.max_entries = max_entries  # ring-buffer cap when not flushing
-        self._entries: list = []  # (ts_ns, payload), ts_ns ascending
+        # (ts_ns, payload), ts_ns ascending.  A deque, NOT a list: the
+        # ring-buffer trim on a full list costs a full copy per append
+        # (O(cap) on every filer mutation once the buffer fills)
+        self._entries: deque = deque()
         self._flushing: list = []  # batch being persisted, still readable
         self._lock = threading.Lock()
         self._flush_gate = threading.Lock()  # serializes flushers
@@ -32,17 +36,18 @@ class LogBuffer:
 
     def add(self, ts_ns: int, payload) -> None:
         with self._lock:
-            self._entries.append((ts_ns, payload))
+            entries = self._entries
+            entries.append((ts_ns, payload))
             if self.max_entries is not None \
-                    and len(self._entries) > self.max_entries:
-                self._entries = self._entries[-self.max_entries:]
+                    and len(entries) > self.max_entries:
+                entries.popleft()
 
     def read_since(self, since_ns: int = 0) -> list:
         """In-RAM entries strictly newer than since_ns.  Entries mid-flush
         stay visible until the flush function has persisted them, so a
         cursoring subscriber never observes a gap."""
         with self._lock:
-            return [p for ts, p in self._flushing + self._entries
+            return [p for ts, p in self._flushing + list(self._entries)
                     if ts > since_ns]
 
     @property
@@ -55,7 +60,7 @@ class LogBuffer:
             with self._lock:
                 if not self._entries:
                     return 0
-                batch, self._entries = self._entries, []
+                batch, self._entries = list(self._entries), deque()
                 self._flushing = batch
             try:
                 if self.flush_fn is not None:
@@ -64,7 +69,7 @@ class LogBuffer:
                 self._last_flushed_ns = batch[-1][0]
             except Exception:
                 with self._lock:  # persist failed: keep entries buffered
-                    self._entries = batch + self._entries
+                    self._entries = deque(batch + list(self._entries))
                     self._flushing = []
                 raise
             with self._lock:

@@ -1,3 +1,4 @@
+from .fid_lease import FidLeaseCache
 from .masterclient import MasterClient, VidMap
 
-__all__ = ["MasterClient", "VidMap"]
+__all__ = ["FidLeaseCache", "MasterClient", "VidMap"]

@@ -12,6 +12,7 @@ import random
 import threading
 from typing import Optional
 
+from . import fid_lease
 from ..rpc import policy
 from ..rpc.http_rpc import RpcError
 from ..util import glog
@@ -135,27 +136,34 @@ class MasterClient:
                 self.current_master = random.choice(healthy)
                 self._stop.wait(1.0)
                 continue
-            feed_id = r.get("feed_id", "")
-            if feed_id != self._feed_id:
-                # different master (failover) = different sequence space:
-                # restart the cursor and drop everything cached
-                if self._feed_id:
-                    self.vid_map.clear()
-                    self._seq = 0
-                    self._feed_id = feed_id
-                    continue  # re-poll from 0 on the new feed
-                self._feed_id = feed_id
-            if r.get("resync"):
-                # fell off the retained delta window: drop the cache and
-                # let lookups repopulate it
+            self._apply_watch_reply(r)
+
+    def _apply_watch_reply(self, r: dict):
+        """Fold one /dir/watch reply into the cache (factored out of the
+        loop so failover handling is testable without a live master)."""
+        feed_id = r.get("feed_id", "")
+        if feed_id != self._feed_id:
+            # different master (failover) = different sequence space:
+            # restart the cursor and drop everything cached — including
+            # any batched fid leases minted against the old leader
+            if self._feed_id:
                 self.vid_map.clear()
-            for d in r.get("deltas", []):
-                if d["op"] == "add":
-                    self.vid_map.add(d["volume"], d["url"],
-                                     d.get("publicUrl", d["url"]))
-                else:
-                    self.vid_map.remove(d["volume"], d["url"])
-            self._seq = max(self._seq, r.get("seq", self._seq))
-            leader = r.get("leader")
-            if leader and leader not in self.masters:
-                glog.v(1).infof("watch leader %s outside master list", leader)
+                self._seq = 0
+                self._feed_id = feed_id
+                fid_lease.invalidate_all(reason="leader_change")
+                return  # re-poll from 0 on the new feed
+            self._feed_id = feed_id
+        if r.get("resync"):
+            # fell off the retained delta window: drop the cache and
+            # let lookups repopulate it
+            self.vid_map.clear()
+        for d in r.get("deltas", []):
+            if d["op"] == "add":
+                self.vid_map.add(d["volume"], d["url"],
+                                 d.get("publicUrl", d["url"]))
+            else:
+                self.vid_map.remove(d["volume"], d["url"])
+        self._seq = max(self._seq, r.get("seq", self._seq))
+        leader = r.get("leader")
+        if leader and leader not in self.masters:
+            glog.v(1).infof("watch leader %s outside master list", leader)

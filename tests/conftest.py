@@ -49,3 +49,7 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "chaos: fault-injection soak tests over a live "
         "mini-cluster")
+    config.addinivalue_line(
+        "markers", "perf_smoke: fast structural checks of the gateway "
+        "fast path (assign amortization, streamed reads) — asserts "
+        "request shape, not wall-clock throughput")

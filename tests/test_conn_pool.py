@@ -1,0 +1,103 @@
+"""Shared keep-alive connection pool: idle caps, TTL reaping of quiet
+addresses, and put/get races (rpc/http_rpc._ConnPool)."""
+
+import threading
+import time
+
+from seaweedfs_tpu.rpc.http_rpc import _ConnPool
+
+
+class FakeConn:
+    """Close-tracking stand-in; sock=None reads as a dropped socket."""
+
+    sock = None
+
+    def __init__(self):
+        self.closed = False
+
+    def close(self):
+        self.closed = True
+
+
+class TestConnPool:
+    def test_idle_cap_evicts_oldest(self):
+        pool = _ConnPool(max_idle_per_addr=16, idle_ttl=30.0)
+        conns = [FakeConn() for _ in range(25)]
+        for c in conns:
+            pool.put("10.0.0.1:80", c)
+        with pool._lock:
+            idle = list(pool._idle["10.0.0.1:80"])
+        assert len(idle) == 16
+        # the 9 evicted are the OLDEST stored; the survivors are the
+        # most recently returned (least likely to be server-reaped)
+        assert [c.closed for c in conns[:9]] == [True] * 9
+        assert [c for c, _ in idle] == conns[9:]
+
+    def test_ttl_reap_covers_quiet_addresses(self):
+        """100 idle sockets across 4 addresses: traffic on ONE address
+        must still reap expired idles on the quiet other three."""
+        pool = _ConnPool(max_idle_per_addr=100, idle_ttl=0.2)
+        addrs = [f"10.0.0.{i}:80" for i in range(4)]
+        conns = {a: [FakeConn() for _ in range(25)] for a in addrs}
+        for a in addrs:
+            for c in conns[a]:
+                pool.put(a, c)
+        time.sleep(0.35)  # everything expires
+        # one put on a single busy address piggybacks the global sweep
+        pool.put(addrs[0], FakeConn())
+        for a in addrs[1:]:
+            assert all(c.closed for c in conns[a]), a
+            with pool._lock:
+                assert a not in pool._idle
+        # fds are actually released, not just forgotten
+        assert all(c.closed for c in conns[addrs[0]])
+
+    def test_get_discards_expired_and_dropped(self):
+        pool = _ConnPool(max_idle_per_addr=16, idle_ttl=0.1)
+        c = FakeConn()
+        pool.put("127.0.0.1:1", c)
+        time.sleep(0.15)
+        fresh = pool.get("127.0.0.1:1", timeout=1.0)
+        assert c.closed  # expired idle was closed, not handed out
+        assert fresh is not c
+
+    def test_put_get_race_keeps_invariants(self):
+        """Hammer one pool from 8 threads across 4 addresses; the cap
+        must hold and every conn must end up either idle or closed."""
+        pool = _ConnPool(max_idle_per_addr=4, idle_ttl=30.0)
+        addrs = [f"10.1.0.{i}:80" for i in range(4)]
+        made = []
+        made_lock = threading.Lock()
+        errors = []
+
+        def worker(seed):
+            try:
+                for i in range(200):
+                    a = addrs[(seed + i) % len(addrs)]
+                    c = FakeConn()
+                    with made_lock:
+                        made.append(c)
+                    pool.put(a, c)
+                    if i % 3 == 0:
+                        got = pool.get(a, timeout=1.0)
+                        # FakeConn reads as dropped -> closed + fresh
+                        # conn object; just release the fresh one
+                        got.close()
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker, args=(s,))
+                   for s in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        with pool._lock:
+            for a, idle in pool._idle.items():
+                assert len(idle) <= 4, a
+            idle_conns = {c for lst in pool._idle.values()
+                          for c, _ in lst}
+        leaked = [c for c in made
+                  if not c.closed and c not in idle_conns]
+        assert not leaked

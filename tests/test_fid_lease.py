@@ -1,0 +1,181 @@
+"""Amortized fid leasing: batch assigns, single-flight refill, expiry
+and the stale-fid retry path (wdclient/fid_lease.py, filer/server.py)."""
+
+import threading
+import time
+
+import pytest
+
+from seaweedfs_tpu.stats import metrics as _stats
+from seaweedfs_tpu.wdclient import fid_lease
+from seaweedfs_tpu.wdclient.fid_lease import FidLeaseCache
+
+
+def counting_assign(record, reply=None, delay=0.0):
+    """assign_fn stub: records (count, replication, collection, ttl)."""
+    lock = threading.Lock()
+
+    def assign(n, replication="", collection="", ttl=""):
+        if delay:
+            time.sleep(delay)
+        with lock:
+            record.append((n, replication, collection, ttl))
+            seq = len(record)
+        out = {"fid": f"3,{seq:08x}ab", "url": "127.0.0.1:9999",
+               "publicUrl": "127.0.0.1:9999", "count": n}
+        if reply:
+            out.update(reply)
+        return out
+
+    return assign
+
+
+class TestLeaseCache:
+    def test_one_master_call_hands_out_n_fids(self, monkeypatch):
+        monkeypatch.setenv("WEED_FILER_ASSIGN_LEASE", "16")
+        calls = []
+        cache = FidLeaseCache(counting_assign(calls), name="t")
+        got = [cache.get() for _ in range(12)]
+        assert len(calls) == 1 and calls[0][0] == 16
+        base = got[0]["fid"]
+        # derived fids follow the <base>_<delta> convention, same volume
+        assert [g["fid"] for g in got] == \
+            [base] + [f"{base}_{i}" for i in range(1, 12)]
+        assert all(g["leased"] for g in got)
+
+    def test_single_flight_refill(self, monkeypatch):
+        monkeypatch.setenv("WEED_FILER_ASSIGN_LEASE", "64")
+        calls = []
+        cache = FidLeaseCache(counting_assign(calls, delay=0.1), name="t")
+        results = []
+        res_lock = threading.Lock()
+
+        def worker():
+            got = cache.get(wait_timeout=10.0)
+            with res_lock:
+                results.append(got["fid"])
+
+        threads = [threading.Thread(target=worker) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # one thread performed the slow master call; the other seven
+        # waited on the key's condition variable instead of piling on
+        assert len(calls) == 1
+        assert len(set(results)) == 8  # all distinct fids, one batch
+
+    def test_ttl_expiry_forces_new_batch(self, monkeypatch):
+        monkeypatch.setenv("WEED_FILER_ASSIGN_LEASE", "16")
+        monkeypatch.setenv("WEED_FILER_ASSIGN_LEASE_TTL", "0.05")
+        calls = []
+        cache = FidLeaseCache(counting_assign(calls), name="t")
+        first = cache.get()
+        time.sleep(0.12)
+        second = cache.get()
+        assert len(calls) == 2
+        assert first["fid"].split("_")[0] != second["fid"].split("_")[0]
+
+    def test_auth_expiry_caps_lease_lifetime(self, monkeypatch):
+        monkeypatch.setenv("WEED_FILER_ASSIGN_LEASE", "16")
+        monkeypatch.setenv("WEED_FILER_ASSIGN_LEASE_TTL", "8.0")
+        calls = []
+        # authExpiresSeconds - _AUTH_SLACK(2.0) = 0.1 s effective lease
+        cache = FidLeaseCache(
+            counting_assign(calls, reply={"auth": "tok",
+                                          "authExpiresSeconds": 2.1}),
+            name="t")
+        cache.get()
+        time.sleep(0.2)
+        cache.get()
+        assert len(calls) == 2
+
+    def test_low_water_triggers_async_refill(self, monkeypatch):
+        monkeypatch.setenv("WEED_FILER_ASSIGN_LEASE", "4")
+        calls = []
+        cache = FidLeaseCache(counting_assign(calls), name="t")
+        for _ in range(4):
+            cache.get()
+        deadline = time.time() + 5
+        while len(calls) < 2 and time.time() < deadline:
+            time.sleep(0.01)
+        assert len(calls) == 2  # refilled in the background, no taker
+
+    def test_leader_change_invalidates_all_caches(self, monkeypatch):
+        from seaweedfs_tpu.wdclient.masterclient import MasterClient
+
+        monkeypatch.setenv("WEED_FILER_ASSIGN_LEASE", "16")
+        calls = []
+        cache = FidLeaseCache(counting_assign(calls), name="t")
+        first = cache.get()
+        assert len(calls) == 1
+        mc = MasterClient("127.0.0.1:0", name="t")
+        mc._apply_watch_reply({"feed_id": "master-a"})
+        mc._apply_watch_reply({"feed_id": "master-b"})  # failover
+        second = cache.get()  # old batch dropped: fresh master call
+        assert len(calls) == 2
+        assert first["fid"].split("_")[0] != second["fid"].split("_")[0]
+
+    def test_lease_disabled_passes_through(self, monkeypatch):
+        monkeypatch.setenv("WEED_FILER_ASSIGN_LEASE", "1")
+        calls = []
+        cache = FidLeaseCache(counting_assign(calls), name="t")
+        cache.get()
+        cache.get()
+        assert [c[0] for c in calls] == [1, 1]
+
+
+class TestStaleFidRetry:
+    @pytest.fixture
+    def stack(self, tmp_path):
+        from seaweedfs_tpu.filer.server import FilerServer
+        from seaweedfs_tpu.master.server import MasterServer
+        from seaweedfs_tpu.volume_server.server import VolumeServer
+
+        master = MasterServer(port=0, pulse_seconds=0.2)
+        master.start()
+        d = tmp_path / "vs0"
+        d.mkdir()
+        vs = VolumeServer([str(d)], master.address, port=0,
+                          pulse_seconds=0.2)
+        vs.start()
+        vs.heartbeat_once()
+        filer = FilerServer(master.address, port=0, chunk_size=1024)
+        filer.start()
+        yield master, vs, filer
+        filer.stop()
+        vs.stop()
+        master.stop()
+
+    def test_stale_leased_fid_reassigns_once(self, stack, monkeypatch):
+        from seaweedfs_tpu.rpc.http_rpc import call
+        from seaweedfs_tpu.wdclient.fid_lease import _Lease
+
+        monkeypatch.setenv("WEED_FILER_ASSIGN_LEASE", "8")
+        master, vs, filer = stack
+        # poison the lease cache: a batch whose volume does not exist,
+        # pointing at the live server (upload gets a real 404 back)
+        good = call(master.address, "/dir/assign")
+        stale = _Lease({"fid": "999,deadbeef01", "url": good["url"],
+                        "publicUrl": good["url"], "count": 8}, 8,
+                       time.monotonic() + 100)
+        key = (filer.replication, filer.collection, "")
+        st = filer._fid_lease._state(key)
+        with st.cond:
+            st.leases.append(stale)
+
+        def retries():
+            return _stats.FilerFidLeaseCounter._values.get(
+                ("stale_retry",), 0.0)
+
+        before = retries()
+        payload = bytes(range(256)) * 20  # 5120 bytes -> 5 chunks
+        resp = call(filer.address, "/stale/data.bin", raw=payload,
+                    method="POST")
+        assert resp["size"] == len(payload)
+        assert call(filer.address, "/stale/data.bin") == payload
+        # the 404 on the poisoned fid was retried with a direct assign
+        # and the whole poisoned batch was dropped
+        assert retries() > before
+        with st.cond:
+            assert all(l is not stale for l in st.leases)

@@ -1,0 +1,119 @@
+"""Gateway fast-path structural checks (perf_smoke).
+
+These assert the SHAPE of the fast path rather than wall-clock numbers,
+so they stay meaningful on loaded CI boxes: amortized fid leasing must
+collapse per-chunk master assigns, and the streamed GET pipeline must
+deliver the first byte without waiting for the tail chunks."""
+
+import socket
+import threading
+import time
+
+import pytest
+
+pytestmark = pytest.mark.perf_smoke
+
+
+@pytest.fixture
+def stack(tmp_path):
+    from seaweedfs_tpu.filer.server import FilerServer
+    from seaweedfs_tpu.master.server import MasterServer
+    from seaweedfs_tpu.volume_server.server import VolumeServer
+
+    master = MasterServer(port=0, pulse_seconds=0.2)
+    master.start()
+    d = tmp_path / "vs0"
+    d.mkdir()
+    vs = VolumeServer([str(d)], master.address, port=0,
+                      pulse_seconds=0.2)
+    vs.start()
+    vs.heartbeat_once()
+    filer = FilerServer(master.address, port=0, chunk_size=1024)
+    filer.start()
+    yield master, vs, filer
+    filer.stop()
+    vs.stop()
+    master.stop()
+
+
+def test_leased_assigns_amortize_across_chunks(stack, monkeypatch):
+    """An 8-chunk PUT with WEED_FILER_ASSIGN_LEASE=8 costs at most two
+    master assign calls (one count=8 batch + at most one low-water
+    background refill) instead of eight count=1 round trips."""
+    from seaweedfs_tpu.rpc.http_rpc import call
+
+    monkeypatch.setenv("WEED_FILER_ASSIGN_LEASE", "8")
+    master, vs, filer = stack
+    assigns = []
+    orig = filer._assign
+
+    def counting_assign(*args, **kwargs):
+        assigns.append(kwargs.get("count", 1))
+        return orig(*args, **kwargs)
+
+    monkeypatch.setattr(filer, "_assign", counting_assign)
+    payload = bytes(range(256)) * 32  # 8192 bytes -> 8 chunks of 1024
+    resp = call(filer.address, "/smoke/eight.bin", raw=payload,
+                method="POST")
+    assert resp["size"] == len(payload)
+    entry = filer.filer.find_entry("/smoke/eight.bin")
+    assert len(entry.chunks) == 8
+    sync_assigns = list(assigns)  # async refill may land after this
+    assert len(sync_assigns) <= 2, sync_assigns
+    assert sync_assigns[0] == 8  # batched, not per-chunk
+    assert call(filer.address, "/smoke/eight.bin") == payload
+
+
+def test_streamed_get_first_byte_before_last_chunk(stack, monkeypatch):
+    """With a prefetch window of 2, the reply's first body bytes arrive
+    while the object's LAST chunk has not even been requested from the
+    volume layer — first-byte latency is one chunk fetch, independent
+    of object size."""
+    from seaweedfs_tpu.rpc.http_rpc import call
+
+    monkeypatch.setenv("WEED_FILER_PREFETCH_CHUNKS", "2")
+    master, vs, filer = stack
+    payload = bytes(range(256)) * 32  # 8 chunks
+    call(filer.address, "/smoke/stream.bin", raw=payload, method="POST")
+    entry = filer.filer.find_entry("/smoke/stream.bin")
+    last_fid = max(entry.chunks, key=lambda c: c.offset).fid
+
+    fetched = []
+    release_last = threading.Event()
+    orig_fetch = filer._fetch_chunk
+
+    def gated_fetch(fid):
+        fetched.append(fid)
+        if fid == last_fid:
+            # hold the tail chunk back until the client has seen the
+            # first body bytes (bounded by a timeout, not forever)
+            release_last.wait(10.0)
+        return orig_fetch(fid)
+
+    monkeypatch.setattr(filer, "_fetch_chunk", gated_fetch)
+    host, port = filer.address.rsplit(":", 1)
+    sock = socket.create_connection((host, int(port)), timeout=15)
+    try:
+        sock.sendall(b"GET /smoke/stream.bin HTTP/1.1\r\n"
+                     b"Host: smoke\r\nConnection: close\r\n\r\n")
+        rfile = sock.makefile("rb")
+        status = rfile.readline()
+        assert b"200" in status, status
+        clen = 0
+        while True:
+            line = rfile.readline()
+            if line in (b"\r\n", b"\n", b""):
+                break
+            if line.lower().startswith(b"content-length:"):
+                clen = int(line.split(b":", 1)[1])
+        assert clen == len(payload)
+        first = rfile.read(1024)  # first chunk's worth of body
+        assert first == payload[:1024]
+        # the tail chunk is outside the prefetch window: untouched
+        assert last_fid not in fetched
+        release_last.set()
+        rest = rfile.read(clen - 1024)
+        assert first + rest == payload
+    finally:
+        release_last.set()
+        sock.close()
