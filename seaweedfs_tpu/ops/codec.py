@@ -97,6 +97,31 @@ _RECOVER_DEVICE_MIN_BYTES = int(
     os.environ.get("WEED_EC_RECOVER_DEVICE_MIN_KB", "512") or 0) << 10
 
 
+def recover_device_min_bytes() -> int:
+    """WEED_EC_RECOVER_DEVICE_MIN_KB re-read per call (daemons and tests
+    flip it without reimporting); import-time value is the fallback."""
+    kb = os.environ.get("WEED_EC_RECOVER_DEVICE_MIN_KB", "")
+    if not kb:
+        return _RECOVER_DEVICE_MIN_BYTES
+    try:
+        return int(kb) << 10
+    except ValueError:
+        return _RECOVER_DEVICE_MIN_BYTES
+
+
+def recover_device_enabled() -> bool:
+    """Whether reconstruct_span may dispatch to a device kernel.
+    WEED_EC_RECOVER_DEVICE: unset/"auto" -> only on a real TPU; "1"
+    forces it on (any jax backend — the CPU mesh harness and tests);
+    "0" disables."""
+    v = os.environ.get("WEED_EC_RECOVER_DEVICE", "auto").lower()
+    if v in ("1", "true", "yes", "force"):
+        return True
+    if v in ("0", "false", "no"):
+        return False
+    return on_tpu()
+
+
 def _apply_rows_host(rows: np.ndarray, inputs: np.ndarray) -> np.ndarray:
     """(t, d) decode rows x (d, L) survivor spans on the best host
     backend: the native kernel ladder when built, else NumPy tables."""
@@ -117,21 +142,52 @@ def _apply_rows_host(rows: np.ndarray, inputs: np.ndarray) -> np.ndarray:
 
 def reconstruct_span(survivors, inputs: np.ndarray, target: int,
                      data_shards: int = 10,
-                     total_shards: int = 14) -> np.ndarray:
+                     total_shards: int = 14,
+                     slab_key=None) -> np.ndarray:
     """Target-row reconstruction: rebuild ONE shard's span from the
     (d, L) survivor stack via the cached decode plan — one GF mat-vec,
     never a full Reconstruct.  `inputs[i]` must be the span read from
     `survivors[i]`.  L may be many coalesced spans laid end to end (the
     batched multi-span decode): the math is column-wise, so stacking is
     free.  Dispatch: fused JAX/Pallas kernel for large spans on a TPU,
-    native/NumPy host kernel for small ones."""
+    native/NumPy host kernel for small ones.
+
+    slab_key: opaque content identity of `inputs` (the caller hashes the
+    survivor stack).  When set, the device upload routes through the EC
+    device slab pool (ops/device_pool.py) keyed by (survivors, content):
+    consecutive decodes against the same survivor spans — a different
+    missing target, or a block re-recovered after LRU eviction — hit the
+    HBM-resident slab instead of re-uploading over the link."""
     rows = decode_rows(data_shards, total_shards, survivors, (target,))
-    if inputs.nbytes >= _RECOVER_DEVICE_MIN_BYTES and on_tpu():
+    if inputs.nbytes >= recover_device_min_bytes() \
+            and recover_device_enabled():
         try:
+            import jax.numpy as jnp
+
+            from .device_pool import get_pool
             from .rs_jax import apply_matrix
 
+            method = "pallas" if on_tpu() else "swar"
+            if slab_key is not None:
+                pool = get_pool()
+                key = ("recover", tuple(survivors), slab_key)
+
+                def _upload():
+                    dev = jnp.asarray(inputs)
+                    pool.note_h2d(inputs.nbytes)
+                    return dev
+
+                dev_in = pool.acquire_resident(key, _upload,
+                                               inputs.nbytes)
+                try:
+                    out = np.asarray(apply_matrix(
+                        np.asarray(rows), dev_in, method=method))[0]
+                finally:
+                    pool.release_resident(key)
+                pool.note_d2h(out.nbytes)
+                return out
             return np.asarray(apply_matrix(
-                np.asarray(rows), inputs, method="pallas"))[0]
+                np.asarray(rows), inputs, method=method))[0]
         except Exception:
             pass  # device hiccup mid-incident: the host path always works
     return _apply_rows_host(rows, inputs)[0]

@@ -8,20 +8,31 @@ ec_encoder.go:194-231).  Here the striped rows of MANY volumes are tiled
 into (B, 10, L) uint8 batches and pushed through one jit-compiled
 parity+CRC step (parallel/mesh.py) with a three-stage pipeline:
 
-  reader thread   — fills pinned host buffers from the .dat files and
-                    appends the data-shard bytes to .ec00-.ec09 (data
+  reader thread   — fills pooled host staging slots from the .dat files
+                    and appends the data-shard bytes to .ec00-.ec09 (data
                     shards are a pure re-interleaving of the .dat, no
-                    compute needed);
-  main thread     — device_put(batch N+1) and dispatches its encode while
-                    batch N's parity is still materializing (double
-                    buffering: transfers overlap compute via async
-                    dispatch); finalizes fused CRCs and chains them into
-                    per-shard-file rolling CRC32Cs;
+                    compute needed; all-zero padding rows are skipped —
+                    the shard files are ftruncate()d to final size, so
+                    their bytes are already zero);
+  main thread     — dispatches batch N+1 into the persistent jitted step
+                    while earlier batches are still in flight (depth
+                    WEED_EC_DEVICE_INFLIGHT), uploading through the
+                    device slab pool (ops/device_pool.py): staging slots
+                    and donated output slots are leased once and recycled,
+                    so the steady state performs zero per-batch device
+                    allocations;
+  completion thread — synchronizes finished batches, chains per-shard-file
+                    rolling CRC32Cs, recycles slots, and hands parity to
   writer thread   — appends parity bytes to .ec10-.ec13.
 
-Every shard chunk's CRC32C is computed on device, fused with the parity
-matmul (BASELINE config 5); whole-shard-file CRCs are returned and persisted
-in the .vif sidecar for scrub tooling.
+Units from ALL volumes in the call pack into ONE fixed compiled shape
+(tail batch padded, pad columns masked out of CRC and writes), so a
+100-volume encode is one pipeline with at most a handful of compiled
+shapes.  On TPU meshes the per-chunk CRC32C is computed on device, fused
+with the parity matmul (BASELINE config 5); on CPU meshes parity runs as
+a persistent batched SWAR step and CRCs use the ~30x-faster host crc32c
+kernel, overlapped with the next batch's compute.  Whole-shard-file CRCs
+are returned and persisted in the .vif sidecar for scrub tooling.
 """
 
 from __future__ import annotations
@@ -57,6 +68,8 @@ class _Unit:
     shard_off: int     # byte offset of this chunk in each shard file
     col: int           # column offset within the row's blocks
     block_size: int
+    real_rows: int = DATA_SHARDS  # rows with any .dat bytes; rows past
+    #                               this are the format's zero padding
 
 
 @dataclass
@@ -101,7 +114,15 @@ def _make_units(plans: list[_VolumePlan], chunk: int) -> list[_Unit]:
     for vi, plan in enumerate(plans):
         for row_start, shard_off, block in plan.rows:
             for col in range(0, block, chunk):
-                units.append(_Unit(vi, row_start, shard_off + col, col, block))
+                # rows i with row_start + i*block + col < dat_size carry
+                # real bytes; the rest are zero padding the device paths
+                # can compact away (their shard bytes are ftruncate
+                # zeros and their chunk CRC is crc32c_zeros(chunk))
+                avail = plan.dat_size - row_start - col
+                real = 0 if avail <= 0 else min(
+                    DATA_SHARDS, -(-avail // block))
+                units.append(_Unit(vi, row_start, shard_off + col, col,
+                                   block, real))
     return units
 
 
@@ -354,27 +375,56 @@ def encode_volumes(bases: list[str], large_block: Optional[int] = None,
                    pacer)
                for vi, p in enumerate(plans)}
     return _encode_units_device(plans, units, chunk, writers, mesh,
-                                batch_units)
+                                batch_units, stage_stats)
 
 
 class _PipelineIO:
     """Shared reader/writer scaffolding of the streaming pipeline:
-    staging slots, backpressure queues, the reader thread (fills slots
-    and appends data shards), the writer thread (appends parity shards),
-    and the torn-shutdown sequencing.  The device and host compute
-    stages differ only in what happens between `ready` and `parity_q`."""
+    pooled staging slots, backpressure queues, the reader thread (fills
+    slots and appends data shards), the writer thread (appends parity
+    shards), and the torn-shutdown sequencing.  The device compute
+    stages differ only in what happens between `ready` and `parity_q`.
 
-    def __init__(self, plans, units, chunk, writers, b):
+    Staging slots are leased from the device slab pool so repeated
+    encodes with the same geometry reuse the same buffers.  Two layouts:
+
+      "bk" — (B, 10, L): the TPU word/sharded steps' input layout; every
+             unit's 10 rows are zero-padded to the format (device CRC
+             covers all 14 shards).
+      "kb" — (10, B, L): the pooled CPU parity step's layout — slicing
+             [:k_max] off axis 0 compacts away trailing all-zero rows
+             as one contiguous view, and each shard row stays contiguous
+             for readinto/pwritev/host-CRC.
+
+    Either way the reader skips zero-padding rows' shard writes (the
+    files are ftruncate zeros already) and trims partial tail rows to
+    their real bytes; `ready` items carry the batch's compacted row
+    count k_max ("bk" readers always report the full 10)."""
+
+    def __init__(self, plans, units, chunk, writers, b, layout, pool,
+                 n_slots=_SLOTS):
         self.plans, self.units, self.chunk = plans, units, chunk
         self.writers, self.b = writers, b
+        self.layout = layout
+        self.pool = pool
         self.n_batches = (len(units) + b - 1) // b
         self.dats = [open(p.base + ".dat", "rb") for p in plans]
-        self.free_slots: "queue.Queue[np.ndarray]" = queue.Queue()
-        for _ in range(_SLOTS):
-            self.free_slots.put(
-                np.zeros((b, DATA_SHARDS, chunk), dtype=np.uint8))
-        self.ready: "queue.Queue" = queue.Queue(maxsize=_SLOTS)
-        self.parity_q: "queue.Queue" = queue.Queue(maxsize=_SLOTS)
+        self.timers = {"read": 0.0, "dispatch": 0.0, "encode_crc": 0.0,
+                       "write": 0.0}
+        self.tlock = threading.Lock()
+        shape = (b, DATA_SHARDS, chunk) if layout == "bk" \
+            else (DATA_SHARDS, b, chunk)
+        self._slot_leases = []
+        self.free_slots: "queue.Queue" = queue.Queue()
+        key = ("ec-stage", layout, shape)
+        nbytes = b * DATA_SHARDS * chunk
+        for _ in range(n_slots):
+            ls = pool.lease(key, lambda: np.zeros(shape, dtype=np.uint8),
+                            nbytes)
+            self._slot_leases.append(ls)
+            self.free_slots.put(ls)
+        self.ready: "queue.Queue" = queue.Queue(maxsize=n_slots)
+        self.parity_q: "queue.Queue" = queue.Queue(maxsize=n_slots)
         self.errors: list[BaseException] = []
         self.stop = threading.Event()
         self._rt = threading.Thread(target=self._reader, daemon=True)
@@ -397,21 +447,49 @@ class _PipelineIO:
                 continue
         return None
 
+    def _fill_row(self, u: _Unit, i: int, row: np.ndarray) -> int:
+        """Read shard row i of the unit into `row`, zero-padding a short
+        read; returns the count of real .dat bytes in the row."""
+        dat = self.dats[u.vol]
+        start = u.row_start + i * u.block_size + u.col
+        dat.seek(start)
+        got = dat.readinto(memoryview(row).cast("B"))
+        if got < self.chunk:
+            row[got:] = 0
+        return min(self.chunk, self.plans[u.vol].dat_size - start)
+
     def _reader(self):
         try:
             for n in range(self.n_batches):
                 batch = self.units[n * self.b:(n + 1) * self.b]
-                buf = self.get(self.free_slots)
-                if buf is None:
+                slot = self.get(self.free_slots)
+                if slot is None:
                     return
+                buf = slot.payload
+                t0 = time.perf_counter()
+                if self.layout == "kb":
+                    k_max = max(u.real_rows for u in batch)
+                else:
+                    k_max = DATA_SHARDS
                 for k, u in enumerate(batch):
-                    _read_unit(self.dats[u.vol],
-                               self.plans[u.vol].dat_size, u,
-                               self.chunk, buf[k])
                     w = self.writers[u.vol]
-                    for i in range(DATA_SHARDS):
-                        w.write(i, [buf[k, i]], u.shard_off)
-                if not self.put(self.ready, (buf, batch)):
+                    for i in range(u.real_rows):
+                        row = buf[i, k] if self.layout == "kb" \
+                            else buf[k, i]
+                        real = self._fill_row(u, i, row)
+                        w.write(i, [row[:real]], u.shard_off)
+                    # zero padding rows up to the compacted height: they
+                    # feed the parity math but neither files nor CRCs
+                    # (files are ftruncate zeros, CRC is the cached
+                    # zeros CRC)
+                    for i in range(u.real_rows, k_max):
+                        if self.layout == "kb":
+                            buf[i, k].fill(0)
+                        else:
+                            buf[k, i].fill(0)
+                with self.tlock:
+                    self.timers["read"] += time.perf_counter() - t0
+                if not self.put(self.ready, (slot, batch, k_max)):
                     return
             self.put(self.ready, None)
         except BaseException as e:  # propagate to the main thread
@@ -425,11 +503,17 @@ class _PipelineIO:
                 if item is None:
                     return
                 parity, batch = item
+                t0 = time.perf_counter()
                 for k, u in enumerate(batch):
+                    if u.real_rows == 0:
+                        continue  # parity of all-zero rows is zero:
+                        #           already on disk via ftruncate
                     w = self.writers[u.vol]
                     for i in range(PARITY_SHARDS):
                         w.write(DATA_SHARDS + i, [parity[k, i]],
                                 u.shard_off)
+                with self.tlock:
+                    self.timers["write"] += time.perf_counter() - t0
         except BaseException as e:
             self.errors.append(e)
             self.stop.set()
@@ -447,6 +531,9 @@ class _PipelineIO:
             f.close()
         for w in self.writers.values():
             w.close()
+        for ls in self._slot_leases:
+            self.pool.release(ls)
+        self._slot_leases = []
 
     def result(self) -> dict[str, list[int]]:
         if self.errors:
@@ -459,79 +546,242 @@ class _PipelineIO:
                 for vi, p in enumerate(self.plans)}
 
 
+def _device_inflight() -> int:
+    """WEED_EC_DEVICE_INFLIGHT: device dispatches in flight before the
+    completion thread must drain one (default 3).  Depth hides dispatch
+    and transfer latency — H2D, compute and D2H genuinely overlap."""
+    try:
+        return max(1, int(
+            os.environ.get("WEED_EC_DEVICE_INFLIGHT", "") or _INFLIGHT))
+    except ValueError:
+        return _INFLIGHT
+
+
 def _encode_units_device(plans, units, chunk, writers, mesh,
-                         batch_units) -> dict[str, list[int]]:
+                         batch_units,
+                         stage_stats: Optional[dict] = None
+                         ) -> dict[str, list[int]]:
     import jax
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
     from ..ops import crc32c as crc_host
     from ..ops.crc_device import finalize
-    from .mesh import make_mesh, make_sharded_encoder, words_capable
+    from ..ops.device_pool import get_pool
+    from .mesh import (make_mesh, make_parity_step, make_sharded_encoder,
+                       words_capable)
 
+    wall0 = time.perf_counter()
     if mesh is None:
         mesh = make_mesh()
     n_data, n_block = mesh.devices.shape
-    if chunk % n_block:
+    # CPU meshes run the pooled persistent SWAR parity step and CRC on
+    # host (the device GF(2) CRC bit-matmul is ~30x slower than the
+    # native host kernel there — it was 97% of the old step's time);
+    # TPU meshes keep the fused device-CRC steps.
+    host_crc = (mesh.devices.flat[0].platform == "cpu" and chunk % 4 == 0)
+    width = (chunk // 4) if host_crc else chunk  # sharded trailing axis
+    if width % n_block:
         mesh = Mesh(mesh.devices.reshape(-1, 1), mesh.axis_names)
         n_data, n_block = mesh.devices.shape
 
     if batch_units is None:
         batch_units = max(1, TARGET_BATCH_BYTES // (DATA_SHARDS * chunk))
+    # ONE fixed compiled shape for every batch in the call (the tail
+    # batch is shorter than b; its pad columns are never read back)
     b = min(batch_units, len(units))
     b = max(n_data, ((b + n_data - 1) // n_data) * n_data)
 
-    # word-layout fast path: packed int32 views move host<->device with
-    # no device bitcasts (the int32->uint8 relayout costs 10x the kernel)
-    use_words = words_capable(mesh, chunk)
-    step = make_sharded_encoder(mesh, words=use_words)
+    depth = _device_inflight()
+    pool = get_pool()
+    single = mesh.devices.size == 1
+    dev0 = mesh.devices.flat[0]
     sharding = NamedSharding(mesh, P("data", None, "block"))
+    sharding_kb = NamedSharding(mesh, P(None, "data", "block"))
 
-    io = _PipelineIO(plans, units, chunk, writers, b)
-    inflight: list = []  # (buf, batch, parity_dev, crc_dev)
+    use_words = False
+    if host_crc:
+        step = make_parity_step(mesh)
+        layout = "kb"
+        backend = "device-pooled-swar"
+        # numpy -> jax via dlpack is ZERO-copy on the CPU backend: the
+        # staging slot IS the device buffer, so H2D costs nothing (the
+        # slot is recycled only after the completion thread synchronized
+        # the batch, so the aliased memory is never overwritten mid-read)
+        zero_copy = single and dev0 == jax.devices("cpu")[0]
+    else:
+        # word-layout fast path: packed int32 views move host<->device
+        # with no device bitcasts (the relayout costs 10x the kernel)
+        use_words = words_capable(mesh, chunk)
+        step = make_sharded_encoder(mesh, words=use_words)
+        layout = "bk"
+        backend = "device-words" if use_words else "device-xla"
+        zero_copy = False
 
-    def drain_one():
-        buf, batch, parity_dev, crc_dev = inflight.pop(0)
-        # blocks until compute done; sharded gathers can come back
-        # non-contiguous, and file writes need a contiguous buffer
-        parity = np.ascontiguousarray(np.asarray(parity_dev))
-        if use_words:  # packed int32 parity words -> bytes (free view)
-            parity = parity.view(np.uint8).reshape(
-                parity.shape[0], PARITY_SHARDS, chunk)
-        crcs = finalize(crc_dev, chunk)
-        io.free_slots.put(buf)  # device consumed the input transfer
-        for k, u in enumerate(batch):
-            w = writers[u.vol]
-            for s in range(TOTAL_SHARDS):
-                w.crcs[s] = crc_host.crc32c_combine(
-                    w.crcs[s], int(crcs[k, s]), chunk)
-        io.put(io.parity_q, (parity, batch))
+    n_slots = max(_SLOTS, depth + 1)
+    io = _PipelineIO(plans, units, chunk, writers, b, layout, pool,
+                     n_slots=n_slots)
+    timers = io.timers
 
+    # donated output-slot ring (pooled path): depth+1 device slots the
+    # persistent step aliases its parity into — the donation swap means
+    # the steady state allocates nothing on device per batch
+    out_ring: "queue.Queue" = queue.Queue()
+    out_leases: list = []
+    if host_crc:
+        oshape = (PARITY_SHARDS, b, width)
+
+        def _out_factory():
+            z = np.zeros(oshape, dtype=np.int32)
+            return jax.device_put(z, dev0 if single else sharding_kb)
+
+        okey = ("ec-out", mesh, oshape)
+        for _ in range(depth + 1):
+            ls = pool.lease(okey, _out_factory, PARITY_SHARDS * b * chunk)
+            out_leases.append(ls)
+            out_ring.put(ls)
+
+    zcrc = crc_host.crc32c_zeros(chunk)
+    done_q: "queue.Queue" = queue.Queue(maxsize=depth)
+    k_shapes: set = set()
+
+    def _complete(slot, batch, out):
+        """Synchronize one batch: D2H, per-chunk CRCs chained into the
+        rolling shard-file CRCs (FIFO order — CRC chaining is order-
+        dependent), slots recycled, parity handed to the writer."""
+        buf = slot.payload
+        t0 = time.perf_counter()
+        if host_crc:
+            parity = None
+            if out is not None:
+                # copies out of the donated slot (required: the slot is
+                # re-donated for a later batch while the writer thread
+                # still holds this parity); blocks until compute done
+                parity32 = np.array(out.payload)
+                pool.note_d2h(parity32.nbytes)
+                out_ring.put(out)
+                parity = parity32.view(np.uint8).reshape(
+                    PARITY_SHARDS, b, chunk)
+            for k, u in enumerate(batch):
+                w = writers[u.vol]
+                r = u.real_rows
+                for i in range(DATA_SHARDS):
+                    c = crc_host.crc32c(buf[i, k]) if i < r else zcrc
+                    w.crcs[i] = crc_host.crc32c_combine(
+                        w.crcs[i], c, chunk)
+                for j in range(PARITY_SHARDS):
+                    c = crc_host.crc32c(parity[j, k]) if r else zcrc
+                    w.crcs[DATA_SHARDS + j] = crc_host.crc32c_combine(
+                        w.crcs[DATA_SHARDS + j], c, chunk)
+            with io.tlock:
+                timers["encode_crc"] += time.perf_counter() - t0
+            io.free_slots.put(slot)
+            if parity is not None:
+                # (4, B, L) -> writer's [k][i] indexing as a free view
+                io.put(io.parity_q, (parity.transpose(1, 0, 2), batch))
+        else:
+            parity_dev, crc_dev = out
+            # blocks until compute done; sharded gathers can come back
+            # non-contiguous, and file writes need a contiguous buffer
+            parity = np.ascontiguousarray(np.asarray(parity_dev))
+            pool.note_d2h(parity.nbytes)
+            if use_words:  # packed int32 parity words -> bytes
+                parity = parity.view(np.uint8).reshape(
+                    parity.shape[0], PARITY_SHARDS, chunk)
+            crcs = finalize(crc_dev, chunk)
+            io.free_slots.put(slot)  # device consumed the transfer
+            for k, u in enumerate(batch):
+                w = writers[u.vol]
+                for s in range(TOTAL_SHARDS):
+                    w.crcs[s] = crc_host.crc32c_combine(
+                        w.crcs[s], int(crcs[k, s]), chunk)
+            with io.tlock:
+                timers["encode_crc"] += time.perf_counter() - t0
+            io.put(io.parity_q, (parity, batch))
+
+    def _completion():
+        try:
+            while True:
+                item = io.get(done_q)
+                if item is None:
+                    return
+                _complete(*item)
+        except BaseException as e:
+            io.errors.append(e)
+            io.stop.set()
+
+    ct = threading.Thread(target=_completion, daemon=True)
     io.start()
+    ct.start()
     try:
         while not io.stop.is_set():
             item = io.get(io.ready)
             if item is None:
                 break
-            buf, batch = item
-            if use_words:
-                # pin to the mesh's device: the caller may run several
-                # 1-device meshes side by side
-                dev = jax.device_put(buf.view(np.int32),
-                                     mesh.devices.flat[0])
+            slot, batch, k_max = item
+            buf = slot.payload
+            t0 = time.perf_counter()
+            if host_crc:
+                out = None
+                if k_max > 0:
+                    k_shapes.add(k_max)
+                    words = buf.view(np.int32)[:k_max]
+                    if zero_copy:
+                        din = jax.dlpack.from_dlpack(words)
+                    else:
+                        din = jax.device_put(
+                            words, dev0 if single else sharding_kb)
+                        pool.note_h2d(words.nbytes)
+                    out = io.get(out_ring)  # backpressure at `depth`
+                    if out is None:
+                        break
+                    # donation swap: the step aliases its result into
+                    # the slot's buffer; the old handle is dead
+                    out.payload = step(din, out.payload)
             else:
-                dev = jax.device_put(buf, sharding)
-            parity_dev, crc_dev = step(dev)
-            inflight.append((buf, batch, parity_dev, crc_dev))
-            if len(inflight) >= _INFLIGHT:
-                drain_one()
-        while inflight and not io.stop.is_set():
-            drain_one()
+                if use_words:
+                    # pin to the mesh's device: the caller may run
+                    # several 1-device meshes side by side
+                    din = jax.device_put(buf.view(np.int32), dev0)
+                else:
+                    din = jax.device_put(buf, sharding)
+                pool.note_h2d(buf.nbytes)
+                out = step(din)
+            with io.tlock:
+                timers["dispatch"] += time.perf_counter() - t0
+            if not io.put(done_q, (slot, batch, out)):
+                break
+        io.put(done_q, None)
+        ct.join(timeout=600)
     except BaseException:
         io.stop.set()
         raise
     finally:
+        if ct.is_alive():
+            io.stop.set()
+            ct.join(timeout=30)
         io.finish()
-    return io.result()
+        for ls in out_leases:
+            pool.release(ls)
+    result = io.result()
+
+    wall = time.perf_counter() - wall0
+    if stage_stats is not None:
+        stage_stats.update({k: round(v, 3) for k, v in timers.items()})
+        stage_stats["wall"] = round(wall, 3)
+        stage_stats["backend"] = backend
+        stage_stats["batches"] = io.n_batches
+        stage_stats["batch_units"] = b
+        stage_stats["k_shapes"] = sorted(k_shapes)
+        stage_stats["inflight"] = depth
+        stage_stats["zero_copy_h2d"] = zero_copy
+        for k in ("read", "dispatch", "encode_crc", "write"):
+            stage_stats[f"{k}_frac"] = (
+                round(timers[k] / wall, 3) if wall > 0 else 0.0)
+        stage_stats["pool"] = pool.snapshot()
+    from ..stats import metrics as stats
+    for k, v in timers.items():
+        stats.EcEncodeStageSeconds.labels(k).set(round(v, 3))
+    return result
 
 
 # Host-pipeline work sizing: a span batches consecutive equal-block rows
@@ -947,6 +1197,7 @@ def _encode_units_host(plans, units, chunk, host_codec,
     if stage_stats is not None:
         stage_stats.update({k: round(v, 3) for k, v in timers.items()})
         stage_stats["wall"] = round(wall, 3)
+        stage_stats["backend"] = "host-pipeline"
         stage_stats["workers"] = nworkers
         stage_stats["writers"] = nwriters
         stage_stats["write_behind"] = write_behind
@@ -1007,6 +1258,7 @@ def rebuild_shards(base: str, mesh=None,
 
     from ..ops import crc32c as crc_host
     from ..ops.crc_device import finalize
+    from ..ops.device_pool import get_pool
     from ..storage.erasure_coding import to_ext
     from .mesh import make_mesh, make_sharded_apply
 
@@ -1044,6 +1296,16 @@ def rebuild_shards(base: str, mesh=None,
 
     step = make_sharded_apply(mesh, matrix)
     sharding = NamedSharding(mesh, P("data", None, "block"))
+    pool = get_pool()
+    # two pooled staging buffers: a buffer is refilled only after its
+    # batch drained (which implies the host->device transfer completed);
+    # leased from the slab pool so consecutive rebuilds with the same
+    # geometry reuse them instead of reallocating
+    skey = ("rebuild-stage", (b, DATA_SHARDS, chunk))
+    slots = [pool.lease(skey,
+                        lambda: np.zeros((b, DATA_SHARDS, chunk),
+                                         dtype=np.uint8),
+                        b * DATA_SHARDS * chunk) for _ in range(2)]
 
     inputs = [open(base + to_ext(i), "rb") for i in chosen]
     _, _, flush_bytes, drop_cache = _write_knobs()
@@ -1084,6 +1346,7 @@ def rebuild_shards(base: str, mesh=None,
         def drain_one():
             batch_offs, out_dev, crc_dev = inflight.pop(0)
             out = np.ascontiguousarray(np.asarray(out_dev))
+            pool.note_d2h(out.nbytes)
             raw = np.asarray(crc_dev)
             for k, off in enumerate(batch_offs):
                 width = min(chunk, shard_size - off)
@@ -1105,12 +1368,8 @@ def rebuild_shards(base: str, mesh=None,
                 except queue.Full:
                     continue
 
-        # two staging buffers: a buffer is refilled only after its batch
-        # drained (which implies the host->device transfer completed)
-        bufs = [np.zeros((b, DATA_SHARDS, chunk), dtype=np.uint8)
-                for _ in range(2)]
         for step_i, start in enumerate(range(0, len(offsets), b)):
-            buf = bufs[step_i % 2]
+            buf = slots[step_i % 2].payload
             batch_offs = offsets[start:start + b]
             for k, off in enumerate(batch_offs):
                 width = min(chunk, shard_size - off)
@@ -1123,6 +1382,7 @@ def rebuild_shards(base: str, mesh=None,
                     if width < chunk:
                         buf[k, i, width:] = 0
             dev = jax.device_put(buf, sharding)
+            pool.note_h2d(buf.nbytes)
             out_dev, crc_dev = step(dev)
             inflight.append((batch_offs, out_dev, crc_dev))
             if len(inflight) >= 2:
@@ -1130,6 +1390,8 @@ def rebuild_shards(base: str, mesh=None,
         while inflight:
             drain_one()
     finally:
+        for sl in slots:
+            pool.release(sl)
         try:
             wq.put(None, timeout=5)
         except queue.Full:

@@ -106,6 +106,60 @@ def batched_encode_step(bit_matrix, data):
 _ENCODER_CACHE: dict = {}
 _APPLY_CACHE: dict = {}
 _PALLAS_OK: dict = {}
+_PARITY_STEP_CACHE: dict = {}
+
+
+def make_parity_step(mesh: Mesh, data_shards: int = 10,
+                     parity_shards: int = 4):
+    """Persistent parity-only step for the pooled device dispatch path:
+    (data32 (k, B, W) int32 packed bytes, out (p, B, W) int32 DONATED)
+    -> (p, B, W) int32 parity words.
+
+    The k axis is the COMPACTED data-row count: trailing all-zero shard
+    rows (the format's zero-padded tail striping) contribute nothing to
+    parity, so the caller slices them off and the step retraces per
+    distinct k (bounded by data_shards shapes).  The donated `out` slot
+    makes XLA alias the result into the same device buffer every batch,
+    which is what lets the steady state run with zero per-batch device
+    allocations.  CRCs are deliberately NOT fused here: this step serves
+    CPU meshes, where the host crc32c kernel is ~30x the GF(2) bit-matmul
+    CRC's rate, so the pipeline CRCs on host while the next batch is in
+    flight (TPU meshes keep the fused device-CRC steps below).
+
+    One jitted callable per (mesh, geometry), shared across encode calls;
+    XLA's shape-keyed trace cache handles the per-k retraces.
+    """
+    from ..ops.rs_jax import _SPREAD, _bit_constants_cached
+
+    cache_key = (mesh, data_shards, parity_shards)
+    cached = _PARITY_STEP_CACHE.get(cache_key)
+    if cached is not None:
+        return cached
+    matrix = gf256.parity_matrix(data_shards, data_shards + parity_shards)
+    consts = jnp.asarray(_bit_constants_cached(*_matrix_key(matrix)))
+
+    def _parity(data32, out):
+        # SWAR over packed words, batched over (B, W): one set bit per
+        # byte lane after the shift+mask, so the int32 multiply by the
+        # per-bit GF constants stays within each byte (rs_jax._apply_swar
+        # generalized to a batch axis, unrolled over k*8 bit planes)
+        acc = out ^ out  # zeros that READ the donated slot: keeps the
+        #                  buffer aliasable into the result
+        for j in range(data32.shape[0]):
+            x = data32[j]
+            for bit in range(8):
+                t = jax.lax.shift_right_logical(x, bit) & _SPREAD
+                acc = acc ^ (t[None, :, :] * consts[:, j, bit][:, None, None])
+        return acc
+
+    if mesh.devices.size == 1:
+        step = jax.jit(_parity, donate_argnums=(1,))
+    else:
+        sh = NamedSharding(mesh, P(None, "data", "block"))
+        step = jax.jit(_parity, in_shardings=(sh, sh), out_shardings=sh,
+                       donate_argnums=(1,))
+    _PARITY_STEP_CACHE[cache_key] = step
+    return step
 
 
 def _pallas_fused_ok(matrix) -> bool:

@@ -321,6 +321,25 @@ EcRecoverSpanCounter = REGISTRY.counter(
 EcRecoverBytesCounter = REGISTRY.counter(
     "SeaweedFS_volumeServer_ec_recover_bytes_total",
     "survivor bytes pushed through degraded-read decodes")
+# device pipeline: the HBM slab pool behind the batched EC dispatch
+# path (ops/device_pool.py) and the host<->device transfer volume of
+# the encode/rebuild/recover device paths
+DevicePoolSlotsGauge = REGISTRY.gauge(
+    "SeaweedFS_volumeServer_device_pool_slots",
+    "EC device-pool slabs by state (free / leased / resident)",
+    ("state",))
+DevicePoolBytesGauge = REGISTRY.gauge(
+    "SeaweedFS_volumeServer_device_pool_bytes",
+    "total bytes retained or leased by the EC device slab pool")
+DevicePoolEvictionsCounter = REGISTRY.counter(
+    "SeaweedFS_volumeServer_device_pool_evictions_total",
+    "idle EC device-pool slabs evicted by the WEED_EC_DEVICE_POOL_MB cap")
+EcDeviceH2dBytesCounter = REGISTRY.counter(
+    "SeaweedFS_volumeServer_ec_device_h2d_bytes_total",
+    "bytes staged host->device by the EC device dispatch paths")
+EcDeviceD2hBytesCounter = REGISTRY.counter(
+    "SeaweedFS_volumeServer_ec_device_d2h_bytes_total",
+    "bytes fetched device->host by the EC device dispatch paths")
 FilerChunkCacheCounter = REGISTRY.counter(
     "SeaweedFS_filer_chunk_cache_total",
     "filer chunk cache lookups", ("result",))

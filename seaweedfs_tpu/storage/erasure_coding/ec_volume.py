@@ -14,6 +14,7 @@ Parity with ec_volume.go / ec_shard.go / ec_volume_delete.go / store_ec.go:
 
 from __future__ import annotations
 
+import hashlib
 import os
 import struct
 import threading
@@ -446,9 +447,18 @@ class EcVolume:
             for i, sid in enumerate(survivors):
                 shard_list[sid] = inputs[i]
             return self._encoder.reconstruct_one(shard_list, target)
+        slab_key = None
+        if (inputs.nbytes >= codec_mod.recover_device_min_bytes()
+                and codec_mod.recover_device_enabled()):
+            # content identity for the device slab pool: consecutive
+            # decodes of the same survivor spans (another missing shard,
+            # or a block re-recovered after cache eviction) reuse the
+            # HBM-resident upload instead of re-crossing the link
+            slab_key = hashlib.blake2b(
+                np.ascontiguousarray(inputs), digest_size=16).digest()
         return codec_mod.reconstruct_span(
             survivors, inputs, target,
-            DATA_SHARDS_COUNT, TOTAL_SHARDS_COUNT)
+            DATA_SHARDS_COUNT, TOTAL_SHARDS_COUNT, slab_key=slab_key)
 
     # -- delete (ec_volume_delete.go) -----------------------------------------
     def delete_needle(self, needle_id: int):

@@ -143,6 +143,17 @@ class RecoverStats:
         if wall and wall > 0:
             for k in ("fetch", "decode", "serve"):
                 out[f"{k}_frac"] = round(out[f"{k}_seconds"] / wall, 3)
+        # the device slab pool serving the recover device path: resident
+        # hits here are survivor-stack uploads the pool saved (one
+        # incident's repeated decodes against the same survivor set)
+        from ...ops import device_pool
+
+        pool = device_pool.get_pool()
+        snap = pool.snapshot()
+        out["device_pool"] = {
+            k: snap[k] for k in ("resident_slabs", "resident_hits",
+                                 "resident_misses", "bytes",
+                                 "evictions")}
         return out
 
 

@@ -506,3 +506,79 @@ class TestWriteBehindStage:
             os.close(fd)
         with open(path, "rb") as f:
             assert f.read() == b"\0\0\0aabbbcccc"
+
+
+class TestDevicePoolPipeline:
+    """The HBM slab-pool dispatch path (ops/device_pool.py + the pooled
+    _encode_units_device): cross-volume identity on an explicit CPU-device
+    mesh, donation safety under inflight slot reuse, and the zero
+    per-batch-allocation steady state."""
+
+    def _assert_identical(self, tmp_path, bases, crcs, tag):
+        for k, base in enumerate(bases):
+            ref = _host_reference(tmp_path, base, f"{tag}{k}")
+            for i in range(14):
+                with open(base + to_ext(i), "rb") as a, \
+                        open(ref + to_ext(i), "rb") as b:
+                    got = a.read()
+                    assert got == b.read(), f"vol {k} shard {i}"
+                assert crcs[base][i] == crc_host.crc32c(got), \
+                    f"vol {k} crc {i}"
+
+    def test_cross_volume_identity_on_mesh(self, tmp_path):
+        """Mixed block sizes and padded tails batched through ONE pooled
+        dispatch on an explicit CPU-device mesh must be byte- and
+        CRC-identical to the reference host encode."""
+        import jax
+
+        from seaweedfs_tpu.parallel.mesh import make_mesh
+
+        sizes = [LARGE * 10 + SMALL * 3 + 57,   # large rows + small tail
+                 SMALL * 10,                     # exactly one full unit
+                 999,                            # sub-unit, padded tail
+                 1]                              # single byte
+        bases = [_make_volume(tmp_path, f"mesh{k}", size, 100 + k)
+                 for k, size in enumerate(sizes)]
+        st: dict = {}
+        crcs = encode_volumes(bases, large_block=LARGE, small_block=SMALL,
+                              mesh=make_mesh(jax.devices()),
+                              stage_stats=st)
+        assert st["backend"].startswith("device-")
+        self._assert_identical(tmp_path, bases, crcs, "meshref")
+
+    @pytest.mark.parametrize("depth", ["1", "4"])
+    def test_donation_slot_reuse_is_safe(self, tmp_path, monkeypatch,
+                                         depth):
+        """The donated output ring and recycled staging slots must not
+        corrupt results at any inflight depth — a slot re-filled before
+        its batch's completion sync would show up as shard corruption."""
+        monkeypatch.setenv("WEED_EC_DEVICE_INFLIGHT", depth)
+        bases = [_make_volume(tmp_path, f"d{depth}v{k}",
+                              SMALL * 10 * 3 + 7 * k, 200 + k)
+                 for k in range(6)]
+        crcs = encode_volumes(bases, large_block=LARGE, small_block=SMALL,
+                              batch_units=2)  # several batches in flight
+        self._assert_identical(tmp_path, bases, crcs, f"d{depth}ref")
+
+    def test_steady_state_makes_zero_allocations(self, tmp_path):
+        """Repeat encodes with the same geometry re-lease pooled slabs:
+        the pool's alloc counter must not move after the first run."""
+        from seaweedfs_tpu.ops.device_pool import get_pool, reset_pool
+
+        reset_pool()
+        size = SMALL * 10 * 4 + 11
+        for rep in range(3):
+            bases = [_make_volume(tmp_path, f"s{rep}v{k}", size, k)
+                     for k in range(3)]
+            st: dict = {}
+            encode_volumes(bases, large_block=LARGE, small_block=SMALL,
+                           stage_stats=st)
+            snap = get_pool().snapshot()
+            if rep == 0:
+                first_allocs = snap["allocs"]
+            else:
+                assert snap["allocs"] == first_allocs, \
+                    f"rep {rep} allocated new slabs: {snap}"
+                assert snap["lease_hits"] > 0
+        assert st["backend"].startswith("device-")
+        reset_pool()

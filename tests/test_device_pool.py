@@ -1,0 +1,167 @@
+"""HBM slab pool for the EC device pipeline (ops/device_pool.py):
+lease reuse, LRU retention-cap eviction, resident refcounting, and the
+recover path's content-addressed slab reuse."""
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.ops import gf256
+from seaweedfs_tpu.ops.codec import reconstruct_span
+from seaweedfs_tpu.ops.device_pool import DevicePool, get_pool, reset_pool
+from seaweedfs_tpu.ops.rs_numpy import gf_apply_matrix
+
+
+@pytest.fixture
+def pool():
+    return DevicePool()
+
+
+def _lease_some(pool, key, n, nbytes=1 << 10):
+    return [pool.lease(key, lambda: bytearray(nbytes), nbytes)
+            for _ in range(n)]
+
+
+class TestLeases:
+    def test_release_then_lease_reuses_slab(self, pool):
+        ls = pool.lease("k", lambda: bytearray(8), 8)
+        payload = ls.payload
+        pool.release(ls)
+        ls2 = pool.lease("k", lambda: bytearray(8), 8)
+        assert ls2.payload is payload
+        snap = pool.snapshot()
+        assert snap["allocs"] == 1 and snap["lease_hits"] == 1
+
+    def test_distinct_keys_do_not_cross(self, pool):
+        a = pool.lease(("shape", 1), lambda: "a", 1)
+        pool.release(a)
+        b = pool.lease(("shape", 2), lambda: "b", 1)
+        assert b.payload == "b"
+        assert pool.snapshot()["allocs"] == 2
+
+    def test_payload_swap_travels_through_release(self, pool):
+        """Donation contract: the caller swaps lease.payload for the
+        returned (re-aliased) handle; the swap must persist."""
+        ls = pool.lease("k", lambda: "old", 4)
+        ls.payload = "new"
+        pool.release(ls)
+        assert pool.lease("k", lambda: "x", 4).payload == "new"
+
+    def test_discard_retains_nothing(self, pool):
+        ls = pool.lease("k", lambda: "a", 64)
+        pool.discard(ls)
+        snap = pool.snapshot()
+        assert snap["free_slots"] == 0 and snap["bytes"] == 0
+
+    def test_lru_eviction_under_cap(self, pool, monkeypatch):
+        monkeypatch.setenv("WEED_EC_DEVICE_POOL_MB", "0.002")  # 2 KiB
+        leases = _lease_some(pool, "k", 3, nbytes=1 << 10)
+        for ls in leases:   # releasing 3 KiB idle against a 2 KiB cap
+            pool.release(ls)
+        snap = pool.snapshot()
+        assert snap["evictions"] == 1
+        assert snap["free_slots"] == 2
+        # oldest released slab went first
+        survivors = [pool.lease("k", lambda: None, 1 << 10).payload
+                     for _ in range(2)]
+        assert not any(s is leases[0].payload for s in survivors)
+
+    def test_leased_slabs_never_evicted(self, pool, monkeypatch):
+        monkeypatch.setenv("WEED_EC_DEVICE_POOL_MB", "0")
+        leases = _lease_some(pool, "k", 2, nbytes=1 << 20)
+        assert pool.snapshot()["evictions"] == 0
+        for ls in leases:
+            pool.release(ls)
+        snap = pool.snapshot()
+        assert snap["evictions"] == 2 and snap["free_slots"] == 0
+
+
+class TestResidents:
+    def test_hit_returns_same_payload(self, pool):
+        made = []
+
+        def factory():
+            made.append(1)
+            return object()
+
+        p1 = pool.acquire_resident("slab", factory, 256)
+        p2 = pool.acquire_resident("slab", factory, 256)
+        assert p1 is p2 and len(made) == 1
+        snap = pool.snapshot()
+        assert snap["resident_misses"] == 1 and snap["resident_hits"] == 1
+
+    def test_refcount_blocks_eviction(self, pool, monkeypatch):
+        monkeypatch.setenv("WEED_EC_DEVICE_POOL_MB", "0")
+        pool.acquire_resident("hot", lambda: "payload", 1 << 20)
+        # refs == 1: releasing an unrelated lease triggers eviction scans
+        pool.release(pool.lease("k", lambda: None, 1))
+        assert pool.snapshot()["resident_slabs"] == 1
+        pool.release_resident("hot")
+        pool.release(pool.lease("k", lambda: None, 1))
+        assert pool.snapshot()["resident_slabs"] == 0
+        assert pool.snapshot()["evictions"] >= 1
+
+    def test_zero_ref_resident_survives_under_cap(self, pool):
+        pool.acquire_resident("warm", lambda: "payload", 1 << 10)
+        pool.release_resident("warm")
+        # cached for the NEXT degraded read — that is the point
+        assert pool.snapshot()["resident_slabs"] == 1
+        pool.acquire_resident("warm", lambda: "new", 1 << 10)
+        assert pool.snapshot()["resident_hits"] == 1
+
+    def test_transfer_counters(self, pool):
+        pool.note_h2d(100)
+        pool.note_h2d(50)
+        pool.note_d2h(30)
+        snap = pool.snapshot()
+        assert snap["h2d_bytes"] == 150 and snap["d2h_bytes"] == 30
+
+
+class TestProcessPool:
+    def test_singleton_and_reset(self):
+        reset_pool()
+        p = get_pool()
+        assert get_pool() is p
+        reset_pool()
+        assert get_pool() is not p
+
+
+class TestRecoverSlabReuse:
+    def _codeword(self, length=4096, seed=3):
+        rng = np.random.default_rng(seed)
+        data = rng.integers(0, 256, (10, length), dtype=np.uint8)
+        parity = gf_apply_matrix(gf256.parity_matrix(10, 14), data)
+        return np.concatenate([data, parity], axis=0)
+
+    def test_consecutive_decodes_hit_resident_slab(self, monkeypatch):
+        monkeypatch.setenv("WEED_EC_RECOVER_DEVICE", "1")
+        monkeypatch.setenv("WEED_EC_RECOVER_DEVICE_MIN_KB", "1")
+        reset_pool()
+        shards = self._codeword()
+        survivors = list(range(1, 11))
+        inputs = np.ascontiguousarray(shards[1:11])
+        key = b"content-identity"
+        got0 = reconstruct_span(survivors, inputs, 0, slab_key=key)
+        snap = get_pool().snapshot()
+        assert snap["resident_misses"] == 1 and snap["resident_slabs"] == 1
+        # a DIFFERENT missing target over the same survivor spans: the
+        # upload is skipped, the HBM slab is reused
+        got11 = reconstruct_span(survivors, inputs, 11, slab_key=key)
+        snap = get_pool().snapshot()
+        assert snap["resident_hits"] >= 1 and snap["resident_misses"] == 1
+        assert np.array_equal(got0, shards[0])
+        assert np.array_equal(got11, shards[11])
+        reset_pool()
+
+    def test_device_matches_host_decode(self, monkeypatch):
+        shards = self._codeword(seed=17)
+        survivors = [0, 2, 3, 4, 5, 6, 7, 8, 9, 13]
+        inputs = np.ascontiguousarray(shards[survivors])
+        monkeypatch.setenv("WEED_EC_RECOVER_DEVICE", "0")
+        want = reconstruct_span(survivors, inputs, 1)
+        monkeypatch.setenv("WEED_EC_RECOVER_DEVICE", "1")
+        monkeypatch.setenv("WEED_EC_RECOVER_DEVICE_MIN_KB", "1")
+        reset_pool()
+        got = reconstruct_span(survivors, inputs, 1, slab_key=b"k2")
+        assert np.array_equal(got, want)
+        assert np.array_equal(got, shards[1])
+        reset_pool()

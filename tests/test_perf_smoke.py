@@ -117,3 +117,30 @@ def test_streamed_get_first_byte_before_last_chunk(stack, monkeypatch):
     finally:
         release_last.set()
         sock.close()
+
+
+def test_device_scale_dispatch_smoke(tmp_path):
+    """Mini bench_e2e_device_scale (4 volumes, CPU-device mesh): asserts
+    the SHAPE of the pooled device pipeline — the pooled backend was
+    selected, the compiled-shape set stays bounded (one fixed batch
+    geometry, not one compile per volume), and repeat dispatches re-lease
+    slabs instead of allocating — not a GiB/s number."""
+    import bench
+    from seaweedfs_tpu.ops.device_pool import get_pool, reset_pool
+
+    reset_pool()
+    rate, st = bench.bench_e2e_device_scale(
+        4, 256 << 10, str(tmp_path), link_capped=True)
+    assert rate > 0
+    assert st["backend"].startswith("device-pooled")
+    assert st["batches"] >= 1
+    # one fixed compiled geometry: k-compaction may retrace per distinct
+    # k, but equal-size volumes must share ONE shape
+    assert len(st["k_shapes"]) == 1
+    assert st["inflight"] >= 1
+    snap = get_pool().snapshot()
+    # the warm encode populated the pool; the timed run re-leased
+    assert snap["lease_hits"] > 0, snap
+    assert st["pool"]["allocs"] == snap["allocs"], \
+        "timed window allocated fresh slabs"
+    reset_pool()
