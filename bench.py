@@ -1031,6 +1031,13 @@ def main():
     dev_scale_stages: dict = {}
     workdir = _pick_workdir(
         max((n_dev + 1) * vol_bytes * 3, scale_vols * scale_vol_bytes * 3))
+    # folded-stack sampler across the e2e encode phases: the bench JSON
+    # carries a self-time top-frames breakdown so a rate regression
+    # comes with its own attribution (not a separate profiling run)
+    from seaweedfs_tpu import profiling as _profiling
+
+    e2e_sampler = _profiling.StackSampler(hz=37.0)
+    e2e_sampler.start()
     try:
         e2e_single = bench_e2e_disk(1, vol_bytes, workdir)
         e2e_device = bench_e2e_disk(n_dev, vol_bytes, workdir, warm=False)
@@ -1051,7 +1058,9 @@ def main():
     except Exception as e:
         print(f"note: device scale e2e failed: {e}", file=sys.stderr)
     finally:
+        e2e_sampler.stop()
         shutil.rmtree(workdir, ignore_errors=True)
+    e2e_profile_top = e2e_sampler.top_frames(12)
 
     # -- small-file data plane (the reference README's headline bench) ------
     # 1M x 1 KB c=16 published numbers: 15,708 writes/s / 47,019 reads/s
@@ -1127,6 +1136,7 @@ def main():
         "e2e_device_dispatch_100vol_gibps": round(dev_scale_rate, 3),
         "e2e_device_dispatch_backend": dev_scale_stages.get("backend", ""),
         "e2e_device_dispatch_stages": dev_scale_stages,
+        "e2e_profile_top": e2e_profile_top,
         "workdir": dict(_WORKDIR_INFO),
         "scale_total_gib": round(scale_vols * scale_vol_bytes / GIB, 2),
         "scale_peak_rss_mb": round(scale_rss, 1),

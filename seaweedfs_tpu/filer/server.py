@@ -23,7 +23,7 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Iterator, Optional
 
-from .. import tracing
+from .. import profiling, tracing
 from ..rpc import policy
 from ..rpc.http_rpc import Request, Response, RpcError, RpcServer, call
 from ..util import faults
@@ -133,6 +133,7 @@ class FilerServer:
         self.server.add("GET", "/metrics", stats.metrics_handler)
         self.server.add("GET", "/debug/traces", tracing.traces_handler)
         faults.mount(self.server)
+        profiling.mount(self.server)
         self.server.add("GET", "/metadata/subscribe", self._h_subscribe)
         self.server.add("GET", "/metadata/aggregate", self._h_aggregate)
         self.server.add("POST", "/remote/configure", self._h_remote_configure)

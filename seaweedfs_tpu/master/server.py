@@ -17,7 +17,7 @@ import time
 import urllib.parse
 from typing import Optional
 
-from .. import tracing
+from .. import profiling, tracing
 from ..rpc.http_rpc import RpcError, RpcServer, call
 from ..security import Guard, gen_write_jwt
 from ..stats import metrics as stats
@@ -243,6 +243,7 @@ class MasterServer:
         s.add("GET", "/metrics", stats.metrics_handler)
         s.add("GET", "/debug/traces", tracing.traces_handler)
         faults.mount(s)
+        profiling.mount(s)
         s.add("POST", "/raft/request_vote",
               lambda r: self.raft.handle_request_vote(r.json()))
         s.add("POST", "/raft/append_entries",

@@ -97,6 +97,14 @@ class DevicePool:
         self.h2d_bytes = 0
         self.d2h_bytes = 0
         self._evictions_published = 0
+        # HBM occupancy telemetry: peak bytes ever held, plus wall time
+        # accrued while occupancy sat at >=95% of that peak (a pool
+        # pinned at its watermark is the signal to raise
+        # WEED_EC_DEVICE_POOL_MB or shrink the batch geometry)
+        self._hwm_bytes = 0
+        self._hwm_seconds = 0.0
+        self._occ_ts = time.monotonic()
+        self._occ_bytes = 0
 
     # -- transfer/compute slots ---------------------------------------
 
@@ -223,13 +231,30 @@ class DevicePool:
         from ..stats import metrics as stats
         stats.EcDeviceD2hBytesCounter.inc(nbytes)
 
+    def _note_occupancy_locked(self):
+        """Advance the watermark clock (lock held).  Time since the last
+        byte mutation is attributed to the PREVIOUS occupancy level, so
+        `hwm_seconds` is exact piecewise accounting, not sampling."""
+        now = time.monotonic()
+        if self._hwm_bytes > 0 and \
+                self._occ_bytes >= 0.95 * self._hwm_bytes:
+            self._hwm_seconds += now - self._occ_ts
+        self._occ_ts = now
+        self._occ_bytes = (self._free_bytes + self._leased_bytes
+                           + self._resident_bytes)
+        if self._occ_bytes > self._hwm_bytes:
+            self._hwm_bytes = self._occ_bytes
+
     def _publish(self):
         """Mirror state into the Prometheus vectors (lock held: the
         registry's own primitives are lock-free enough)."""
+        self._note_occupancy_locked()
         try:
             from ..stats import metrics as stats
         except Exception:  # pragma: no cover - import cycles at teardown
             return
+        stats.DevicePoolHwmBytesGauge.set(self._hwm_bytes)
+        stats.DevicePoolHwmSecondsGauge.set(self._hwm_seconds)
         stats.DevicePoolSlotsGauge.labels("free").set(
             len(self._free_order))
         stats.DevicePoolSlotsGauge.labels("leased").set(self._leased_count)
@@ -244,7 +269,10 @@ class DevicePool:
 
     def snapshot(self) -> dict:
         with self._lock:
+            self._note_occupancy_locked()
             return {
+                "hwm_bytes": self._hwm_bytes,
+                "hwm_seconds": round(self._hwm_seconds, 3),
                 "free_slots": len(self._free_order),
                 "leased_slots": self._leased_count,
                 "resident_slabs": len(self._residents),

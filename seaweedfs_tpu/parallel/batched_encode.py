@@ -48,6 +48,8 @@ from typing import Optional
 
 import numpy as np
 
+from .. import profiling
+
 DATA_SHARDS = 10
 PARITY_SHARDS = 4
 TOTAL_SHARDS = 14
@@ -643,8 +645,9 @@ def _encode_units_device(plans, units, chunk, writers, mesh,
     zcrc = crc_host.crc32c_zeros(chunk)
     done_q: "queue.Queue" = queue.Queue(maxsize=depth)
     k_shapes: set = set()
+    kernel_lats: list = []  # host-timed dispatch->ready per batch
 
-    def _complete(slot, batch, out):
+    def _complete(slot, batch, out, t_disp, k_rows):
         """Synchronize one batch: D2H, per-chunk CRCs chained into the
         rolling shard-file CRCs (FIFO order — CRC chaining is order-
         dependent), slots recycled, parity handed to the writer."""
@@ -657,6 +660,10 @@ def _encode_units_device(plans, units, chunk, writers, mesh,
                 # re-donated for a later batch while the writer thread
                 # still holds this parity); blocks until compute done
                 parity32 = np.array(out.payload)
+                lat = time.perf_counter() - t_disp
+                kernel_lats.append(lat)
+                profiling.record_device_batch(lat, units=len(batch),
+                                              k=k_rows)
                 pool.note_d2h(parity32.nbytes)
                 out_ring.put(out)
                 parity = parity32.view(np.uint8).reshape(
@@ -683,6 +690,9 @@ def _encode_units_device(plans, units, chunk, writers, mesh,
             # blocks until compute done; sharded gathers can come back
             # non-contiguous, and file writes need a contiguous buffer
             parity = np.ascontiguousarray(np.asarray(parity_dev))
+            lat = time.perf_counter() - t_disp
+            kernel_lats.append(lat)
+            profiling.record_device_batch(lat, units=len(batch), k=k_rows)
             pool.note_d2h(parity.nbytes)
             if use_words:  # packed int32 parity words -> bytes
                 parity = parity.view(np.uint8).reshape(
@@ -748,7 +758,7 @@ def _encode_units_device(plans, units, chunk, writers, mesh,
                 out = step(din)
             with io.tlock:
                 timers["dispatch"] += time.perf_counter() - t0
-            if not io.put(done_q, (slot, batch, out)):
+            if not io.put(done_q, (slot, batch, out, t0, k_max)):
                 break
         io.put(done_q, None)
         ct.join(timeout=600)
@@ -765,6 +775,20 @@ def _encode_units_device(plans, units, chunk, writers, mesh,
     result = io.result()
 
     wall = time.perf_counter() - wall0
+    # XLA cost analysis once per compiled geometry (pooled SWAR path;
+    # StableHLO-level, no backend compile — see mesh.step_cost_analysis)
+    kernel_cost = {}
+    if host_crc:
+        from .mesh import step_cost_analysis
+
+        for k in sorted(k_shapes):
+            geom = f"k{k}xb{b}xw{width}"
+            entry = step_cost_analysis(
+                step, geom,
+                jax.ShapeDtypeStruct((k, b, width), np.int32),
+                jax.ShapeDtypeStruct((PARITY_SHARDS, b, width), np.int32))
+            if entry is not None:
+                kernel_cost[geom] = entry
     if stage_stats is not None:
         stage_stats.update({k: round(v, 3) for k, v in timers.items()})
         stage_stats["wall"] = round(wall, 3)
@@ -777,6 +801,19 @@ def _encode_units_device(plans, units, chunk, writers, mesh,
         for k in ("read", "dispatch", "encode_crc", "write"):
             stage_stats[f"{k}_frac"] = (
                 round(timers[k] / wall, 3) if wall > 0 else 0.0)
+        if kernel_lats:
+            lats = sorted(kernel_lats)
+            stage_stats["kernel"] = {
+                "batches": len(lats),
+                "dispatch_ready_p50_ms": round(
+                    lats[len(lats) // 2] * 1e3, 3),
+                "dispatch_ready_p95_ms": round(
+                    lats[min(len(lats) - 1,
+                             int(len(lats) * 0.95))] * 1e3, 3),
+                "dispatch_ready_max_ms": round(lats[-1] * 1e3, 3),
+            }
+        if kernel_cost:
+            stage_stats["kernel_cost"] = kernel_cost
         stage_stats["pool"] = pool.snapshot()
     from ..stats import metrics as stats
     for k, v in timers.items():

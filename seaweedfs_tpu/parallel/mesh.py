@@ -162,6 +162,37 @@ def make_parity_step(mesh: Mesh, data_shards: int = 10,
     return step
 
 
+_COST_CACHE: dict = {}
+
+
+def step_cost_analysis(step, key, *abstract_args):
+    """XLA cost analysis (flops / bytes accessed) for `step` at the
+    abstract shapes in `abstract_args`, computed once per `key` and
+    published to the profiling layer's kernel-cost table.
+
+    Uses ``Lowered.cost_analysis()`` — StableHLO-level, no backend
+    compile (~10ms) — so capturing it always-on per compiled geometry is
+    safe even inside the encode hot path.  Returns the entry dict, or
+    None when analysis is unavailable on this jax build."""
+    cached = _COST_CACHE.get(key)
+    if cached is not None:
+        return cached
+    try:
+        cost = step.lower(*abstract_args).cost_analysis()
+        if isinstance(cost, (list, tuple)):  # older jax: one per device
+            cost = cost[0] if cost else {}
+        flops = float(cost.get("flops", 0.0))
+        nbytes = float(cost.get("bytes accessed", 0.0))
+    except Exception:  # cost analysis is telemetry, never fatal
+        return None
+    from .. import profiling
+
+    entry = {"flops": flops, "bytes_accessed": nbytes}
+    _COST_CACHE[key] = entry
+    profiling.record_kernel_cost(str(key), flops, nbytes)
+    return entry
+
+
 def _pallas_fused_ok(matrix) -> bool:
     """One-time self-test (per matrix geometry) of the fused Mosaic
     kernel on this backend: compile+run at a production-representative

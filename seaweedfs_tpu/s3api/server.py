@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import hashlib
 import json
+import threading
 import time
 import urllib.parse
 import uuid
@@ -24,7 +25,7 @@ from ..filer.filechunk_manifest import (has_chunk_manifest,
                                         resolve_chunk_manifest)
 from ..filer.filer_store import NotFoundError
 from ..filer.server import FilerServer
-from .. import tracing
+from .. import profiling, tracing
 from ..rpc.http_rpc import Request, Response, RpcError, RpcServer
 from ..stats import metrics as stats
 from ..util import faults
@@ -133,7 +134,10 @@ class S3ApiServer:
         self.server.add("GET", "/metrics", stats.metrics_handler)
         self.server.add("GET", "/debug/traces", tracing.traces_handler)
         faults.mount(self.server)
+        profiling.mount(self.server)
         self.server.default_route = self._handle
+        self._stop_event = threading.Event()
+        self._register_thread: Optional[threading.Thread] = None
 
     @property
     def address(self) -> str:
@@ -141,9 +145,33 @@ class S3ApiServer:
 
     def start(self):
         self.server.start()
+        # announce in the master's cluster registry as type "s3" (the
+        # filer does the same as type "filer") so cluster-wide tooling
+        # — weed.py profile, /cluster/nodes?type=s3 — can discover
+        # gateways; previously s3 daemons were invisible to discovery
+        self._register_thread = threading.Thread(
+            target=self._register_loop, daemon=True,
+            name="s3-cluster-register")
+        self._register_thread.start()
 
     def stop(self):
+        self._stop_event.set()
         self.server.stop()
+
+    def _register_loop(self):
+        from ..rpc.http_rpc import RpcError, call
+
+        interval = 5.0
+        while not self._stop_event.is_set():
+            try:
+                r = call(self.filer_server.master_address,
+                         "/cluster/register",
+                         {"type": "s3", "address": self.address},
+                         timeout=10)
+                interval = min(5.0, float(r.get("pulse_seconds", 5.0)))
+            except (RpcError, OSError):
+                pass
+            self._stop_event.wait(interval)
 
     def _maybe_reload_circuit_breaker(self):
         if not self._cb_from_filer or \

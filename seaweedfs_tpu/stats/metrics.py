@@ -414,6 +414,52 @@ VolumeReadonlyDemotions = REGISTRY.counter(
     "volumes auto-demoted to read-only after disk write failures")
 
 
+# -- continuous profiling (profiling.py): the always-on folded-stack
+# sampler's self-measured duty cycle and per-route sample counts, plus
+# the device-side kernel telemetry fed by the EC dispatch pipeline
+def _profiler_overhead() -> float:
+    from .. import profiling
+
+    return profiling.overhead_ratio()
+
+
+def _profiler_stacks() -> float:
+    from .. import profiling
+
+    return profiling.stack_count()
+
+
+ProfilerOverheadGauge = REGISTRY.gauge(
+    "SeaweedFS_profiler_overhead_ratio",
+    "fraction of wall time the always-on stack sampler spends sampling",
+    fn=_profiler_overhead)
+ProfilerStacksGauge = REGISTRY.gauge(
+    "SeaweedFS_profiler_stacks",
+    "distinct folded stacks interned by the always-on sampler",
+    fn=_profiler_stacks)
+ProfilerRouteSamplesCounter = REGISTRY.counter(
+    "SeaweedFS_profiler_route_samples_total",
+    "always-on profiler samples attributed to an active RPC route",
+    ("route",))
+EcKernelDispatchHistogram = REGISTRY.histogram(
+    "SeaweedFS_volumeServer_ec_kernel_dispatch_ready_seconds",
+    "host-observed dispatch->ready latency per EC device batch")
+EcKernelFlopsGauge = REGISTRY.gauge(
+    "SeaweedFS_volumeServer_ec_kernel_flops",
+    "XLA cost-analysis flops per compiled EC parity geometry",
+    ("geometry",))
+EcKernelBytesGauge = REGISTRY.gauge(
+    "SeaweedFS_volumeServer_ec_kernel_bytes_accessed",
+    "XLA cost-analysis bytes accessed per compiled EC parity geometry",
+    ("geometry",))
+DevicePoolHwmBytesGauge = REGISTRY.gauge(
+    "SeaweedFS_volumeServer_device_pool_hwm_bytes",
+    "high-watermark of bytes held by the EC device slab pool")
+DevicePoolHwmSecondsGauge = REGISTRY.gauge(
+    "SeaweedFS_volumeServer_device_pool_hwm_seconds",
+    "seconds the EC device slab pool spent at >=95% of its watermark")
+
+
 # -- process self-metrics (the reference's Go runtime collectors:
 # prometheus.NewGoCollector/NewProcessCollector) -----------------------------
 _PROCESS_START = time.time()
@@ -492,7 +538,7 @@ def start_metrics_server(host: str = "127.0.0.1",
     -metricsPort; stats/metrics.go StartMetricsServer).  Daemons whose
     main port serves a user namespace (filer paths, s3 buckets) cannot
     mount /metrics there without shadowing user data."""
-    from .. import tracing
+    from .. import profiling, tracing
     from ..rpc.http_rpc import RpcServer
     from ..util import faults
 
@@ -500,5 +546,6 @@ def start_metrics_server(host: str = "127.0.0.1",
     server.add("GET", "/metrics", metrics_handler)
     server.add("GET", "/debug/traces", tracing.traces_handler)
     faults.mount(server)
+    profiling.mount(server)
     server.start()
     return server

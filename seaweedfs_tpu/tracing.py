@@ -104,7 +104,7 @@ def _new_id() -> str:
 class Span:
     __slots__ = ("trace_id", "span_id", "parent_id", "name", "service",
                  "status", "tags", "start_ts", "duration", "sampled",
-                 "is_root", "_t0")
+                 "is_root", "route", "_t0")
 
     def __init__(self, trace_id: str, span_id: str,
                  parent_id: Optional[str], name: str, service: str,
@@ -115,6 +115,10 @@ class Span:
         self.parent_id = parent_id
         self.name = name
         self.service = service
+        # the enclosing RPC route ("GET /dir/assign"); dispatch spans
+        # are born with name==route, children inherit it in start() —
+        # this is what lets the profiler slice samples per route
+        self.route = name
         self.status = "ok"
         self.tags = tags
         self.start_ts = time.time()
@@ -158,6 +162,12 @@ class Span:
 
 _ctx = threading.local()
 
+# mirror of every thread's installed span, keyed by thread ident.  The
+# profiler samples OTHER threads' stacks from its own thread, where
+# threading.local is unreadable — swap()/restore() keep this map exact
+# (same writers, same order), and the sampler prunes dead idents.
+_thread_spans: dict = {}
+
 
 def current() -> Optional[Span]:
     return getattr(_ctx, "span", None)
@@ -169,11 +179,33 @@ def swap(span: Optional[Span]) -> Optional[Span]:
     dispatch loop)."""
     prev = getattr(_ctx, "span", None)
     _ctx.span = span
+    if span is not None:
+        _thread_spans[threading.get_ident()] = span
+    else:
+        _thread_spans.pop(threading.get_ident(), None)
     return prev
 
 
 def restore(prev: Optional[Span]):
     _ctx.span = prev
+    if prev is not None:
+        _thread_spans[threading.get_ident()] = prev
+    else:
+        _thread_spans.pop(threading.get_ident(), None)
+
+
+def span_for_thread(tid: int) -> Optional[Span]:
+    """The span installed on thread `tid`, if any (profiler cross-thread
+    read; racy by design — a stale span only mislabels one sample)."""
+    return _thread_spans.get(tid)
+
+
+def prune_thread_spans(live_tids):
+    """Drop mirror entries for threads that no longer exist (a pool
+    thread that died with a span installed would pin it forever)."""
+    dead = [tid for tid in list(_thread_spans) if tid not in live_tids]
+    for tid in dead:
+        _thread_spans.pop(tid, None)
 
 
 def start(name: str, service: str = "", parent: Optional[Span] = None,
@@ -184,8 +216,10 @@ def start(name: str, service: str = "", parent: Optional[Span] = None,
     if parent is None:
         parent = current()
     if parent is not None:
-        return Span(parent.trace_id, _new_id(), parent.span_id, name,
-                    service or parent.service, parent.sampled, False, tags)
+        sp = Span(parent.trace_id, _new_id(), parent.span_id, name,
+                  service or parent.service, parent.sampled, False, tags)
+        sp.route = parent.route  # children keep the request route
+        return sp
     return Span(_new_id(), _new_id(), None, name, service,
                 random.random() < sample_rate(), True, tags)
 

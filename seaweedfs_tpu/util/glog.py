@@ -90,6 +90,27 @@ def v(level: int) -> _VLog:
     return _VLog(False)
 
 
+_tracing = None
+
+
+def _trace_prefix() -> str:
+    """"[trace_id] " when the calling thread carries a SAMPLED span, so
+    slow-trace promotion (WEED_TRACE_SLOW_MS) cross-references straight
+    into daemon logs.  Unsampled spans stay silent: the id would never
+    appear in /debug/traces, so it is noise."""
+    global _tracing
+    if _tracing is None:
+        try:
+            from .. import tracing as _t
+        except ImportError:  # pragma: no cover - partial teardown
+            return ""
+        _tracing = _t
+    sp = _tracing.current()
+    if sp is not None and sp.sampled:
+        return "[%s] " % sp.trace_id
+    return ""
+
+
 def _emit(severity: int, message: str, depth: int = 3) -> None:
     if severity < _min_severity:
         return
@@ -98,10 +119,10 @@ def _emit(severity: int, message: str, depth: int = 3) -> None:
     frame = sys._getframe(depth)
     where = "%s:%d" % (os.path.basename(frame.f_code.co_filename),
                        frame.f_lineno)
-    line = "%s%02d%02d %02d:%02d:%02d.%06d %5d %s] %s\n" % (
+    line = "%s%02d%02d %02d:%02d:%02d.%06d %5d %s] %s%s\n" % (
         _SEVERITIES[severity], tm.tm_mon, tm.tm_mday, tm.tm_hour, tm.tm_min,
         tm.tm_sec, int((now % 1) * 1e6), threading.get_ident() % 100000,
-        where, message)
+        where, _trace_prefix(), message)
     with _lock:
         _out.write(line)
         _out.flush()

@@ -32,43 +32,43 @@ class SamplingProfiler:
 
     cProfile only traces the thread that enabled it — useless for a
     daemon whose work happens on HTTP worker threads while main sits in
-    signal.pause().  This samples sys._current_frames() instead, like
-    Go's pprof CPU profile, and dumps a flat self-sample report."""
+    signal.pause().  This delegates to profiling.StackSampler (the same
+    folded-stack engine behind /debug/pprof/profile), so the shutdown
+    dump is collapsed-stack text that feeds straight into flamegraph.pl
+    or speedscope — the old flat leaf-frame report carried no caller
+    context."""
 
     def __init__(self, interval: float = 0.005):
+        from .. import profiling
+
         self.interval = interval
-        self.samples: dict[tuple, int] = {}
-        self.total = 0
-        self._stop = threading.Event()
-        self._thread: Optional[threading.Thread] = None
+        self._sampler = profiling.StackSampler(hz=1.0 / interval)
+
+    @property
+    def total(self) -> int:
+        return self._sampler.total
+
+    @property
+    def samples(self) -> dict:
+        return self._sampler.samples
 
     def start(self):
-        self._thread = threading.Thread(target=self._loop, daemon=True)
-        self._thread.start()
-
-    def _loop(self):
-        me = threading.get_ident()
-        while not self._stop.wait(self.interval):
-            for tid, frame in sys._current_frames().items():
-                if tid == me:
-                    continue
-                key = (frame.f_code.co_filename, frame.f_lineno,
-                       frame.f_code.co_name)
-                self.samples[key] = self.samples.get(key, 0) + 1
-                self.total += 1
+        self._sampler.start()
 
     def stop_and_dump(self, path: str):
-        self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=2)
+        if not self._sampler.stop():
+            # the sampler thread is daemonized so it cannot hang exit,
+            # but a dump racing one last tick deserves a trace, not
+            # silence (the old implementation leaked the thread quietly)
+            from . import glog
+
+            glog.warningf("cpu profile sampler did not join in time; "
+                          "dump may miss the final tick")
         with open(path, "w") as f:
             f.write(f"# sampling cpu profile: {self.total} samples "
-                    f"@ {self.interval * 1000:.1f}ms\n")
-            ranked = sorted(self.samples.items(), key=lambda kv: -kv[1])
-            for (filename, lineno, func), count in ranked[:200]:
-                pct = 100.0 * count / max(1, self.total)
-                f.write(f"{count:8d} {pct:5.1f}%  "
-                        f"{func} ({filename}:{lineno})\n")
+                    f"@ {self.interval * 1000:.1f}ms "
+                    f"(collapsed stacks — flamegraph.pl/speedscope)\n")
+            f.write(self._sampler.folded())
 
 
 def on_interrupt(hook: Callable[[], None]):

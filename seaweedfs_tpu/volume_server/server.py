@@ -23,7 +23,7 @@ import threading
 import time
 from typing import Optional
 
-from .. import tracing
+from .. import profiling, tracing
 from ..rpc import policy
 from ..rpc.http_rpc import (Request, Response, RpcError, RpcServer, call,
                             call_stream, stream_file)
@@ -628,6 +628,7 @@ class VolumeServer:
         s.add("GET", "/metrics", self._h_metrics)
         s.add("GET", "/debug/traces", tracing.traces_handler)
         faults.mount(s)
+        profiling.mount(s)
         s.add("GET", "/ui", self._h_ui)
         s.default_route = self._handle_object
 

@@ -174,6 +174,22 @@ class TestExpositionFormat:
         assert fams["SeaweedFS_volumeServer_request_seconds"][
             "type"] == "histogram"
         assert fams["SeaweedFS_rpc_inflight_requests"]["type"] == "gauge"
+        # continuous-profiling families (profiling.py)
+        assert fams["SeaweedFS_profiler_overhead_ratio"]["type"] == "gauge"
+        assert fams["SeaweedFS_profiler_stacks"]["type"] == "gauge"
+        assert fams["SeaweedFS_profiler_route_samples_total"][
+            "type"] == "counter"
+        assert fams["SeaweedFS_volumeServer_ec_kernel_dispatch_ready"
+                    "_seconds"]["type"] == "histogram"
+        assert fams["SeaweedFS_volumeServer_ec_kernel_flops"][
+            "type"] == "gauge"
+        assert fams["SeaweedFS_volumeServer_device_pool_hwm_bytes"][
+            "type"] == "gauge"
+        assert fams["SeaweedFS_volumeServer_device_pool_hwm_seconds"][
+            "type"] == "gauge"
+        # the self-measured duty cycle is a sane ratio
+        overhead = fams["SeaweedFS_profiler_overhead_ratio"]["samples"]
+        assert len(overhead) == 1 and 0.0 <= overhead[0][2] < 1.0
         assert check_histograms(fams) >= 2
         # the hop histogram observed this test's calls
         hops = [s for s in fams["SeaweedFS_rpc_hop_seconds"]["samples"]

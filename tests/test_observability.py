@@ -148,6 +148,8 @@ class TestMetricsScrape:
             "SeaweedFS_process_threads",
             "SeaweedFS_process_gc_collections",
             "SeaweedFS_process_uptime_seconds",
+            "SeaweedFS_profiler_overhead_ratio",
+            "SeaweedFS_profiler_stacks",
         )
         per_daemon = {
             master.address: ("SeaweedFS_master_received_heartbeats",),
@@ -198,3 +200,12 @@ class TestGrafanaDashboard:
                 base = re.sub(r"_(bucket|sum|count)$", "", token)
                 assert base in registered, (
                     f"dashboard references unknown metric {token}")
+        # the Profiling row queries the continuous-profiling families
+        joined = "\n".join(exprs)
+        for token in (
+                "SeaweedFS_profiler_overhead_ratio",
+                "SeaweedFS_profiler_route_samples_total",
+                "SeaweedFS_volumeServer_ec_kernel_dispatch_ready"
+                "_seconds_bucket",
+                "SeaweedFS_volumeServer_device_pool_hwm_bytes"):
+            assert token in joined, f"no Profiling panel queries {token}"

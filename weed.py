@@ -989,6 +989,69 @@ def cmd_filer_remote_sync(args):
             _time.sleep(args.interval)
 
 
+def cmd_profile(args):
+    """Cluster flamegraph: fan /debug/pprof/profile out to every live
+    daemon (master topology + cluster membership discovery), merge the
+    folded stacks under per-daemon root frames, print/write collapsed-
+    stack text ready for flamegraph.pl or speedscope."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from seaweedfs_tpu import profiling
+    from seaweedfs_tpu.rpc.http_rpc import RpcError, call
+
+    master = args.master
+    targets: dict[str, str] = {f"master {master}": master}
+    try:
+        topo = call(master, "/dir/status")
+    except (RpcError, OSError) as e:
+        print(f"error: master {master} unreachable: {e}")
+        sys.exit(1)
+    for dc in topo.get("datacenters", []):
+        for rack in dc.get("racks", []):
+            for n in rack.get("nodes", []):
+                targets[f"volume {n['url']}"] = n["url"]
+    for kind in ("filer", "s3"):
+        try:
+            nodes = call(master, f"/cluster/nodes?type={kind}")
+        except (RpcError, OSError):
+            continue
+        for n in nodes.get("cluster_nodes", []):
+            targets[f"{kind} {n['address']}"] = n["address"]
+
+    seconds, hz = args.seconds, args.hz
+    path = f"/debug/pprof/profile?seconds={seconds}&hz={hz}"
+
+    def fetch(addr: str):
+        return call(addr, path, parse=False, timeout=seconds + 30.0)
+
+    profiles: dict[str, str] = {}
+    failed: list[str] = []
+    with ThreadPoolExecutor(max_workers=max(4, len(targets))) as pool:
+        futures = {name: pool.submit(fetch, addr)
+                   for name, addr in targets.items()}
+        for name, fut in futures.items():
+            try:
+                profiles[name.replace(";", ":")] = \
+                    fut.result().decode("utf-8", "replace")
+            except (RpcError, OSError) as e:
+                failed.append(f"{name}: {e}")
+
+    merged = profiling.merge_folded(profiles)
+    header = (f"# cluster cpu profile: {len(profiles)}/{len(targets)} "
+              f"daemons, {seconds}s @ {hz}Hz\n")
+    for f in failed:
+        header += f"# unreachable: {f}\n"
+    if args.o:
+        with open(args.o, "w") as f:
+            f.write(header + merged)
+        print(f"wrote {args.o} ({len(merged.splitlines())} stacks from "
+              f"{len(profiles)} daemons)")
+    else:
+        print(header + merged, end="")
+    if not profiles:
+        sys.exit(1)
+
+
 def cmd_scaffold(args):
     from seaweedfs_tpu.util.config import scaffold
 
@@ -1164,6 +1227,18 @@ def main(argv=None):
     p.add_argument("-c", default="",
                    help="run ;-separated commands and exit")
     p.set_defaults(fn=cmd_shell)
+
+    p = sub.add_parser("profile",
+                       help="cluster-wide CPU flamegraph: burst-profile "
+                            "every live daemon and merge the stacks")
+    p.add_argument("-master", default="127.0.0.1:9333")
+    p.add_argument("-seconds", type=float, default=5.0,
+                   help="burst duration per daemon")
+    p.add_argument("-hz", type=float, default=99.0,
+                   help="sampling rate during the burst")
+    p.add_argument("-o", default="",
+                   help="write collapsed stacks here (default: stdout)")
+    p.set_defaults(fn=cmd_profile)
 
     p = sub.add_parser("benchmark", help="write/read load benchmark")
     p.add_argument("-master", default="127.0.0.1:9333")
