@@ -388,6 +388,52 @@ def bench_e2e_device_scale(n_vols: int, vol_bytes: int, workdir: str,
     return n_vols * vol_bytes / GIB / dt, st
 
 
+def bench_maintenance_deep_scrub(n_vols: int, vol_bytes: int,
+                                 workdir: str,
+                                 link_capped: bool) -> tuple[float, dict]:
+    """Curator deep-scrub verification rate: spans from every volume's
+    14 shard files re-encoded through the persistent device parity step
+    and chained-CRC-checked against the .vif records, batching spans
+    ACROSS volumes into one compiled geometry (maintenance/deep_scrub).
+    Returns (GiB/s over shard bytes read, stage stats — backend, batch
+    counts, per-stage busy fractions, slab-pool counters)."""
+    from seaweedfs_tpu.maintenance.deep_scrub import (deep_scrub,
+                                                      local_target)
+    from seaweedfs_tpu.parallel.batched_encode import encode_volumes
+    from seaweedfs_tpu.storage.erasure_coding.encoder import \
+        save_volume_info
+
+    mesh = None
+    if link_capped:
+        import jax
+
+        from seaweedfs_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(jax.devices("cpu"))
+    bases = []
+    for i in range(n_vols):
+        base = os.path.join(workdir, f"scrubvol{i}")
+        _write_volume(base, vol_bytes, seed=900 + i)
+        bases.append(base)
+    crc_map = encode_volumes(bases, mesh=mesh)
+    for base in bases:
+        save_volume_info(base, version=3,
+                         extra={"shard_crc32c": crc_map[base]})
+    # warm at the measured geometry: the parity step compiles per
+    # (k, batch) shape, and batch size follows the unit count
+    deep_scrub([local_target(b, i + 1) for i, b in enumerate(bases)],
+               mesh=mesh)
+    targets = [local_target(b, i + 1) for i, b in enumerate(bases)]
+    st: dict = {}
+    t0 = time.perf_counter()
+    out = deep_scrub(targets, mesh=mesh, stage_stats=st)
+    dt = time.perf_counter() - t0
+    _cleanup(workdir, "scrubvol")
+    if out["corrupt"]:
+        raise RuntimeError(f"scrub flagged fresh volumes: {out}")
+    return out["scrubbed_bytes"] / GIB / dt, st
+
+
 def bench_cpu_e2e(vol_bytes: int, workdir: str, reps: int = 2) -> float:
     """The reference architecture end-to-end: synchronous per-row host loop
     with the AVX2 codec (ec_encoder.go:194-231 semantics)."""
@@ -1029,6 +1075,8 @@ def main():
     default_stages: dict = {}
     scale_stages: dict = {}
     dev_scale_stages: dict = {}
+    maint_scrub_rate = 0.0
+    maint_scrub_stages: dict = {}
     workdir = _pick_workdir(
         max((n_dev + 1) * vol_bytes * 3, scale_vols * scale_vol_bytes * 3))
     # folded-stack sampler across the e2e encode phases: the bench JSON
@@ -1057,6 +1105,13 @@ def main():
             scale_vols, 4 << 20, workdir, link_capped)
     except Exception as e:
         print(f"note: device scale e2e failed: {e}", file=sys.stderr)
+    try:
+        maint_scrub_rate, maint_scrub_stages = \
+            bench_maintenance_deep_scrub(
+                8 if on_tpu else 4, 16 << 20, workdir, link_capped)
+    except Exception as e:
+        print(f"note: maintenance deep scrub failed: {e}",
+              file=sys.stderr)
     finally:
         e2e_sampler.stop()
         shutil.rmtree(workdir, ignore_errors=True)
@@ -1136,6 +1191,10 @@ def main():
         "e2e_device_dispatch_100vol_gibps": round(dev_scale_rate, 3),
         "e2e_device_dispatch_backend": dev_scale_stages.get("backend", ""),
         "e2e_device_dispatch_stages": dev_scale_stages,
+        "maintenance_deep_scrub_gibps": round(maint_scrub_rate, 3),
+        "maintenance_deep_scrub_backend":
+            maint_scrub_stages.get("backend", ""),
+        "maintenance_deep_scrub_stages": maint_scrub_stages,
         "e2e_profile_top": e2e_profile_top,
         "workdir": dict(_WORKDIR_INFO),
         "scale_total_gib": round(scale_vols * scale_vol_bytes / GIB, 2),

@@ -1,0 +1,200 @@
+"""The curator: leader-resident continuous maintenance scheduler.
+
+Runs next to the master's topology: a detector pass every
+WEED_MAINT_INTERVAL seconds (leader only) snapshots heartbeat state,
+turns anomalies into typed jobs, and feeds the persistent deduped
+priority queue.  Volume servers lease jobs over /maintenance/lease,
+renew while executing, and report complete/fail; a worker that dies
+mid-job simply stops renewing and the lease expiry requeues the work.
+
+The curator also owns the last-deep-scrub clock per EC volume (the
+heartbeats carry no scrub timestamps) and converts deep-scrub findings
+into rebuild jobs — detect once, repair automatically."""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Optional
+
+from ..util import glog
+from . import detectors
+from .jobs import JOB_TYPES, TYPE_DEEP_SCRUB, TYPE_EC_REBUILD
+from .queue import JobQueue
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+class Curator:
+    def __init__(self, master, journal_dir: str = "",
+                 interval: Optional[float] = None):
+        self.master = master
+        self._interval = interval
+        journal = (os.path.join(journal_dir, "maintenance.jlog")
+                   if journal_dir else "")
+        self.queue = JobQueue(journal_path=journal)
+        self.last_scrub: dict[int, float] = {}
+        self._recent: dict[tuple, float] = {}  # (type, vid) -> done at
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.enabled = os.environ.get("WEED_MAINT", "1") != "0"
+        self.scans = 0
+        self.enqueued = 0
+        self.now = time.time  # fake-clock seam
+
+    @property
+    def interval(self) -> float:
+        if self._interval is not None:
+            return self._interval
+        return _env_float("WEED_MAINT_INTERVAL", 30.0)
+
+    def cooldown(self) -> float:
+        return _env_float("WEED_MAINT_COOLDOWN", 60.0)
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self):
+        if not self.enabled or self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._loop, name="curator", daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def _loop(self):
+        while not self._stop.wait(self.interval):
+            if not self.master.raft.is_leader:
+                continue
+            try:
+                self.tick()
+            except Exception as e:  # detector bugs must not kill the loop
+                glog.warning(f"curator tick failed: {e}")
+
+    # -- one detector pass ---------------------------------------------------
+    def tick(self) -> list[str]:
+        """Expire dead-worker leases, scan topology, enqueue.  Returns
+        the ids enqueued this pass (for /maintenance/run)."""
+        self.queue.expire_leases()
+        snap = detectors.snapshot(self.master.topo)
+        now = self.now()
+        vacuum_on = getattr(self.master, "auto_vacuum_interval", 0) > 0
+        specs = detectors.scan(
+            snap, now=now, last_scrub=self.last_scrub,
+            garbage_threshold=getattr(self.master, "garbage_threshold",
+                                      0.3),
+            vacuum_enabled=vacuum_on)
+        self.scans += 1
+        ids = []
+        cooldown = self.cooldown()
+        for spec in specs:
+            done_at = self._recent.get((spec["type"], spec["volume"]), 0)
+            if now - done_at < cooldown:
+                continue  # just repaired; wait for heartbeats to settle
+            jid = self.queue.enqueue(spec["type"], spec["volume"],
+                                     spec["collection"], spec["params"])
+            if jid is not None:
+                ids.append(jid)
+                self.enqueued += 1
+        return ids
+
+    # -- completion hook -----------------------------------------------------
+    def on_complete(self, job, report: Optional[dict]):
+        self._recent[(job.type, job.volume)] = self.now()
+        if job.type == TYPE_DEEP_SCRUB:
+            self.last_scrub[job.volume] = self.now()
+            # scrub findings close the loop: corruption becomes a
+            # rebuild job right now, not on the next detector pass
+            if report and (report.get("corrupt")
+                           or report.get("parity_mismatch")
+                           or report.get("missing")):
+                self.queue.enqueue(
+                    TYPE_EC_REBUILD, job.volume, job.collection,
+                    {"from": "deep.scrub",
+                     "corrupt": report.get("corrupt", []),
+                     "missing": report.get("missing", [])})
+
+    # -- admin surface -------------------------------------------------------
+    def status(self) -> dict:
+        return {"enabled": self.enabled,
+                "leader": bool(self.master.raft.is_leader),
+                "interval": self.interval,
+                "scans": self.scans, "enqueued": self.enqueued,
+                "queue": self.queue.stats(),
+                "last_scrub": {str(k): round(v, 3)
+                               for k, v in self.last_scrub.items()}}
+
+    def mount(self, server, guard):
+        """Register /maintenance/* on the master's RpcServer.  Worker
+        endpoints (lease/renew/complete/fail) are open like
+        /api/heartbeat; operator endpoints go through the IP guard."""
+        s = server
+
+        def status(req):
+            return self.status()
+
+        def queue_view(req):
+            return {"jobs": self.queue.jobs(),
+                    "history": list(self.queue.history)[-50:]}
+
+        def lease(req):
+            d = req.json()
+            types = d.get("types") or list(JOB_TYPES)
+            jobs = self.queue.lease(d.get("worker", ""), types,
+                                    int(d.get("limit", 1)),
+                                    ec_volumes=d.get("ec_volumes"))
+            return {"jobs": jobs,
+                    "lease_seconds": self.queue.lease_seconds}
+
+        def renew(req):
+            d = req.json()
+            return {"ok": self.queue.renew(d.get("id", ""),
+                                           d.get("worker", ""))}
+
+        def complete(req):
+            d = req.json()
+            job = self.queue.complete(d.get("id", ""),
+                                      d.get("worker", ""),
+                                      d.get("outcome", "ok"))
+            if job is not None:
+                self.on_complete(job, d.get("report"))
+            return {"ok": job is not None}
+
+        def fail(req):
+            d = req.json()
+            job = self.queue.fail(d.get("id", ""), d.get("worker", ""),
+                                  d.get("error", ""))
+            return {"ok": job is not None,
+                    "state": job.state if job else "lost"}
+
+        def pause(req):
+            d = req.json()
+            self.queue.paused = bool(d.get("paused", True))
+            return {"paused": self.queue.paused}
+
+        def run(req):
+            d = req.json()
+            if d.get("type"):  # enqueue one explicit job
+                jid = self.queue.enqueue(
+                    d["type"], int(d.get("volume", 0)),
+                    d.get("collection", ""), d.get("params") or {})
+                return {"enqueued": [jid] if jid else []}
+            return {"enqueued": self.tick()}
+
+        s.add("GET", "/maintenance/status", status)
+        s.add("GET", "/maintenance/queue", guard(queue_view))
+        s.add("POST", "/maintenance/lease", lease)
+        s.add("POST", "/maintenance/renew", renew)
+        s.add("POST", "/maintenance/complete", complete)
+        s.add("POST", "/maintenance/fail", fail)
+        s.add("POST", "/maintenance/pause", guard(pause))
+        s.add("POST", "/maintenance/run", guard(run))

@@ -1,0 +1,126 @@
+"""Curator detectors: topology state -> maintenance job specs.
+
+`snapshot()` flattens the leader's live Topology (under its lock) into
+a plain dict; `scan()` is a pure function over that dict, so detector
+behaviour is unit-testable with fabricated snapshots and the detector
+pass itself never blocks on the topology lock or the network (the old
+auto-vacuum synchronously called every volume server from the reap
+loop — the curator only *reads heartbeat state* here and defers the
+actual RPCs to the worker executing the job)."""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from ..storage.erasure_coding import TOTAL_SHARDS_COUNT
+from .jobs import (TYPE_BALANCE, TYPE_DEEP_SCRUB, TYPE_EC_REBUILD,
+                   TYPE_FIX_REPLICATION, TYPE_VACUUM)
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def snapshot(topo) -> dict:
+    """Flatten a master Topology into the dict `scan()` consumes."""
+    volumes: dict[int, dict] = {}
+    node_ec: dict[str, int] = {}
+    with topo.lock:
+        for dc in topo.dcs.values():
+            for rack in dc.racks.values():
+                for node in rack.nodes.values():
+                    node_ec[node.url] = sum(
+                        b.count() for b in node.ec_shards.values())
+                    for v in node.volumes.values():
+                        agg = volumes.setdefault(v.id, {
+                            "id": v.id, "collection": v.collection,
+                            "size": 0, "deleted_bytes": 0,
+                            "replication": v.replica_placement,
+                            "replicas": 0, "read_only": False})
+                        agg["replicas"] += 1
+                        agg["size"] = max(agg["size"], v.size)
+                        agg["deleted_bytes"] = max(
+                            agg["deleted_bytes"], v.deleted_byte_count)
+                        agg["read_only"] = (agg["read_only"]
+                                            or v.read_only)
+        ec = [{"id": vid,
+               "collection": topo.ec_collections.get(vid, ""),
+               "shards": sorted(sid for sid, nodes in shard_map.items()
+                                if nodes)}
+              for vid, shard_map in topo.ec_shard_map.items()]
+    return {"volumes": sorted(volumes.values(), key=lambda v: v["id"]),
+            "ec": sorted(ec, key=lambda e: e["id"]),
+            "node_ec_shards": node_ec}
+
+
+def scan(snap: dict, now: float, last_scrub: dict,
+         garbage_threshold: float = 0.3,
+         scrub_interval: Optional[float] = None,
+         balance_skew: Optional[int] = None,
+         vacuum_enabled: bool = True) -> list[dict]:
+    """All detectors over one snapshot -> job specs
+    ({type, volume, collection, params}), urgent first."""
+    if scrub_interval is None:
+        scrub_interval = _env_float("WEED_MAINT_SCRUB_INTERVAL", 86400.0)
+    if balance_skew is None:
+        balance_skew = int(_env_float("WEED_MAINT_BALANCE_SKEW", 4))
+    specs: list[dict] = []
+
+    # missing-or-lost EC shards -> rebuild (most urgent: every missing
+    # shard is erasure-budget already spent)
+    for e in snap.get("ec", []):
+        have = set(e["shards"])
+        if have and len(have) < TOTAL_SHARDS_COUNT:
+            missing = sorted(set(range(TOTAL_SHARDS_COUNT)) - have)
+            specs.append({"type": TYPE_EC_REBUILD, "volume": e["id"],
+                          "collection": e["collection"],
+                          "params": {"missing": missing}})
+
+    # replica count below placement -> one cluster-wide fix pass
+    from ..storage.super_block import ReplicaPlacement
+
+    under = []
+    for v in snap.get("volumes", []):
+        want = ReplicaPlacement.from_byte(v.get("replication", 0) or 0) \
+            .copy_count()
+        if v["replicas"] < want:
+            under.append(v["id"])
+    if under:
+        specs.append({"type": TYPE_FIX_REPLICATION, "volume": 0,
+                      "collection": "",
+                      "params": {"volumes": sorted(under)}})
+
+    # garbage ratio over threshold -> vacuum (replaces the master's
+    # in-reap-loop auto-vacuum pass)
+    if vacuum_enabled:
+        for v in snap.get("volumes", []):
+            size = v.get("size", 0)
+            if size <= 0 or v.get("read_only"):
+                continue
+            ratio = v.get("deleted_bytes", 0) / float(size)
+            if ratio > garbage_threshold:
+                specs.append({"type": TYPE_VACUUM, "volume": v["id"],
+                              "collection": v["collection"],
+                              "params": {"garbage_ratio":
+                                         round(ratio, 4)}})
+
+    # stale scrub -> deep scrub (never-scrubbed volumes are due
+    # immediately; the queue's dedupe + the pacer bound the sweep)
+    for e in snap.get("ec", []):
+        if len(e["shards"]) < TOTAL_SHARDS_COUNT:
+            continue  # rebuild first; scrub after it converges
+        if now - last_scrub.get(e["id"], 0.0) >= scrub_interval:
+            specs.append({"type": TYPE_DEEP_SCRUB, "volume": e["id"],
+                          "collection": e["collection"], "params": {}})
+
+    # EC placement skew -> balance
+    counts = list(snap.get("node_ec_shards", {}).values())
+    if len(counts) >= 2 and max(counts) - min(counts) > balance_skew:
+        specs.append({"type": TYPE_BALANCE, "volume": 0,
+                      "collection": "",
+                      "params": {"skew": max(counts) - min(counts)}})
+    return specs

@@ -42,7 +42,8 @@ class MasterServer:
                  raft_dir: str = "",
                  raft_election_timeout: float = 0.8,
                  auto_vacuum_interval: float = 15 * 60.0,
-                 enable_native_assign: bool = False):
+                 enable_native_assign: bool = False,
+                 maintenance_interval: Optional[float] = None):
         self.topo = Topology(
             volume_size_limit=volume_size_limit_mb * 1024 * 1024,
             pulse_seconds=pulse_seconds)
@@ -68,11 +69,19 @@ class MasterServer:
         self._members: dict[tuple[str, str], dict] = {}
         self._admin_locks: dict[str, dict] = {}
         self._admin_locks_mutex = threading.Lock()
+        self.auto_vacuum_interval = auto_vacuum_interval
+        # leader-resident maintenance curator: detectors + the
+        # persistent job queue the volume-server workers pull from
+        # (the journal lives next to the raft state so a failed-over
+        # leader replays the same pending set)
+        from ..maintenance.curator import Curator
+
+        self.curator = Curator(self, journal_dir=raft_dir,
+                               interval=maintenance_interval)
         self._register_routes()
         self._reaper: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._grow_lock = threading.Lock()
-        self.auto_vacuum_interval = auto_vacuum_interval
         self.enable_native_assign = enable_native_assign
         self._native_assign = False
         self._native_assign_owner = False
@@ -87,11 +96,13 @@ class MasterServer:
         self.raft.start()
         self._reaper = threading.Thread(target=self._reap_loop, daemon=True)
         self._reaper.start()
+        self.curator.start()
         if self.enable_native_assign:
             self._start_native_assign()
 
     def stop(self):
         self._stop.set()
+        self.curator.stop()
         self.raft.stop()
         with self._change_cond:
             self._change_cond.notify_all()
@@ -204,18 +215,16 @@ class MasterServer:
         return d
 
     def _reap_loop(self):
-        # periodic garbage vacuum rides the same loop (topology_vacuum.go:
-        # the reference leader vacuums on a 15-minute cadence)
-        next_vacuum = time.monotonic() + self.auto_vacuum_interval
+        # Nothing but liveness reaping runs here.  The periodic garbage
+        # vacuum used to ride this loop, synchronously calling every
+        # volume server's check/compact/commit — blocking the leader's
+        # dead-node reaping (and heartbeat-driven liveness) for the
+        # duration.  The curator's garbage-ratio detector now reads the
+        # heartbeat state the nodes already report and routes vacuums
+        # through the maintenance queue, where a volume-server worker
+        # burns its own thread on the holder RPCs.
         while not self._stop.wait(self.topo.pulse_seconds):
             self.topo.reap_dead_nodes()
-            if self.auto_vacuum_interval > 0 and self.raft.is_leader \
-                    and time.monotonic() >= next_vacuum:
-                next_vacuum = time.monotonic() + self.auto_vacuum_interval
-                try:
-                    self._vacuum_pass(self.garbage_threshold)
-                except Exception:
-                    pass  # individual node errors already skipped inside
 
     # -- routes --------------------------------------------------------------
     def _guarded(self, fn):
@@ -263,6 +272,9 @@ class MasterServer:
         s.add("POST", "/admin/lock", g(self._handle_admin_lock))
         s.add("POST", "/admin/unlock", g(self._handle_admin_unlock))
         s.add("GET", "/ui", self._handle_ui)
+        # maintenance curator: status/queue views, worker lease
+        # protocol, pause/run controls
+        self.curator.mount(s, g)
 
     def _handle_ui(self, req):
         """Status page (server/master_ui/master.html)."""

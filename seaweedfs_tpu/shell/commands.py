@@ -11,11 +11,27 @@ applyBalancing=false (shell/command_ec_test.go).
 
 from __future__ import annotations
 
+import concurrent.futures as cf
+import threading
 from dataclasses import dataclass, field
 from typing import Optional
 
 from ..rpc.http_rpc import RpcError, call
 from ..storage.erasure_coding import TOTAL_SHARDS_COUNT
+
+# shared fan-out pool for holder-parallel commands (ec.scrub): sized for
+# I/O-bound RPC waits, lazily built so import stays thread-free
+_fanout_pool: Optional[cf.ThreadPoolExecutor] = None
+_fanout_lock = threading.Lock()
+
+
+def _fanout() -> cf.ThreadPoolExecutor:
+    global _fanout_pool
+    with _fanout_lock:
+        if _fanout_pool is None:
+            _fanout_pool = cf.ThreadPoolExecutor(
+                max_workers=16, thread_name_prefix="shell-fanout")
+        return _fanout_pool
 
 
 @dataclass
@@ -466,12 +482,16 @@ def ec_scrub(env: CommandEnv, vid: Optional[int] = None,
         corrupt: list[tuple[str, int]] = []
         errors: list[dict] = []
         clean_union: set[int] = set()
-        for url in sorted(holders):
+        # every holder walks its own disks — fan the scrub RPCs out in
+        # parallel instead of serializing 600s-budget calls per holder
+        futs = {url: _fanout().submit(
+                    call, url, "/admin/ec/scrub",
+                    {"volume": v, "collection": collection}, timeout=600)
+                for url in sorted(holders)}
+        for url in sorted(futs):
             try:
-                r = call(url, "/admin/ec/scrub",
-                         {"volume": v, "collection": collection},
-                         timeout=600)
-            except RpcError as e:
+                r = futs[url].result()
+            except (RpcError, OSError) as e:
                 errors.append({"holder": url, "error": str(e)})
                 continue
             clean_union.update(r.get("clean", []))

@@ -458,6 +458,27 @@ DevicePoolHwmBytesGauge = REGISTRY.gauge(
 DevicePoolHwmSecondsGauge = REGISTRY.gauge(
     "SeaweedFS_volumeServer_device_pool_hwm_seconds",
     "seconds the EC device slab pool spent at >=95% of its watermark")
+# maintenance curator (seaweedfs_tpu/maintenance): the leader's job
+# queue, the workers' execution outcomes, and the byte pacer that
+# keeps background scrubs out of the foreground's way
+MaintQueueJobsGauge = REGISTRY.gauge(
+    "SeaweedFS_master_maintenance_queue_jobs",
+    "live maintenance jobs in the curator queue, by state",
+    ("state",))
+MaintJobsCounter = REGISTRY.counter(
+    "SeaweedFS_master_maintenance_jobs_total",
+    "maintenance jobs finished, by type and outcome",
+    ("type", "outcome"))
+MaintJobSecondsHistogram = REGISTRY.histogram(
+    "SeaweedFS_volumeServer_maintenance_job_seconds",
+    "maintenance job execution latency on the worker, by type",
+    ("type",))
+MaintScrubbedBytesCounter = REGISTRY.counter(
+    "SeaweedFS_volumeServer_maintenance_scrubbed_bytes_total",
+    "shard bytes streamed through deep scrub")
+MaintPacerRateGauge = REGISTRY.gauge(
+    "SeaweedFS_volumeServer_maintenance_pacer_bytes_per_second",
+    "effective maintenance byte rate after foreground-load backoff")
 
 
 # -- process self-metrics (the reference's Go runtime collectors:

@@ -126,21 +126,33 @@ def compact_offline(directory: str, collection: str, vid: int) -> dict:
             "reclaimed": before - after}
 
 
-def shard_file_crc32c(path: str) -> int:
-    """Whole-file CRC32C, streamed in 4 MiB chunks."""
+def shard_file_crc32c(path: str, chunk_size: int = 4 << 20,
+                      throttle: Optional[Callable[[int], None]] = None
+                      ) -> int:
+    """Whole-file CRC32C, streamed in bounded chunks.  `throttle` is
+    called with each chunk's byte count *before* the bytes are hashed —
+    the curator's BytePacer plugs in here so a background scrub never
+    streams a shard file faster than the paced rate (an unthrottled
+    whole-file read stalls foreground I/O on the same spindle)."""
     from ..ops.crc32c import crc32c
 
+    chunk_size = max(64 << 10, int(chunk_size))
     crc = 0
     with open(path, "rb") as f:
         while True:
-            chunk = f.read(4 << 20)
+            chunk = f.read(chunk_size)
             if not chunk:
                 break
+            if throttle is not None:
+                throttle(len(chunk))
             crc = crc32c(chunk, crc)
     return crc
 
 
-def verify_shard_files(base: str, stored) -> tuple[list, list, list]:
+def verify_shard_files(base: str, stored,
+                       chunk_size: int = 4 << 20,
+                       throttle: Optional[Callable[[int], None]] = None
+                       ) -> tuple[list, list, list]:
     """Classify the .ecNN files at `base` against the recorded CRCs:
     -> (clean, corrupt, absent) shard-id lists.  Shared by the offline
     `weed scrub` and the volume server's /admin/ec/scrub handler (where
@@ -156,7 +168,8 @@ def verify_shard_files(base: str, stored) -> tuple[list, list, list]:
         path = base + to_ext(sid)
         if not os.path.exists(path):
             absent.append(sid)
-        elif shard_file_crc32c(path) == stored[sid]:
+        elif shard_file_crc32c(path, chunk_size=chunk_size,
+                               throttle=throttle) == stored[sid]:
             clean.append(sid)
         else:
             corrupt.append(sid)

@@ -249,6 +249,11 @@ class VolumeServer:
         for loc in self.store.locations:
             for vid, ev in loc.ec_volumes.items():
                 ev.remote_reader = self._make_remote_reader(vid)
+        # maintenance worker: pulls curator jobs from the master and
+        # executes them under the foreground-load-aware byte pacer
+        from ..maintenance.worker import MaintenanceWorker
+
+        self.maintenance_worker = MaintenanceWorker(self)
 
     @property
     def address(self) -> str:
@@ -262,9 +267,11 @@ class VolumeServer:
         self._heartbeat_thread = threading.Thread(
             target=self._heartbeat_loop, daemon=True)
         self._heartbeat_thread.start()
+        self.maintenance_worker.start()
 
     def stop(self):
         self._stop.set()
+        self.maintenance_worker.stop()
         if getattr(self, "_native_owner", False) or \
                 getattr(self, "_native_jwt_owner", False) or \
                 getattr(self, "_native_listener_owner", False):

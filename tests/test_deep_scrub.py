@@ -1,0 +1,155 @@
+"""Device-batched deep scrub vs the host verifier.
+
+The device path re-encodes data-shard spans through the persistent
+parity step and chains CRCs; the host path walks shard files (and
+needles) with crc32c.  Both must agree on every verdict, and the
+device path must batch spans from MANY volumes into one compiled
+geometry."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.maintenance.deep_scrub import (ScrubTarget,
+                                                  deep_scrub,
+                                                  deep_scrub_host,
+                                                  local_target)
+from seaweedfs_tpu.storage.erasure_coding import TOTAL_SHARDS_COUNT
+from seaweedfs_tpu.storage.erasure_coding.encoder import (
+    save_volume_info, write_ec_files)
+from seaweedfs_tpu.storage.tools import shard_file_crc32c
+
+
+def _make_volume(directory, vid, n_bytes, seed=0):
+    base = os.path.join(str(directory), str(vid))
+    rng = np.random.default_rng(seed)
+    with open(base + ".dat", "wb") as f:
+        f.write(rng.integers(0, 256, n_bytes, dtype=np.uint8).tobytes())
+    crcs = write_ec_files(base, batched=True)
+    save_volume_info(base, version=3, extra={"shard_crc32c": crcs})
+    return base
+
+
+def _flip(path, offset, mask=0xFF):
+    with open(path, "r+b") as f:
+        f.seek(offset)
+        b = f.read(1)
+        f.seek(offset)
+        f.write(bytes([b[0] ^ mask]))
+
+
+class TestDeviceVsHost:
+    def test_clean_volumes_verify_on_both_paths(self, tmp_path):
+        base = _make_volume(tmp_path, 1, (2 << 20) + 999, seed=1)
+        out = deep_scrub([local_target(base, 1)])
+        v = out["volumes"][0]
+        assert v["ok"] and v["recomputed"]
+        assert out["corrupt"] == []
+        host = deep_scrub_host(str(tmp_path), "", 1, needle_walk=False)
+        assert host["corrupt"] == [] and host["missing"] == []
+
+    def test_both_paths_flag_the_same_corrupt_shards(self, tmp_path):
+        base = _make_volume(tmp_path, 1, (2 << 20) + 1234, seed=2)
+        _flip(base + ".ec04", 4096)   # data shard
+        _flip(base + ".ec11", 100)    # parity shard
+        out = deep_scrub([local_target(base, 1)])
+        device_corrupt = out["volumes"][0]["corrupt"]
+        host = deep_scrub_host(str(tmp_path), "", 1, needle_walk=False)
+        assert device_corrupt == host["corrupt"] == [4, 11]
+        # data corruption explains everything: no parity_mismatch claim
+        assert out["volumes"][0]["parity_mismatch"] == []
+
+    def test_missing_shard_reported_not_crashed(self, tmp_path):
+        base = _make_volume(tmp_path, 1, 1 << 20, seed=3)
+        os.unlink(base + ".ec06")
+        out = deep_scrub([local_target(base, 1)])
+        v = out["volumes"][0]
+        assert v["missing"] == [6]
+        # a missing DATA shard kills the recompute but file CRCs of the
+        # present shards are still checked
+        assert not v["recomputed"] and v["corrupt"] == []
+        host = deep_scrub_host(str(tmp_path), "", 1, needle_walk=False)
+        assert host["missing"] == [6]
+
+    def test_parity_record_drift_caught_only_by_recompute(self, tmp_path):
+        """Corrupt a parity file AND launder its file CRC into the .vif:
+        plain per-file verification now passes, but re-encoding the data
+        through the device step exposes the stored parity as wrong —
+        the check that justifies the deep scrub."""
+        base = _make_volume(tmp_path, 1, (1 << 20) + 77, seed=4)
+        _flip(base + ".ec12", 2000)
+        with open(base + ".vif") as f:
+            info = json.load(f)
+        info["shard_crc32c"][12] = shard_file_crc32c(base + ".ec12")
+        with open(base + ".vif", "w") as f:
+            json.dump(info, f)
+        # host file-CRC sweep is blind to it
+        host = deep_scrub_host(str(tmp_path), "", 1, needle_walk=False)
+        assert host["corrupt"] == [] and host["ok"]
+        # the device recompute is not
+        out = deep_scrub([local_target(base, 1)])
+        v = out["volumes"][0]
+        assert v["parity_mismatch"] == [12]
+        assert not v["ok"]
+        assert out["corrupt"] == [{"volume": 1, "shards": [12]}]
+
+
+class TestCrossVolumeBatching:
+    def test_many_volumes_share_one_geometry(self, tmp_path):
+        bases = [_make_volume(tmp_path, i + 1, (1 << 20) + i * 333,
+                              seed=10 + i) for i in range(5)]
+        _flip(bases[2] + ".ec01", 50)
+        stats = {}
+        out = deep_scrub(
+            [local_target(b, i + 1) for i, b in enumerate(bases)],
+            stage_stats=stats)
+        assert stats["backend"] == "device-pooled-swar"
+        # one compiled k-shape serves every volume's spans
+        assert stats["k_shapes"] == [10]
+        assert stats["batch_units"] > 1  # spans DID share dispatches
+        assert {c["volume"]: c["shards"] for c in out["corrupt"]} \
+            == {3: [1]}
+        for v in out["volumes"]:
+            assert v["recomputed"]
+        # stage accounting covers the wall clock it claims
+        assert stats["wall"] > 0
+        for k in ("read_frac", "dispatch_frac", "encode_crc_frac"):
+            assert 0.0 <= stats[k] <= 1.0
+        assert stats["pool"]["allocs"] >= 0
+
+    def test_throttle_sees_every_span_byte(self, tmp_path):
+        base = _make_volume(tmp_path, 1, 1 << 20, seed=20)
+        seen = []
+        out = deep_scrub([local_target(base, 1)],
+                         throttle=seen.append)
+        # every byte of all 14 shard files went through the pacer hook
+        total_shard_bytes = sum(
+            os.path.getsize(base + f".ec{sid:02d}")
+            for sid in range(TOTAL_SHARDS_COUNT))
+        assert sum(seen) == total_shard_bytes
+        assert out["scrubbed_bytes"] == total_shard_bytes
+
+    def test_unreadable_reader_degrades_to_verdict(self, tmp_path):
+        base = _make_volume(tmp_path, 1, 1 << 20, seed=21)
+        good = local_target(base, 1)
+
+        calls = {"n": 0}
+
+        def flaky_reader(sid, off, size):
+            if sid == 3:
+                raise OSError("disk went away")
+            return good.reader(sid, off, size)
+
+        t = ScrubTarget(volume=1, collection="",
+                        stored=list(good.stored),
+                        sizes=list(good.sizes), reader=flaky_reader)
+        out = deep_scrub([t])
+        v = out["volumes"][0]
+        assert v["unreadable"] == [3]
+        # an unreadable DATA shard invalidates the recompute chain but
+        # is not misreported as corrupt
+        assert not v["recomputed"]
+        assert 3 not in v["corrupt"]
+        assert not v["ok"]

@@ -1,0 +1,700 @@
+"""Curator maintenance subsystem: queue, detectors, pacer, curator,
+worker — plus a live-cluster detect→enqueue→lease→repair lifecycle and
+a chaos-marked convergence drill (corrupt shard + dead holder under
+fault injection, repaired with no operator in the loop)."""
+
+import glob
+import json
+import os
+import time
+
+import pytest
+
+from seaweedfs_tpu.maintenance import detectors
+from seaweedfs_tpu.maintenance.jobs import (TYPE_BALANCE,
+                                            TYPE_DEEP_SCRUB,
+                                            TYPE_EC_REBUILD,
+                                            TYPE_FIX_REPLICATION,
+                                            TYPE_VACUUM)
+from seaweedfs_tpu.maintenance.pacer import BytePacer
+from seaweedfs_tpu.maintenance.queue import JobQueue
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# -- job queue ---------------------------------------------------------------
+
+
+class TestJobQueue:
+    def test_enqueue_dedupes_and_orders_by_priority(self):
+        q = JobQueue()
+        v = q.enqueue(TYPE_VACUUM, 7, "", {"garbage_ratio": 0.5})
+        r = q.enqueue(TYPE_EC_REBUILD, 9, "", {"missing": [3]})
+        assert v and r
+        # same (type, volume, collection) while live -> deduped
+        assert q.enqueue(TYPE_VACUUM, 7, "", {}) is None
+        # different volume is a different job
+        assert q.enqueue(TYPE_VACUUM, 8, "", {}) is not None
+        # rebuild outranks vacuum regardless of enqueue order
+        leased = q.lease("w1", limit=10)
+        assert [j["type"] for j in leased][:2] == [TYPE_EC_REBUILD,
+                                                  TYPE_VACUUM]
+
+    def test_lease_renew_complete_lifecycle(self):
+        q = JobQueue(lease_seconds=60)
+        clock = FakeClock()
+        q.now = clock
+        jid = q.enqueue(TYPE_VACUUM, 1, "")
+        (job,) = q.lease("w1", [TYPE_VACUUM])
+        assert job["id"] == jid and job["state"] == "leased"
+        assert job["attempts"] == 1
+        # a second worker sees nothing while the lease is held
+        assert q.lease("w2", [TYPE_VACUUM]) == []
+        clock.advance(50)
+        assert q.renew(jid, "w1")
+        clock.advance(50)  # renewed at t+50, so still inside the lease
+        assert q.expire_leases() == []
+        # a stale worker cannot complete someone else's lease
+        assert q.complete(jid, "w2") is None
+        done = q.complete(jid, "w1", "ok")
+        assert done is not None and done.outcome == "ok"
+        assert q.stats()["live"] == 0
+        # once finished, the same key can be enqueued again
+        assert q.enqueue(TYPE_VACUUM, 1, "") is not None
+
+    def test_lease_expiry_requeues_dead_workers_job(self):
+        q = JobQueue(lease_seconds=60)
+        clock = FakeClock()
+        q.now = clock
+        jid = q.enqueue(TYPE_DEEP_SCRUB, 4, "")
+        q.lease("w1", ec_volumes=[4])
+        clock.advance(61)  # worker died: no renewals
+        assert q.expire_leases() == [jid]
+        # requeued and leasable by another worker, attempts accumulate
+        (job,) = q.lease("w2", ec_volumes=[4])
+        assert job["id"] == jid and job["attempts"] == 2
+        assert job["last_error"] == "lease expired"
+
+    def test_fail_backs_off_then_exhausts(self):
+        q = JobQueue(lease_seconds=60, max_attempts=2, retry_backoff=5)
+        clock = FakeClock()
+        q.now = clock
+        jid = q.enqueue(TYPE_VACUUM, 2, "")
+        q.lease("w1")
+        failed = q.fail(jid, "w1", "boom")
+        assert failed.state == "pending"
+        # backoff: not leasable until retry_backoff elapses
+        assert q.lease("w1") == []
+        clock.advance(6)
+        (job,) = q.lease("w1")
+        assert job["attempts"] == 2
+        # attempts exhausted -> parked in history as failed
+        gone = q.fail(jid, "w1", "boom again")
+        assert gone.state == "done" and gone.outcome == "failed"
+        assert q.stats()["live"] == 0
+        assert q.history[-1]["id"] == jid
+
+    def test_deep_scrub_leases_only_to_holders(self):
+        q = JobQueue()
+        q.enqueue(TYPE_DEEP_SCRUB, 11, "")
+        q.enqueue(TYPE_VACUUM, 11, "")
+        # not a holder of volume 11: gets the vacuum but not the scrub
+        jobs = q.lease("w1", limit=10, ec_volumes=[12, 13])
+        assert [j["type"] for j in jobs] == [TYPE_VACUUM]
+        jobs = q.lease("w2", limit=10, ec_volumes=[11])
+        assert [j["type"] for j in jobs] == [TYPE_DEEP_SCRUB]
+
+    def test_pause_stops_leasing_not_enqueueing(self):
+        q = JobQueue()
+        q.paused = True
+        assert q.enqueue(TYPE_VACUUM, 1, "") is not None
+        assert q.lease("w1") == []
+        q.paused = False
+        assert len(q.lease("w1")) == 1
+
+    def test_journal_replay_survives_restart(self, tmp_path):
+        path = str(tmp_path / "maint.jlog")
+        q = JobQueue(journal_path=path, lease_seconds=60)
+        clock = FakeClock()
+        q.now = clock
+        kept = q.enqueue(TYPE_EC_REBUILD, 5, "c1", {"missing": [0, 7]})
+        done = q.enqueue(TYPE_VACUUM, 6, "")
+        q.lease("w1", [TYPE_VACUUM])
+        q.complete(done, "w1")
+        q.lease("w1", [TYPE_EC_REBUILD])
+
+        # failover: a new queue replays the journal
+        q2 = JobQueue(journal_path=path, lease_seconds=60)
+        q2.now = FakeClock(clock.t)
+        assert q2.stats()["live"] == 1
+        job = q2.get(kept)
+        assert job.type == TYPE_EC_REBUILD and job.state == "leased"
+        assert job.params == {"missing": [0, 7]}
+        # dedupe index survives too
+        assert q2.enqueue(TYPE_EC_REBUILD, 5, "c1") is None
+        # the replayed lease expires on the new master's clock
+        q2.now.advance(61)
+        assert q2.expire_leases() == [kept]
+
+    def test_journal_replay_tolerates_torn_tail(self, tmp_path):
+        path = str(tmp_path / "maint.jlog")
+        q = JobQueue(journal_path=path)
+        q.enqueue(TYPE_VACUUM, 1, "")
+        with open(path, "a") as f:
+            f.write('{"op":"set","job":{"id":"jX"')  # crash mid-write
+        q2 = JobQueue(journal_path=path)
+        assert q2.stats()["live"] == 1
+
+    def test_journal_compacts_instead_of_growing_forever(self, tmp_path):
+        path = str(tmp_path / "maint.jlog")
+        q = JobQueue(journal_path=path)
+        for i in range(120):
+            jid = q.enqueue(TYPE_VACUUM, 1, "")
+            q.lease("w1")
+            q.complete(jid, "w1")
+        with open(path) as f:
+            lines = sum(1 for _ in f)
+        assert lines <= 80  # 360 mutations journaled, compacted away
+
+
+# -- detectors ---------------------------------------------------------------
+
+
+class TestDetectors:
+    def _snap(self, **over):
+        snap = {"volumes": [], "ec": [], "node_ec_shards": {}}
+        snap.update(over)
+        return snap
+
+    def test_missing_ec_shards_become_rebuild(self):
+        snap = self._snap(ec=[
+            {"id": 1, "collection": "", "shards": list(range(14))},
+            {"id": 2, "collection": "c", "shards": [0, 1, 2, 3, 4, 5,
+                                                    6, 7, 8, 9, 10]},
+        ])
+        specs = detectors.scan(snap, now=0, last_scrub={1: 0, 2: 0},
+                               scrub_interval=86400)
+        rebuilds = [s for s in specs if s["type"] == TYPE_EC_REBUILD]
+        assert rebuilds == [{"type": TYPE_EC_REBUILD, "volume": 2,
+                             "collection": "c",
+                             "params": {"missing": [11, 12, 13]}}]
+
+    def test_under_replication_becomes_one_global_fix(self):
+        # replication byte 0x01 = 010 = two copies wanted
+        snap = self._snap(volumes=[
+            {"id": 3, "collection": "", "size": 10, "deleted_bytes": 0,
+             "replication": 0x01, "replicas": 1, "read_only": False},
+            {"id": 4, "collection": "", "size": 10, "deleted_bytes": 0,
+             "replication": 0x01, "replicas": 2, "read_only": False},
+        ])
+        specs = detectors.scan(snap, now=0, last_scrub={},
+                               scrub_interval=86400)
+        fixes = [s for s in specs if s["type"] == TYPE_FIX_REPLICATION]
+        assert len(fixes) == 1
+        assert fixes[0]["volume"] == 0
+        assert fixes[0]["params"]["volumes"] == [3]
+
+    def test_garbage_ratio_triggers_vacuum(self):
+        vols = [
+            {"id": 5, "collection": "", "size": 100, "deleted_bytes": 40,
+             "replication": 0, "replicas": 1, "read_only": False},
+            {"id": 6, "collection": "", "size": 100, "deleted_bytes": 10,
+             "replication": 0, "replicas": 1, "read_only": False},
+            {"id": 7, "collection": "", "size": 100, "deleted_bytes": 90,
+             "replication": 0, "replicas": 1, "read_only": True},
+        ]
+        specs = detectors.scan(self._snap(volumes=vols), now=0,
+                               last_scrub={}, garbage_threshold=0.3,
+                               scrub_interval=86400)
+        vacs = [s for s in specs if s["type"] == TYPE_VACUUM]
+        assert [s["volume"] for s in vacs] == [5]  # 6 under, 7 read-only
+        assert vacs[0]["params"]["garbage_ratio"] == 0.4
+        # the master's auto-vacuum switch gates the detector entirely
+        none = detectors.scan(self._snap(volumes=vols), now=0,
+                              last_scrub={}, garbage_threshold=0.3,
+                              scrub_interval=86400, vacuum_enabled=False)
+        assert not [s for s in none if s["type"] == TYPE_VACUUM]
+
+    def test_stale_scrub_due_only_when_volume_complete(self):
+        snap = self._snap(ec=[
+            {"id": 8, "collection": "", "shards": list(range(14))},
+            {"id": 9, "collection": "", "shards": list(range(13))},
+        ])
+        specs = detectors.scan(snap, now=1000.0,
+                               last_scrub={8: 0.0},  # 9 never scrubbed
+                               scrub_interval=500)
+        scrubs = [s for s in specs if s["type"] == TYPE_DEEP_SCRUB]
+        # 8 is overdue; 9 is incomplete (rebuild first, scrub later)
+        assert [s["volume"] for s in scrubs] == [8]
+        fresh = detectors.scan(snap, now=1000.0,
+                               last_scrub={8: 800.0},
+                               scrub_interval=500)
+        assert not [s for s in fresh if s["type"] == TYPE_DEEP_SCRUB]
+
+    def test_placement_skew_triggers_balance(self):
+        snap = self._snap(node_ec_shards={"a": 10, "b": 2, "c": 5})
+        specs = detectors.scan(snap, now=0, last_scrub={},
+                               scrub_interval=86400, balance_skew=4)
+        (bal,) = [s for s in specs if s["type"] == TYPE_BALANCE]
+        assert bal["params"]["skew"] == 8
+        calm = detectors.scan(
+            self._snap(node_ec_shards={"a": 5, "b": 4}), now=0,
+            last_scrub={}, scrub_interval=86400, balance_skew=4)
+        assert not [s for s in calm if s["type"] == TYPE_BALANCE]
+
+
+# -- pacer -------------------------------------------------------------------
+
+
+class TestBytePacer:
+    def _fake(self, pacer):
+        slept = []
+        t = [0.0]
+        pacer.now = lambda: t[0]
+        pacer.sleep = lambda d: (slept.append(d),
+                                 t.__setitem__(0, t[0] + d))
+        return slept, t
+
+    def test_rate_limits_sustained_stream(self):
+        p = BytePacer(rate_bytes=1 << 20, burst_seconds=0.25)
+        slept, t = self._fake(p)
+        for _ in range(8):
+            p.throttle(512 << 10)  # 4 MiB total at 1 MiB/s
+        # bucket gave 0.25s of burst; the rest must have been slept
+        assert sum(slept) == pytest.approx(4 - 0.25, rel=0.01)
+        assert p.paced_bytes == 4 << 20
+
+    def test_foreground_load_squeezes_to_floor(self):
+        load = [0.0]
+        p = BytePacer(rate_bytes=1000, load_fn=lambda: load[0],
+                      floor_frac=0.1)
+        assert p.effective_rate() == 1000
+        load[0] = 0.5
+        assert p.effective_rate() == 500
+        load[0] = 1.0  # saturated: floor keeps repairs progressing
+        assert p.effective_rate() == pytest.approx(100)
+        load[0] = 17.0  # garbage load values clamp
+        assert p.effective_rate() == pytest.approx(100)
+
+    def test_throttle_noop_when_under_rate(self):
+        p = BytePacer(rate_bytes=1 << 30)
+        slept, t = self._fake(p)
+        p.throttle(1024)
+        assert slept == []
+
+
+# -- curator (unit, fake master) ---------------------------------------------
+
+
+class _FakeRaft:
+    is_leader = True
+
+
+class _FakeMaster:
+    def __init__(self):
+        self.raft = _FakeRaft()
+        self.topo = None
+        self.auto_vacuum_interval = 900.0
+        self.garbage_threshold = 0.3
+
+
+class TestCurator:
+    def _curator(self, monkeypatch, specs):
+        from seaweedfs_tpu.maintenance.curator import Curator
+
+        cur = Curator(_FakeMaster(), interval=3600)
+        clock = FakeClock()
+        cur.now = clock
+        cur.queue.now = clock
+        monkeypatch.setattr(detectors, "snapshot", lambda topo: {})
+        monkeypatch.setattr(detectors, "scan",
+                            lambda *a, **k: list(specs))
+        return cur, clock
+
+    def test_tick_enqueues_and_dedupes(self, monkeypatch):
+        specs = [{"type": TYPE_VACUUM, "volume": 1, "collection": "",
+                  "params": {}}]
+        cur, clock = self._curator(monkeypatch, specs)
+        assert len(cur.tick()) == 1
+        # same anomaly on the next pass: deduped by the live queue
+        assert cur.tick() == []
+        assert cur.queue.stats()["live"] == 1
+
+    def test_completion_cooldown_bridges_stale_heartbeats(
+            self, monkeypatch):
+        specs = [{"type": TYPE_VACUUM, "volume": 1, "collection": "",
+                  "params": {}}]
+        cur, clock = self._curator(monkeypatch, specs)
+        monkeypatch.setenv("WEED_MAINT_COOLDOWN", "60")
+        (jid,) = cur.tick()
+        cur.queue.lease("w1")
+        job = cur.queue.complete(jid, "w1")
+        cur.on_complete(job, {})
+        # heartbeats still show stale garbage; cooldown suppresses
+        assert cur.tick() == []
+        clock.advance(61)
+        assert len(cur.tick()) == 1
+
+    def test_deep_scrub_findings_enqueue_rebuild(self, monkeypatch):
+        cur, clock = self._curator(monkeypatch, [])
+        jid = cur.queue.enqueue(TYPE_DEEP_SCRUB, 9, "c")
+        cur.queue.lease("w1", ec_volumes=[9])
+        job = cur.queue.complete(jid, "w1")
+        cur.on_complete(job, {"corrupt": [3], "missing": [],
+                              "parity_mismatch": []})
+        assert cur.last_scrub[9] == clock()
+        jobs = cur.queue.jobs()
+        assert [j["type"] for j in jobs] == [TYPE_EC_REBUILD]
+        assert jobs[0]["volume"] == 9
+        assert jobs[0]["params"]["from"] == "deep.scrub"
+
+    def test_clean_scrub_enqueues_nothing(self, monkeypatch):
+        cur, clock = self._curator(monkeypatch, [])
+        jid = cur.queue.enqueue(TYPE_DEEP_SCRUB, 9, "")
+        cur.queue.lease("w1", ec_volumes=[9])
+        cur.on_complete(cur.queue.complete(jid, "w1"),
+                        {"corrupt": [], "missing": [],
+                         "parity_mismatch": []})
+        assert cur.queue.jobs() == []
+        assert 9 in cur.last_scrub
+
+
+# -- live cluster: detect -> enqueue -> lease -> repair ----------------------
+
+
+@pytest.fixture
+def maint_cluster(tmp_path, monkeypatch):
+    """3 volume servers with worker THREADS parked (WEED_MAINT_WORKER=0)
+    so tests drive poll_once() deterministically; the curator object is
+    live on the master but its interval is hours away."""
+    monkeypatch.setenv("WEED_MAINT_WORKER", "0")
+    monkeypatch.setenv("WEED_MAINT_INTERVAL", "3600")
+    from seaweedfs_tpu.master.server import MasterServer
+    from seaweedfs_tpu.volume_server.server import VolumeServer
+
+    (tmp_path / "m").mkdir()
+    master = MasterServer(port=0, volume_size_limit_mb=64,
+                          pulse_seconds=0.2,
+                          raft_dir=str(tmp_path / "m"))
+    master.start()
+    servers = []
+    for i in range(3):
+        d = tmp_path / f"vs{i}"
+        d.mkdir()
+        vs = VolumeServer([str(d)], master.address, port=0,
+                          rack=f"rack{i % 2}", pulse_seconds=0.2)
+        vs.start()
+        vs.heartbeat_once()
+        servers.append(vs)
+    yield master, servers
+    for vs in servers:
+        vs.stop()
+    master.stop()
+
+
+def _fill_and_encode(master, servers):
+    from seaweedfs_tpu.rpc.http_rpc import call
+    from seaweedfs_tpu.shell import commands as sh
+
+    stored = {}
+    for i in range(40):
+        a = call(master.address, "/dir/assign")
+        payload = os.urandom(500 + i)
+        call(a["url"], f"/{a['fid']}", raw=payload, method="POST")
+        stored[a["fid"]] = payload
+    env = sh.CommandEnv(master.address)
+    vid = sorted({int(fid.split(",")[0]) for fid in stored})[0]
+    sh.ec_encode(env, vid)
+    for vs in servers:
+        vs.heartbeat_once()
+    return env, vid, {f: p for f, p in stored.items()
+                      if int(f.split(",")[0]) == vid}
+
+
+def _find_shard(servers, vid, sid):
+    for vs in servers:
+        for loc in vs.store.locations:
+            hits = glob.glob(f"{loc.directory}/{vid}.ec{sid:02d}")
+            if hits:
+                return vs, hits[0]
+    return None, None
+
+
+class TestMaintenanceLifecycle:
+    def test_deep_scrub_job_detects_and_autorepairs(self, maint_cluster):
+        """The full loop, driven deterministically: detector enqueues
+        deep.scrub -> holder leases it -> device-batched scrub flags the
+        corrupt shard -> completion enqueues ec.rebuild -> a worker
+        repairs -> scrub-clean and byte-identical reads."""
+        from seaweedfs_tpu.rpc.http_rpc import call
+        from seaweedfs_tpu.shell import commands as sh
+
+        master, servers = maint_cluster
+        env, vid, stored = _fill_and_encode(master, servers)
+
+        # detector pass: the never-scrubbed EC volume is due now
+        ids = master.curator.tick()
+        jobs = master.curator.queue.jobs()
+        assert TYPE_DEEP_SCRUB in [j["type"] for j in jobs]
+
+        # flip a byte inside a DATA shard on whichever holder has it
+        holder, shard_path = _find_shard(servers, vid, 2)
+        assert shard_path
+        with open(shard_path, "r+b") as f:
+            f.seek(33)
+            b = f.read(1)
+            f.seek(33)
+            f.write(bytes([b[0] ^ 0xA5]))
+
+        # the holder leases the scrub over real HTTP and executes the
+        # device-batched pipeline; completion reports back to the master
+        # (poll until the scrub lands — the tick may have queued other
+        # work first; stop there so the follow-up rebuild stays queued)
+        for _ in range(4):
+            holder.maintenance_worker.poll_once()
+            if any(h["type"] == TYPE_DEEP_SCRUB
+                   for h in master.curator.queue.history):
+                break
+        scrubs = [h for h in master.curator.queue.history
+                  if h["type"] == TYPE_DEEP_SCRUB]
+        assert scrubs and scrubs[-1]["outcome"] == "ok"
+        assert vid in master.curator.last_scrub
+
+        # the finding closed the loop into a rebuild job
+        rebuilds = [j for j in master.curator.queue.jobs()
+                    if j["type"] == TYPE_EC_REBUILD]
+        assert rebuilds and rebuilds[0]["volume"] == vid
+        assert rebuilds[0]["params"]["from"] == "deep.scrub"
+        assert 2 in rebuilds[0]["params"]["corrupt"]
+
+        # any worker can run the rebuild (RPC-driven repair)
+        for _ in range(4):
+            if not [j for j in master.curator.queue.jobs()
+                    if j["type"] == TYPE_EC_REBUILD]:
+                break
+            servers[0].maintenance_worker.poll_once()
+        for vs in servers:
+            vs.heartbeat_once()
+        clean = sh.ec_scrub(env, vid)
+        assert clean[0]["clean_shards"] == 14
+        assert clean[0]["corrupt"] == []
+        for fid, payload in stored.items():
+            lookup = call(master.address,
+                          f"/dir/lookup?volumeId={vid}")
+            assert call(lookup["locations"][0]["url"],
+                        f"/{fid}") == payload
+
+    def test_worker_scrub_reports_device_stage_breakdown(
+            self, maint_cluster):
+        from seaweedfs_tpu.rpc.http_rpc import call
+
+        master, servers = maint_cluster
+        env, vid, _ = _fill_and_encode(master, servers)
+        call(master.address, "/maintenance/run",
+             {"type": TYPE_DEEP_SCRUB, "volume": vid})
+        holder, _ = _find_shard(servers, vid, 0)
+        assert holder.maintenance_worker.poll_once() == 1
+        hist = [h for h in master.curator.queue.history
+                if h["type"] == TYPE_DEEP_SCRUB]
+        assert hist
+        # stage breakdown travels in the completion report and is
+        # summarized in the worker's last pacer snapshot
+        snap = holder.maintenance_worker.pacer.snapshot()
+        assert snap["paced_bytes"] > 0
+
+    def test_host_needle_walk_agrees_with_device_verdict(
+            self, maint_cluster):
+        from seaweedfs_tpu.maintenance.deep_scrub import deep_scrub_host
+
+        master, servers = maint_cluster
+        env, vid, _ = _fill_and_encode(master, servers)
+        holder, shard_path = _find_shard(servers, vid, 1)
+        with open(shard_path, "r+b") as f:
+            f.seek(17)
+            b = f.read(1)
+            f.seek(17)
+            f.write(bytes([b[0] ^ 0xFF]))
+        directory = os.path.dirname(shard_path)
+        out = deep_scrub_host(directory, "", vid)
+        assert 1 in out["corrupt"]
+        assert not out["ok"]
+
+    def test_admin_surface_status_queue_pause(self, maint_cluster):
+        from seaweedfs_tpu.rpc.http_rpc import call
+
+        master, servers = maint_cluster
+        st = call(master.address, "/maintenance/status")
+        assert st["enabled"] and st["leader"]
+        call(master.address, "/maintenance/pause", {"paused": True})
+        call(master.address, "/maintenance/run",
+             {"type": TYPE_VACUUM, "volume": 999})
+        assert servers[0].maintenance_worker.poll_once() == 0  # paused
+        call(master.address, "/maintenance/pause", {"paused": False})
+        q = call(master.address, "/maintenance/queue")
+        assert [j["volume"] for j in q["jobs"]] == [999]
+
+    def test_vacuum_flows_through_queue_not_reap_loop(
+            self, maint_cluster):
+        """Satellite: the master's auto-vacuum detector enqueues instead
+        of synchronously RPCing holders from the reap loop; a worker
+        executes the compaction and deleted bytes drop."""
+        from seaweedfs_tpu.rpc.http_rpc import call
+        from seaweedfs_tpu.shell import commands as sh
+
+        master, servers = maint_cluster
+        fids = []
+        for i in range(30):
+            a = call(master.address, "/dir/assign")
+            call(a["url"], f"/{a['fid']}", raw=os.urandom(2000),
+                 method="POST")
+            fids.append((a["url"], a["fid"]))
+        vid = int(fids[0][1].split(",")[0])
+        for url, fid in fids:
+            if int(fid.split(",")[0]) == vid:
+                call(url, f"/{fid}", method="DELETE")
+        for vs in servers:
+            vs.heartbeat_once()
+
+        ids = master.curator.tick()
+        vacs = [j for j in master.curator.queue.jobs()
+                if j["type"] == TYPE_VACUUM and j["volume"] == vid]
+        assert vacs, f"no vacuum enqueued (got {ids})"
+        assert vacs[0]["params"]["garbage_ratio"] > 0.3
+        assert servers[0].maintenance_worker.poll_once() == 1
+        done = [h for h in master.curator.queue.history
+                if h["type"] == TYPE_VACUUM]
+        assert done and done[-1]["outcome"] == "ok"
+        for vs in servers:
+            vs.heartbeat_once()
+        status = call(master.address, "/dir/status")
+        vols = [v for dc in status["datacenters"]
+                for r in dc["racks"] for n in r["nodes"]
+                for v in n["volume_list"] if v["id"] == vid]
+        assert vols and all(v["deleted_bytes"] == 0 for v in vols)
+
+
+# -- chaos: convergence with a dead holder under fault injection -------------
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_curator_converges_after_corruption_and_holder_death(
+        tmp_path, monkeypatch):
+    """Acceptance drill: corrupt a data shard byte AND kill a shard
+    holder while client-RPC faults fire; the curator must detect,
+    enqueue and repair with no operator action until ec.scrub is clean
+    and every read is byte-identical."""
+    from seaweedfs_tpu.rpc.http_rpc import call
+    from seaweedfs_tpu.shell import commands as sh
+    from seaweedfs_tpu.util import faults
+
+    monkeypatch.setenv("WEED_MAINT_INTERVAL", "0.3")
+    monkeypatch.setenv("WEED_MAINT_POLL", "0.2")
+    monkeypatch.setenv("WEED_MAINT_LEASE", "10")
+    monkeypatch.setenv("WEED_MAINT_COOLDOWN", "0.5")
+    monkeypatch.setenv("WEED_MAINT_RATE_MB", "512")
+    faults.REGISTRY.clear()
+
+    from seaweedfs_tpu.master.server import MasterServer
+    from seaweedfs_tpu.volume_server.server import VolumeServer
+
+    (tmp_path / "m").mkdir()
+    master = MasterServer(port=0, volume_size_limit_mb=64,
+                          pulse_seconds=0.2,
+                          raft_dir=str(tmp_path / "m"))
+    master.start()
+    servers = []
+    for i in range(5):  # killing one holder must leave >= 10 clean
+        d = tmp_path / f"vs{i}"
+        d.mkdir()
+        vs = VolumeServer([str(d)], master.address, port=0,
+                          rack=f"rack{i % 2}", pulse_seconds=0.2)
+        vs.start()
+        vs.heartbeat_once()
+        servers.append(vs)
+    try:
+        env, vid, stored = _fill_and_encode(master, servers)
+
+        # victim = the holder with the FEWEST shards of this volume, so
+        # its death plus one corrupt shard still leaves >= 10 clean
+        def held(vs):
+            return sum(len(glob.glob(
+                f"{loc.directory}/{vid}.ec[0-9][0-9]"))
+                for loc in vs.store.locations)
+
+        holders = [vs for vs in servers if held(vs)]
+        victim_vs = min(holders, key=held)
+        assert held(victim_vs) <= 3
+
+        # corrupt a DATA shard byte on a server we will keep alive
+        survivor_candidates = [s for s in servers if s is not victim_vs]
+        shard_path = None
+        for sid in range(10):
+            holder, path = _find_shard(survivor_candidates, vid, sid)
+            if path:
+                shard_path = path
+                break
+        assert shard_path
+        with open(shard_path, "r+b") as f:
+            f.seek(29)
+            b = f.read(1)
+            f.seek(29)
+            f.write(bytes([b[0] ^ 0x3C]))
+
+        # kill a different holder and let sparse client faults fire
+        victim_vs.stop()
+        faults.REGISTRY.configure(
+            "error,status=503,pct=5,side=client,route=/[0-9]*",
+            seed=42)
+
+        deadline = time.monotonic() + 90
+        clean = None
+        while time.monotonic() < deadline:
+            time.sleep(1.0)
+            try:
+                report = sh.ec_scrub(env, vid, plan_only=True)
+            except Exception:
+                continue
+            if not report:
+                continue
+            r = report[0]
+            if (r["clean_shards"] == 14 and not r["corrupt"]
+                    and not r["missing"]):
+                clean = r
+                break
+        assert clean, (
+            f"curator failed to converge: {sh.ec_scrub(env, vid, plan_only=True)} "
+            f"queue={master.curator.queue.stats()} "
+            f"history={list(master.curator.queue.history)}")
+
+        faults.REGISTRY.clear()
+        # every needle byte-identical after automatic repair
+        for fid, payload in stored.items():
+            lookup = call(master.address, f"/dir/lookup?volumeId={vid}")
+            assert call(lookup["locations"][0]["url"],
+                        f"/{fid}") == payload
+        # and a clean deep scrub eventually rides the same queue (the
+        # rebuilt volume has never been scrubbed, so it is due now)
+        scrub_deadline = time.monotonic() + 45
+        while time.monotonic() < scrub_deadline:
+            hist = [h for h in master.curator.queue.history
+                    if h["type"] == TYPE_DEEP_SCRUB
+                    and h["outcome"] == "ok"]
+            if hist:
+                break
+            time.sleep(0.5)
+        assert hist
+    finally:
+        faults.REGISTRY.clear()
+        for vs in servers:
+            vs.stop()
+        master.stop()

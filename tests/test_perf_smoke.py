@@ -151,6 +151,58 @@ def test_profiler_overhead_under_five_percent():
     assert ratio < 0.05, f"profiler duty cycle {ratio:.4f} >= 5%"
 
 
+def test_maintenance_scrub_paced_under_foreground_load(tmp_path):
+    """Deep-scrub I/O runs under the maintenance token bucket, and a
+    saturated front end halves (here: floor-clamps) its effective rate.
+    Fake clock: asserts the sleep arithmetic — every scrubbed byte is
+    debited and the injected delay is exactly bytes/effective_rate
+    minus the one-burst credit — not wall-clock numbers."""
+    import os
+
+    import numpy as np
+
+    from seaweedfs_tpu.maintenance.deep_scrub import (deep_scrub,
+                                                      local_target)
+    from seaweedfs_tpu.maintenance.pacer import BytePacer
+    from seaweedfs_tpu.storage.erasure_coding import TOTAL_SHARDS_COUNT
+    from seaweedfs_tpu.storage.erasure_coding.encoder import (
+        save_volume_info, write_ec_files)
+
+    base = os.path.join(str(tmp_path), "1")
+    rng = np.random.default_rng(7)
+    with open(base + ".dat", "wb") as f:
+        f.write(rng.integers(0, 256, 1 << 20, dtype=np.uint8).tobytes())
+    crcs = write_ec_files(base, batched=True)
+    save_volume_info(base, version=3, extra={"shard_crc32c": crcs})
+
+    pacer = BytePacer(rate_bytes=float(1 << 20),
+                      load_fn=lambda: 1.0,  # shedder saturated
+                      floor_frac=0.5)
+    clock = {"t": 0.0}
+    slept = []
+    pacer.now = lambda: clock["t"]
+
+    def fake_sleep(s):
+        slept.append(s)
+        clock["t"] += s
+
+    pacer.sleep = fake_sleep
+    eff = pacer.effective_rate()
+    assert eff == pytest.approx(0.5 * (1 << 20))  # floor, not zero
+
+    out = deep_scrub([local_target(base, 1)], throttle=pacer.throttle)
+    assert out["corrupt"] == [] and out["volumes"][0]["ok"]
+    total = sum(os.path.getsize(base + f".ec{sid:02d}")
+                for sid in range(TOTAL_SHARDS_COUNT))
+    # every shard byte was debited through the bucket
+    assert pacer.paced_bytes == out["scrubbed_bytes"] == total
+    # injected delay is deterministic: bytes at the floored rate minus
+    # the single burst_seconds credit the bucket starts with
+    assert sum(slept) == pytest.approx(
+        total / eff - pacer.burst_seconds, rel=1e-6)
+    assert pacer.throttled_seconds == pytest.approx(sum(slept))
+
+
 def test_device_scale_dispatch_smoke(tmp_path):
     """Mini bench_e2e_device_scale (4 volumes, CPU-device mesh): asserts
     the SHAPE of the pooled device pipeline — the pooled backend was

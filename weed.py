@@ -340,6 +340,7 @@ def _shell_handlers(env):
     """The full admin command registry (weed/shell/commands.go)."""
     from seaweedfs_tpu.shell import commands as sh
     from seaweedfs_tpu.shell import commands_fs as fs
+    from seaweedfs_tpu.shell import commands_maintenance as mnt
     from seaweedfs_tpu.shell import commands_remote as rem
     from seaweedfs_tpu.shell import commands_volume as vol
 
@@ -430,6 +431,15 @@ def _shell_handlers(env):
             vid=(lambda v: int(v[0]) if v else None)(
                 [x for x in a if not x.startswith("-")]),
             repair="-repair" in a, plan_only=plan(a))),
+        # maintenance family — curator status/queue on the master
+        "maintenance.status": lambda a: show(mnt.maintenance_status(env)),
+        "maintenance.queue": lambda a: show(mnt.maintenance_queue(env)),
+        "maintenance.pause": lambda a: show(mnt.maintenance_pause(
+            env, paused="-resume" not in a)),
+        "maintenance.run": lambda a: show(mnt.maintenance_run(
+            env, job_type=flag(a, "type"),
+            volume=int(flag(a, "volume", "0") or 0),
+            collection=flag(a, "collection", ""))),
         # collection / cluster
         "collection.list": lambda a: show(vol.collection_list(env)),
         "collection.delete": lambda a: show(vol.collection_delete(
@@ -1052,6 +1062,34 @@ def cmd_profile(args):
         sys.exit(1)
 
 
+def cmd_maintenance(args):
+    """One-shot curator control from the command line: status/queue
+    dumps, pause/resume, or force a detector pass / explicit job —
+    the same /maintenance/* surface the shell commands use."""
+    from seaweedfs_tpu.rpc.http_rpc import RpcError
+    from seaweedfs_tpu.shell import commands_maintenance as mnt
+    from seaweedfs_tpu.shell.commands import CommandEnv
+
+    env = CommandEnv(args.master)
+    try:
+        if args.action == "status":
+            out = mnt.maintenance_status(env)
+        elif args.action == "queue":
+            out = mnt.maintenance_queue(env)
+        elif args.action == "pause":
+            out = mnt.maintenance_pause(env, paused=True)
+        elif args.action == "resume":
+            out = mnt.maintenance_pause(env, paused=False)
+        else:  # run
+            out = mnt.maintenance_run(
+                env, job_type=args.type or None, volume=args.volume,
+                collection=args.collection)
+    except (RpcError, OSError) as e:
+        print(f"error: master {args.master} unreachable: {e}")
+        sys.exit(1)
+    print(json.dumps(out, indent=2, default=str))
+
+
 def cmd_scaffold(args):
     from seaweedfs_tpu.util.config import scaffold
 
@@ -1239,6 +1277,22 @@ def main(argv=None):
     p.add_argument("-o", default="",
                    help="write collapsed stacks here (default: stdout)")
     p.set_defaults(fn=cmd_profile)
+
+    p = sub.add_parser("maintenance",
+                       help="curator control: status, queue, pause/"
+                            "resume, or force a scan/job")
+    p.add_argument("action",
+                   choices=["status", "queue", "pause", "resume", "run"])
+    p.add_argument("-master", default="127.0.0.1:9333")
+    p.add_argument("-type", default="",
+                   help="run: enqueue one explicit job of this type "
+                        "(ec.rebuild / fix.replication / vacuum / "
+                        "deep.scrub / balance) instead of a full scan")
+    p.add_argument("-volume", type=int, default=0,
+                   help="run: volume id for the explicit job")
+    p.add_argument("-collection", default="",
+                   help="run: collection for the explicit job")
+    p.set_defaults(fn=cmd_maintenance)
 
     p = sub.add_parser("benchmark", help="write/read load benchmark")
     p.add_argument("-master", default="127.0.0.1:9333")
