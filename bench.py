@@ -674,6 +674,176 @@ def bench_ec_degraded_read(num_files: int = 2000,
         shutil.rmtree(workdir, ignore_errors=True)
 
 
+def bench_qos_isolation(num_files: int = 800, read_reqs: int = 3000,
+                        scrub_vols: int = 3,
+                        scrub_vol_bytes: int = 8 << 20) -> dict:
+    """QoS foreground/background isolation: the degraded-read storm
+    (bench_ec_degraded_read's incident path) measured once on an idle
+    box and once while a device-batched deep scrub grinds in the same
+    process.  The scrub's encode batches yield at their lane
+    checkpoints whenever a recover decode holds the foreground lane
+    (qos/lanes.py), so the with-scrub p99 should stay near the idle
+    p99 while the scrub is visibly paced.  Returns fg rps/p99 for both
+    runs, the concurrent scrub rate, and the lane counters
+    (preemptions / background stall) accrued during the storm."""
+    import tempfile
+    import threading
+
+    from seaweedfs_tpu.maintenance.deep_scrub import (deep_scrub,
+                                                      local_target)
+    from seaweedfs_tpu.master.server import MasterServer
+    from seaweedfs_tpu.parallel.batched_encode import encode_volumes
+    from seaweedfs_tpu.qos.lanes import LANES
+    from seaweedfs_tpu.rpc.http_rpc import RpcError, call
+    from seaweedfs_tpu.shell import commands as sh
+    from seaweedfs_tpu.storage.erasure_coding.encoder import \
+        save_volume_info
+    from seaweedfs_tpu.volume_server.server import VolumeServer
+
+    workdir = tempfile.mkdtemp(prefix="swbench_qos_")
+    # the recovered-block LRU would absorb the whole needle set after
+    # one pass and idle the foreground lane; disable it so both storms
+    # measure real decode work
+    prev_cache = os.environ.get("WEED_EC_RECOVER_CACHE_MB")
+    os.environ["WEED_EC_RECOVER_CACHE_MB"] = "0"
+    master = MasterServer(port=0, pulse_seconds=1.0,
+                          volume_size_limit_mb=1024)
+    master.start()
+    vs = VolumeServer([workdir], master.address, port=0,
+                      pulse_seconds=1.0, max_volume_counts=[16],
+                      enable_tcp=True)
+    vs.start()
+    vs.heartbeat_once()
+    try:
+        rng = np.random.default_rng(11)
+        payload = rng.integers(0, 256, 1024, dtype=np.uint8).tobytes()
+
+        def call_retry(url, path, *args, **kw):
+            for attempt in range(3):
+                try:
+                    return call(url, path, *args, timeout=60, **kw)
+                except RpcError as e:
+                    if attempt == 2 or e.status != 503:
+                        raise
+                    time.sleep(1.0)
+
+        fids = []
+        vid = None
+        for _ in range(num_files):
+            a = call_retry(master.address, "/dir/assign")
+            if vid is None:
+                vid = int(a["fid"].split(",")[0])
+            if int(a["fid"].split(",")[0]) != vid:
+                continue
+            call_retry(a["url"], f"/{a['fid']}", raw=payload,
+                       method="POST")
+            fids.append(a["fid"])
+        env = sh.CommandEnv(master.address)
+        sh.ec_encode(env, vid)
+        vs.heartbeat_once()
+        kill = [0, 1, 2, 3]
+        call_retry(vs.store.url, "/admin/ec/unmount",
+                   {"volume": vid, "shard_ids": kill})
+        call_retry(vs.store.url, "/admin/ec/delete_shards",
+                   {"volume": vid, "shard_ids": kill})
+        vs.heartbeat_once()
+        got = call_retry(vs.store.url, f"/{fids[0]}")
+        assert got == payload, "degraded read returned wrong bytes"
+
+        # background material: separate volumes the scrub loop chews on
+        # while the storm runs; tiny spans/batches so the scrub takes
+        # many lane checkpoints per pass instead of one long batch
+        scrub_dir = os.path.join(workdir, "scrub")
+        os.makedirs(scrub_dir, exist_ok=True)
+        bases = []
+        for i in range(scrub_vols):
+            base = os.path.join(scrub_dir, f"qosvol{i}")
+            _write_volume(base, scrub_vol_bytes, seed=1100 + i)
+            bases.append(base)
+        crc_map = encode_volumes(bases)
+        for base in bases:
+            save_volume_info(base, version=3,
+                             extra={"shard_crc32c": crc_map[base]})
+        targets = [local_target(b, i + 1) for i, b in enumerate(bases)]
+        deep_scrub(targets, span_bytes=256 << 10, batch_units=4)  # warm
+
+        import concurrent.futures as cf
+
+        lat_lock = threading.Lock()
+
+        def storm() -> tuple[float, float]:
+            lat: list[float] = []
+
+            def one(i: int):
+                fid = fids[i % len(fids)]
+                t0 = time.perf_counter()
+                try:
+                    call(vs.store.url, f"/{fid}")
+                except RpcError as e:
+                    if e.status != 503:
+                        raise
+                    call_retry(vs.store.url, f"/{fid}")
+                dt = (time.perf_counter() - t0) * 1000.0
+                with lat_lock:
+                    lat.append(dt)
+
+            t0 = time.perf_counter()
+            with cf.ThreadPoolExecutor(max_workers=16) as pool:
+                list(pool.map(one, range(read_reqs)))
+            secs = time.perf_counter() - t0
+            lat.sort()
+            p99 = lat[int(len(lat) * 0.99) - 1] if lat else 0.0
+            return read_reqs / secs, p99
+
+        base_rps, base_p99 = storm()
+
+        # concurrent run: scrub loops until the storm drains
+        LANES.reset()
+        stop = threading.Event()
+        scrub_bytes = [0]
+        scrub_secs = [0.0]
+
+        def scrub_loop():
+            while not stop.is_set():
+                t0 = time.perf_counter()
+                out = deep_scrub(targets, span_bytes=256 << 10,
+                                 batch_units=4)
+                scrub_secs[0] += time.perf_counter() - t0
+                scrub_bytes[0] += out["scrubbed_bytes"]
+
+        th = threading.Thread(target=scrub_loop, daemon=True)
+        th.start()
+        try:
+            iso_rps, iso_p99 = storm()
+        finally:
+            stop.set()
+            th.join(timeout=120)
+        lanes = LANES.snapshot()
+        scrub_gibps = (scrub_bytes[0] / GIB / scrub_secs[0]
+                       if scrub_secs[0] else 0.0)
+        return {
+            "fg_rps": round(base_rps, 1),
+            "fg_p99_ms": round(base_p99, 2),
+            "fg_rps_with_scrub": round(iso_rps, 1),
+            "fg_p99_ms_with_scrub": round(iso_p99, 2),
+            "p99_ratio": (round(iso_p99 / base_p99, 2)
+                          if base_p99 else 0.0),
+            "scrub_gibps": round(scrub_gibps, 3),
+            "scrub_passes_bytes": scrub_bytes[0],
+            "lane_preemptions": lanes["preemptions"],
+            "lane_bg_wait_seconds": lanes["background_wait_seconds"],
+            "lane_bg_batches": lanes["background_batches"],
+        }
+    finally:
+        if prev_cache is None:
+            os.environ.pop("WEED_EC_RECOVER_CACHE_MB", None)
+        else:
+            os.environ["WEED_EC_RECOVER_CACHE_MB"] = prev_cache
+        vs.stop()
+        master.stop()
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
 def _stage_fractions(spans: dict, roots: tuple) -> dict:
     """Render a RECORDER.aggregate() dict as per-stage fractions of the
     named root spans' total seconds (the gateway stage breakdown)."""
@@ -1156,6 +1326,14 @@ def main():
         deg_err = f"{type(e).__name__}: {e}"
         print(f"note: degraded-read bench failed: {e}", file=sys.stderr)
 
+    # -- QoS isolation: fg degraded reads vs concurrent deep scrub ----------
+    qos_iso: dict = {}
+    try:
+        _policy.reset_state()
+        qos_iso = bench_qos_isolation()
+    except Exception as e:
+        print(f"note: qos isolation bench failed: {e}", file=sys.stderr)
+
     # -- S3 gateway vs filer data plane --------------------------------------
     s3_stats: dict = {}
     try:
@@ -1226,6 +1404,7 @@ def main():
         "ec_degraded_read_native_rps": round(deg_native_rps, 1),
         "ec_degraded_read_stages": deg_stages,
         "ec_degraded_read_error": deg_err,
+        "qos_isolation": qos_iso,
         "s3_put_rps": round(s3_stats.get("s3_put_rps", 0.0), 1),
         "s3_get_rps": round(s3_stats.get("s3_get_rps", 0.0), 1),
         "filer_put_rps": round(s3_stats.get("filer_put_rps", 0.0), 1),

@@ -23,7 +23,7 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Iterator, Optional
 
-from .. import profiling, tracing
+from .. import profiling, qos, tracing
 from ..rpc import policy
 from ..rpc.http_rpc import Request, Response, RpcError, RpcServer, call
 from ..util import faults
@@ -134,6 +134,11 @@ class FilerServer:
         self.server.add("GET", "/debug/traces", tracing.traces_handler)
         faults.mount(self.server)
         profiling.mount(self.server)
+        # weighted-fair front-end admission (WEED_QOS_FILER_LIMIT; 0 =
+        # classify/count only, never queue)
+        self.qos_gate = qos.AdmissionGate("filer",
+                                          limit_env="WEED_QOS_FILER_LIMIT")
+        qos.mount(self.server, gate=self.qos_gate)
         self.server.add("GET", "/metadata/subscribe", self._h_subscribe)
         self.server.add("GET", "/metadata/aggregate", self._h_aggregate)
         self.server.add("POST", "/remote/configure", self._h_remote_configure)
@@ -255,6 +260,25 @@ class FilerServer:
 
     # -- request routing -----------------------------------------------------
     def _handle(self, method: str, req: Request):
+        if qos.enabled():
+            cls = qos.current_class()
+            if qos.QOS_HEADER not in req.headers:
+                # unclassified gateway traffic: reads are interactive,
+                # writes standard; the collection is the tenant key
+                cls = qos.INTERACTIVE if method in ("GET", "HEAD") \
+                    else qos.STANDARD
+            tenant = req.param("collection") or self.collection or ""
+            cls = qos.class_for_tenant(tenant, cls)
+            release = self.qos_gate.admit(cls, tenant)
+            prev = qos.set_qos(cls, tenant)
+            try:
+                return self._handle_inner(method, req)
+            finally:
+                qos.set_qos(*prev)
+                release()
+        return self._handle_inner(method, req)
+
+    def _handle_inner(self, method: str, req: Request):
         path = req.path or "/"
         if method in ("GET", "HEAD"):
             stats.FilerRequestCounter.labels("read").inc()

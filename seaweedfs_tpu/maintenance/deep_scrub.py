@@ -33,6 +33,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Optional
 
 from ..ops import crc32c as crc_host
+from ..qos import lanes as _lanes
 from ..storage.erasure_coding import (DATA_SHARDS_COUNT,
                                       PARITY_SHARDS_COUNT,
                                       TOTAL_SHARDS_COUNT, to_ext)
@@ -290,6 +291,10 @@ def deep_scrub(targets: list, mesh=None,
                                    chunk, throttle)
                 t1 = time.perf_counter()
                 timers["read"] += t1 - t0
+                # background device lane: yield to in-flight foreground
+                # (degraded-read recover) decodes before dispatching
+                timers["lane_wait"] = timers.get("lane_wait", 0.0) \
+                    + _lanes.LANES.background_checkpoint()
                 words = buf.view(np.int32)
                 if zero_copy:
                     din = jax.dlpack.from_dlpack(words)

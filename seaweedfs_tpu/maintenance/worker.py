@@ -15,6 +15,7 @@ import os
 import threading
 import time
 
+from .. import qos
 from ..rpc.http_rpc import RpcError, call
 from ..stats import metrics
 from ..storage.erasure_coding import TOTAL_SHARDS_COUNT
@@ -52,8 +53,13 @@ class MaintenanceWorker:
         return _env_float("WEED_MAINT_POLL", 5.0)
 
     def _foreground_load(self) -> float:
-        """In-flight fraction of the request shedder's limit — the
-        same signal that drives 503 shedding drives pacer backoff."""
+        """Occupancy of the QoS admission gate (in-flight + queued over
+        the limit) — the same signal that queues/sheds foreground
+        requests drives pacer backoff.  With QoS disabled, fall back to
+        the legacy request-shedder fraction."""
+        gate = getattr(self.server, "qos_gate", None)
+        if gate is not None and qos.enabled():
+            return gate.occupancy()
         shed = getattr(self.server, "request_shedder", None)
         if shed is None:
             return 0.0
@@ -125,7 +131,10 @@ class MaintenanceWorker:
         self.last_job = {"id": job["id"], "type": job["type"],
                          "volume": job["volume"]}
         try:
-            report = self._execute(job)
+            # curator jobs run (and fan out RPCs) as background-class
+            # maintenance traffic: peers admit them behind foreground
+            with qos.qos_scope(qos.BACKGROUND, tenant="maintenance"):
+                report = self._execute(job)
             metrics.MaintJobSecondsHistogram.labels(job["type"]) \
                 .observe(time.perf_counter() - t0)
             self.executed += 1

@@ -17,7 +17,7 @@ import time
 import urllib.parse
 from typing import Optional
 
-from .. import profiling, tracing
+from .. import profiling, qos, tracing
 from ..rpc.http_rpc import RpcError, RpcServer, call
 from ..security import Guard, gen_write_jwt
 from ..stats import metrics as stats
@@ -253,6 +253,7 @@ class MasterServer:
         s.add("GET", "/debug/traces", tracing.traces_handler)
         faults.mount(s)
         profiling.mount(s)
+        qos.mount(s)  # quota/lane state; assigns are metered, not queued
         s.add("POST", "/raft/request_vote",
               lambda r: self.raft.handle_request_vote(r.json()))
         s.add("POST", "/raft/append_entries",
@@ -373,6 +374,13 @@ class MasterServer:
         rp = ReplicaPlacement.parse(replication)
         ttl = TTL.parse(ttl_s)
 
+        # per-collection ops quota: meter assigns before topology work
+        # so a runaway writer can't starve other collections' growth
+        if qos.enabled() and not qos.QUOTAS.allow(collection,
+                                                  ops=float(count)):
+            raise RpcError(
+                f"collection {collection!r} over its assign quota", 503,
+                headers={"Retry-After": qos.retry_after(1, 3)})
         rp_byte, ttl_u32 = rp.to_byte(), ttl.to_uint32()
         if self.topo.writable_count(collection, rp_byte, ttl_u32) == 0:
             self._grow(collection, rp, ttl, only_if_needed=True)
@@ -380,11 +388,12 @@ class MasterServer:
         if picked is None:
             # assign drought is a transient overload (growth may still
             # be racing ahead), not a missing resource: shed with 503 +
-            # Retry-After so policy-aware writers back off and retry
+            # a jittered Retry-After so policy-aware writers back off
+            # without re-arriving in one synchronized wave
             raise RpcError(
                 "no writable volumes", 503,
-                headers={"Retry-After": str(max(
-                    1, int(self.topo.pulse_seconds)))})
+                headers={"Retry-After": qos.retry_after(
+                    1, max(1, int(self.topo.pulse_seconds)))})
         vid, locations = picked
         key, _ = self.topo.assign_file_id(count)
         cookie = random.getrandbits(32)

@@ -268,6 +268,10 @@ class DevicePool:
             self._evictions_published = self.evictions
 
     def snapshot(self) -> dict:
+        # the QoS device lanes gate dispatch INTO this pool's slots, so
+        # their state belongs in the same observability snapshot
+        from ..qos.lanes import LANES
+
         with self._lock:
             self._note_occupancy_locked()
             return {
@@ -285,6 +289,7 @@ class DevicePool:
                 "evictions": self.evictions,
                 "h2d_bytes": self.h2d_bytes,
                 "d2h_bytes": self.d2h_bytes,
+                "lanes": LANES.snapshot(),
             }
 
     def clear(self):

@@ -49,6 +49,7 @@ from typing import Optional
 import numpy as np
 
 from .. import profiling
+from ..qos import lanes as _lanes
 
 DATA_SHARDS = 10
 PARITY_SHARDS = 4
@@ -729,6 +730,13 @@ def _encode_units_device(plans, units, chunk, writers, mesh,
                 break
             slot, batch, k_max = item
             buf = slot.payload
+            # background device lane: bulk encode yields to in-flight
+            # foreground (degraded-read recover) decodes per batch
+            lane_wait = _lanes.LANES.background_checkpoint()
+            if lane_wait:
+                with io.tlock:
+                    timers["lane_wait"] = timers.get("lane_wait", 0.0) \
+                        + lane_wait
             t0 = time.perf_counter()
             if host_crc:
                 out = None

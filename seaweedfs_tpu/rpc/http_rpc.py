@@ -24,6 +24,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
 
 from .. import tracing
+from ..qos import classify as _qos
 from ..stats import metrics as _stats
 from ..util import faults as _faults
 
@@ -327,6 +328,13 @@ class RpcServer:
                 service = outer.service_name
                 sp = tracing.from_headers(f"{method} {label}", service,
                                           self.headers)
+                # install the caller's QoS context (class + tenant) for
+                # the handler's duration, exactly like the deadline; tag
+                # the dispatch span so profiler route shares separate
+                # background from foreground CPU time
+                qcls, qtenant = _qos.from_headers(self.headers)
+                tracing.tag_qos(sp, qcls, qtenant)
+                prev_qos = _qos.set_qos(qcls, qtenant)
                 src = self.headers.get(tracing.SRC_HEADER) or "client"
                 outer._inflight.inc()
                 t0 = time.perf_counter()
@@ -383,6 +391,7 @@ class RpcServer:
                                                 sp.trace_id)
                     self._reply(resp)
                 finally:
+                    _qos.set_qos(*prev_qos)
                     set_deadline(prev_dl)
                     tracing.restore(prev)
                     sp.finish()
@@ -750,7 +759,7 @@ def call(addr: str, path: str, payload: Optional[dict] = None,
     parse=False always returns the raw body — required when fetching
     stored object content whose mime may itself be application/json."""
     data = None
-    req_headers = tracing.inject(dict(headers or {}))
+    req_headers = _qos.inject(tracing.inject(dict(headers or {})))
     if raw is not None:
         data = raw
     elif payload is not None:
@@ -849,7 +858,10 @@ def call(addr: str, path: str, payload: Optional[dict] = None,
                 message = json.loads(body).get("error", body.decode())
             except Exception:
                 message = body.decode(errors="replace")
-            raise RpcError(message, status, addr=addr, route=path)
+            retry_after = resp.headers.get("Retry-After")
+            raise RpcError(message, status, addr=addr, route=path,
+                           headers={"Retry-After": retry_after}
+                           if retry_after else None)
         if parse and "application/json" in ctype:
             return json.loads(body) if body else {}
         return body
@@ -865,7 +877,7 @@ def call_stream(addr: str, path: str, payload: Optional[dict] = None,
     Errors before the first byte raise RpcError like call()."""
     url = f"http://{addr}{path}"
     data = None
-    req_headers = tracing.inject(dict(headers or {}))
+    req_headers = _qos.inject(tracing.inject(dict(headers or {})))
     if payload is not None:
         data = json.dumps(payload).encode()
         req_headers["Content-Type"] = "application/json"

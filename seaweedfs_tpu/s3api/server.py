@@ -25,7 +25,7 @@ from ..filer.filechunk_manifest import (has_chunk_manifest,
                                         resolve_chunk_manifest)
 from ..filer.filer_store import NotFoundError
 from ..filer.server import FilerServer
-from .. import profiling, tracing
+from .. import profiling, qos, tracing
 from ..rpc.http_rpc import Request, Response, RpcError, RpcServer
 from ..stats import metrics as stats
 from ..util import faults
@@ -135,6 +135,11 @@ class S3ApiServer:
         self.server.add("GET", "/debug/traces", tracing.traces_handler)
         faults.mount(self.server)
         profiling.mount(self.server)
+        # weighted-fair front-end admission; the S3 access key is the
+        # tenant key (WEED_QOS_S3_LIMIT; 0 = classify/count only)
+        self.qos_gate = qos.AdmissionGate("s3",
+                                          limit_env="WEED_QOS_S3_LIMIT")
+        qos.mount(self.server, gate=self.qos_gate)
         self.server.default_route = self._handle
         self._stop_event = threading.Event()
         self._register_thread: Optional[threading.Thread] = None
@@ -197,9 +202,12 @@ class S3ApiServer:
             except AuthError as e:
                 resp = _error_xml(e.code, str(e), e.status)
             except SlowDown as e:
-                # retryable shed: tell SDK retry layers when to come back
-                resp = _error_xml("SlowDown", str(e), 503,
-                                  headers={"Retry-After": "1"})
+                # retryable shed: tell SDK retry layers when to come
+                # back — jittered so shed clients don't re-arrive in
+                # one synchronized wave
+                resp = _error_xml(
+                    "SlowDown", str(e), 503,
+                    headers={"Retry-After": qos.retry_after(1, 3)})
             except NotFoundError as e:
                 resp = _error_xml("NoSuchKey", str(e), 404)
         code = resp.status if isinstance(resp, Response) else 200
@@ -233,19 +241,46 @@ class S3ApiServer:
             raise AuthError("AccessDenied",
                             f"{action} not allowed on {bucket}", 403)
 
-        release = self.circuit_breaker.acquire(
-            bucket, "Read" if action in (ACTION_READ, ACTION_LIST)
-            else "Write", len(req.body or b""))
+        qos_release = None
+        prev_qos = None
+        if qos.enabled():
+            # tenant = S3 access key (fall back to the bucket); reads
+            # classify interactive, writes standard, both overridable
+            # per tenant via WEED_QOS_CLASS_MAP
+            tenant = (identity.access_key if identity is not None
+                      else bucket)
+            cls = qos.INTERACTIVE \
+                if action in (ACTION_READ, ACTION_LIST) else qos.STANDARD
+            cls = qos.class_for_tenant(tenant, cls)
+            try:
+                qos_release = self.qos_gate.admit(cls, tenant)
+            except RpcError as e:
+                raise SlowDown(str(e)) from None
+            prev_qos = qos.set_qos(cls, tenant)
         try:
-            if not bucket:
-                if method == "GET":
-                    return self._list_buckets()
-                raise RpcError("bad request", 400)
-            if not key:
-                return self._bucket_op(method, bucket, req)
-            return self._object_op(method, bucket, key, req)
+            if prev_qos is not None and method == "PUT" and key \
+                    and not qos.QUOTAS.allow(
+                        bucket, ops=1, nbytes=len(req.body or b"")):
+                raise SlowDown(
+                    f"collection {bucket!r} over its byte/ops quota")
+            release = self.circuit_breaker.acquire(
+                bucket, "Read" if action in (ACTION_READ, ACTION_LIST)
+                else "Write", len(req.body or b""))
+            try:
+                if not bucket:
+                    if method == "GET":
+                        return self._list_buckets()
+                    raise RpcError("bad request", 400)
+                if not key:
+                    return self._bucket_op(method, bucket, req)
+                return self._object_op(method, bucket, key, req)
+            finally:
+                release()
         finally:
-            release()
+            if prev_qos is not None:
+                qos.set_qos(*prev_qos)
+            if qos_release is not None:
+                qos_release()
 
     # -- buckets -------------------------------------------------------------
     def _bucket_path(self, bucket: str) -> str:

@@ -481,6 +481,48 @@ MaintPacerRateGauge = REGISTRY.gauge(
     "effective maintenance byte rate after foreground-load backoff")
 
 
+# -- cluster QoS: tenant-aware admission, weighted-fair queues, and the
+# foreground/background device lanes ----------------------------------------
+QosRequestsCounter = REGISTRY.counter(
+    "SeaweedFS_qos_requests_total",
+    "front-end requests by QoS class and admission outcome",
+    ("service", "class", "outcome"))
+QosInflightGauge = REGISTRY.gauge(
+    "SeaweedFS_qos_inflight",
+    "admitted in-flight requests per QoS class",
+    ("service", "class"))
+QosQueueDepthGauge = REGISTRY.gauge(
+    "SeaweedFS_qos_queue_depth",
+    "requests parked in the weighted-fair queues per QoS class",
+    ("service", "class"))
+QosQueueWaitHistogram = REGISTRY.histogram(
+    "SeaweedFS_qos_queue_wait_seconds",
+    "time a request spent queued before dispatch or shed",
+    ("class",))
+QosTenantThrottledCounter = REGISTRY.counter(
+    "SeaweedFS_qos_tenant_throttled_total",
+    "requests denied by per-tenant token buckets",
+    ("service", "class"))
+QosQuotaRejectsCounter = REGISTRY.counter(
+    "SeaweedFS_qos_quota_rejects_total",
+    "assigns/uploads denied by per-collection quotas, by resource kind",
+    ("kind",))
+QosLaneActiveGauge = REGISTRY.gauge(
+    "SeaweedFS_qos_lane_active",
+    "device-lane work items currently active, by lane",
+    ("lane",))
+QosLaneBatchesCounter = REGISTRY.counter(
+    "SeaweedFS_qos_lane_batches_total",
+    "device batches dispatched, by lane",
+    ("lane",))
+QosLanePreemptionsCounter = REGISTRY.counter(
+    "SeaweedFS_qos_lane_preemptions_total",
+    "background device batches stalled behind foreground decodes")
+QosLaneWaitSecondsCounter = REGISTRY.counter(
+    "SeaweedFS_qos_lane_wait_seconds_total",
+    "cumulative seconds background batches waited on the foreground lane")
+
+
 # -- process self-metrics (the reference's Go runtime collectors:
 # prometheus.NewGoCollector/NewProcessCollector) -----------------------------
 _PROCESS_START = time.time()
@@ -559,7 +601,7 @@ def start_metrics_server(host: str = "127.0.0.1",
     -metricsPort; stats/metrics.go StartMetricsServer).  Daemons whose
     main port serves a user namespace (filer paths, s3 buckets) cannot
     mount /metrics there without shadowing user data."""
-    from .. import profiling, tracing
+    from .. import profiling, qos, tracing
     from ..rpc.http_rpc import RpcServer
     from ..util import faults
 
@@ -568,5 +610,6 @@ def start_metrics_server(host: str = "127.0.0.1",
     server.add("GET", "/debug/traces", tracing.traces_handler)
     faults.mount(server)
     profiling.mount(server)
+    qos.mount(server)
     server.start()
     return server

@@ -397,18 +397,24 @@ class EcVolume:
         if len(shards) < DATA_SHARDS_COUNT and remote_candidates:
             import concurrent.futures as cf
 
+            from ...qos import classify as qos_classify
             from ...rpc.http_rpc import current_deadline, set_deadline
 
             # pool workers don't share this thread's locals: pin the
-            # caller's propagated deadline on each fetch so survivor
-            # RPCs stay inside the budget the client handed us
+            # caller's propagated deadline and QoS context on each fetch
+            # so survivor RPCs stay inside the budget the client handed
+            # us and keep their class downstream
             dl = current_deadline()
+            qctx = (qos_classify.current_class(),
+                    qos_classify.current_tenant())
 
             def fetch(sid: int):
                 prev = set_deadline(dl)
+                prev_q = qos_classify.set_qos(*qctx)
                 try:
                     return self.remote_reader(sid, offset, size)
                 finally:
+                    qos_classify.set_qos(*prev_q)
                     set_deadline(prev)
 
             pool = _recover_pool()

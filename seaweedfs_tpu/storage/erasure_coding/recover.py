@@ -341,6 +341,8 @@ class SpanDecodeBatcher:
 
     def _decode_batch(self, survivors: tuple, target: int,
                       batch: list[_DecodeReq]) -> list[np.ndarray]:
+        from ...qos.lanes import LANES
+
         sp = tracing.start("ec.recover.decode", tags={"spans": len(batch)})
         prev = tracing.swap(sp)
         try:
@@ -348,7 +350,11 @@ class SpanDecodeBatcher:
                 stacked = batch[0].inputs
             else:
                 stacked = np.concatenate([r.inputs for r in batch], axis=1)
-            out = self._decode_fn(survivors, target, stacked)
+            # foreground device lane: while this decode runs, queued
+            # background batches (scrub re-encode, bulk encode) yield
+            # at their next checkpoint
+            with LANES.foreground():
+                out = self._decode_fn(survivors, target, stacked)
             outs = []
             col = 0
             for r in batch:

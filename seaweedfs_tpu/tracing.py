@@ -235,6 +235,20 @@ def from_headers(name: str, service: str, headers) -> Span:
                 random.random() < sample_rate(), True)
 
 
+def tag_qos(span: Span, qos_class: str, tenant: str = "") -> None:
+    """Stamp a span with its QoS class.  Background spans get a route
+    suffix so the profiler's per-route sample shares (and `weed.py
+    profile`) separate background CPU time — replication fan-out,
+    curator jobs, deep scrub — from foreground request handling.
+    Children inherit the suffixed route via start()."""
+    if qos_class and qos_class != "standard":
+        span.set_tag("qos_class", qos_class)
+    if tenant:
+        span.set_tag("qos_tenant", tenant)
+    if qos_class == "background" and not span.route.endswith(" [bg]"):
+        span.route = span.route + " [bg]"
+
+
 def inject(headers: dict, span: Optional[Span] = None) -> dict:
     """Stamp the propagation headers for an outbound call (no-op when
     the calling thread carries no span)."""

@@ -23,7 +23,7 @@ import threading
 import time
 from typing import Optional
 
-from .. import profiling, tracing
+from .. import profiling, qos, tracing
 from ..rpc import policy
 from ..rpc.http_rpc import (Request, Response, RpcError, RpcServer, call,
                             call_stream, stream_file)
@@ -209,6 +209,13 @@ class VolumeServer:
         self.upload_gate = _InflightGate(upload_limit_mb << 20)
         self.download_gate = _InflightGate(download_limit_mb << 20)
         self.request_shedder = _RequestShedder(max_inflight_requests)
+        # weighted-fair admission over the same limit; WEED_QOS=0 falls
+        # back to the flat shedder above (WEED_VS_MAX_INFLIGHT is the
+        # deprecated alias for WEED_QOS_VS_LIMIT)
+        self.qos_gate = qos.AdmissionGate(
+            "volume", limit_env="WEED_QOS_VS_LIMIT",
+            fallback_env="WEED_VS_MAX_INFLIGHT",
+            default_limit=max_inflight_requests)
         self.enable_tcp = enable_tcp
         self._tcp_sock = None
         # tier backends must be registered before Store discovery so
@@ -636,6 +643,7 @@ class VolumeServer:
         s.add("GET", "/debug/traces", tracing.traces_handler)
         faults.mount(s)
         profiling.mount(s)
+        qos.mount(s, gate=self.qos_gate)
         s.add("GET", "/ui", self._h_ui)
         s.default_route = self._handle_object
 
@@ -796,11 +804,28 @@ class VolumeServer:
 
     # -- public object API ---------------------------------------------------
     def _handle_object(self, method: str, req: Request):
+        if qos.enabled():
+            # class/tenant installed by the dispatch loop from the
+            # X-QoS-* headers; unclassified reads count as interactive
+            # so foreground GETs outrank queued background work
+            cls = qos.current_class()
+            if qos.QOS_HEADER not in req.headers \
+                    and method in ("GET", "HEAD"):
+                cls = qos.INTERACTIVE
+            try:
+                release = self.qos_gate.admit(cls)
+            except RpcError:
+                stats.VolumeServerThrottleRejects.labels("inflight").inc()
+                raise
+            try:
+                return self._handle_object_inner(method, req)
+            finally:
+                release()
         if not self.request_shedder.try_acquire():
             stats.VolumeServerThrottleRejects.labels("inflight").inc()
             raise RpcError(
                 "too many requests: inflight limit", 503,
-                headers={"Retry-After": "1"})
+                headers={"Retry-After": qos.retry_after(1, 3)})
         try:
             return self._handle_object_inner(method, req)
         finally:
@@ -1054,7 +1079,10 @@ class VolumeServer:
         if not others:
             return
         with tracing.span("needle.replicate",
-                          tags={"fid": fid, "replicas": len(others)}):
+                          tags={"fid": fid, "replicas": len(others)}), \
+                qos.qos_scope(qos.BACKGROUND):
+            # replication fan-out is auto-tagged background: replicas
+            # admit it behind their own foreground traffic
             for url in others:
                 # breaker-guarded, retried fan-out: type=replicate is
                 # idempotent (unchanged-content writes dedup), so a

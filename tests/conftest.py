@@ -42,14 +42,6 @@ def reference_fixture(relpath):
     return p if os.path.exists(p) else None
 
 
-def pytest_configure(config):
-    config.addinivalue_line(
-        "markers", "slow: long-running tests, excluded from tier-1 "
-        "(-m 'not slow')")
-    config.addinivalue_line(
-        "markers", "chaos: fault-injection soak tests over a live "
-        "mini-cluster")
-    config.addinivalue_line(
-        "markers", "perf_smoke: fast structural checks of the gateway "
-        "fast path (assign amortization, streamed reads) — asserts "
-        "request shape, not wall-clock throughput")
+# Custom markers are registered in pytest.ini (the shared config) —
+# tests/test_markers_registered.py fails tier-1 if a test file uses a
+# marker that is not listed there.

@@ -1,0 +1,40 @@
+"""Tier-1 guard: every @pytest.mark.<name> used by the suite must be
+registered in pytest.ini.
+
+An unregistered marker is how a slow/chaos test silently lands in the
+wrong tier — `-m 'not slow'` can only exclude marks pytest knows
+about.  pytest.ini also sets --strict-markers (typos fail at
+collection); this test guards the other direction by scanning the
+sources, so a marker added in a branch that never runs on this box
+still gets caught."""
+
+import os
+import re
+
+# marks pytest itself defines; these need no [pytest] markers entry
+BUILTIN_MARKS = {
+    "parametrize", "skip", "skipif", "xfail", "usefixtures",
+    "filterwarnings", "tryfirst", "trylast", "anyio", "asyncio",
+}
+
+MARK_RE = re.compile(r"pytest\.mark\.([A-Za-z_][A-Za-z0-9_]*)")
+
+
+def test_all_marks_used_by_the_suite_are_registered(request):
+    registered = {line.split(":", 1)[0].strip()
+                  for line in request.config.getini("markers")}
+    tests_dir = os.path.dirname(os.path.abspath(__file__))
+    used = {}  # mark -> first file seen
+    for name in sorted(os.listdir(tests_dir)):
+        if not name.endswith(".py"):
+            continue
+        with open(os.path.join(tests_dir, name)) as f:
+            for mark in MARK_RE.findall(f.read()):
+                used.setdefault(mark, name)
+    unregistered = {m: f for m, f in used.items()
+                    if m not in BUILTIN_MARKS and m not in registered}
+    assert not unregistered, (
+        f"markers used but not registered in pytest.ini: {unregistered} "
+        f"— add them to the [pytest] markers list")
+    # the tiers this repo's driver relies on must stay registered
+    assert {"slow", "chaos", "perf_smoke", "qos"} <= registered

@@ -341,6 +341,7 @@ def _shell_handlers(env):
     from seaweedfs_tpu.shell import commands as sh
     from seaweedfs_tpu.shell import commands_fs as fs
     from seaweedfs_tpu.shell import commands_maintenance as mnt
+    from seaweedfs_tpu.shell import commands_qos as qos_cmds
     from seaweedfs_tpu.shell import commands_remote as rem
     from seaweedfs_tpu.shell import commands_volume as vol
 
@@ -440,6 +441,8 @@ def _shell_handlers(env):
             env, job_type=flag(a, "type"),
             volume=int(flag(a, "volume", "0") or 0),
             collection=flag(a, "collection", ""))),
+        # qos — cluster-wide /debug/qos rollup
+        "qos.status": lambda a: show(qos_cmds.qos_status(env)),
         # collection / cluster
         "collection.list": lambda a: show(vol.collection_list(env)),
         "collection.delete": lambda a: show(vol.collection_delete(
