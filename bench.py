@@ -674,6 +674,77 @@ def bench_ec_degraded_read(num_files: int = 2000,
         shutil.rmtree(workdir, ignore_errors=True)
 
 
+def bench_ec_rebuild(data_bytes: int = 24 << 20) -> dict:
+    """Repair-optimal rebuilds across the coding tier: encode the same
+    volume with every registered code family, delete ONE data shard, run
+    the family's planned rebuild, and report bytes-read-per-rebuilt-byte
+    (read amplification) plus throughputs.  RS/Cauchy decode plans read
+    k=10 full survivors (amp 10.0); pm_msr's projection repair reads
+    1/alpha of d=8 helpers (amp 2.0) — the regenerating-code claim is
+    the read_amp_vs_rs <= 0.6 line.  Rebuilt bytes are CRC-verified
+    against the encode-time record, so the amp numbers only count when
+    the repair is byte-exact."""
+    import tempfile
+
+    from seaweedfs_tpu.storage.erasure_coding import to_ext
+    from seaweedfs_tpu.storage.erasure_coding.codes import (
+        family_names, get_family)
+    from seaweedfs_tpu.storage.erasure_coding.encoder import (
+        rebuild_ec_files, write_ec_files)
+    from seaweedfs_tpu.storage.tools import shard_file_crc32c
+
+    workdir = tempfile.mkdtemp(prefix="swbench_ecrb_")
+    rng = np.random.default_rng(0x5EA)
+    payload = rng.integers(0, 256, data_bytes, dtype=np.uint8).tobytes()
+    families: dict[str, dict] = {}
+    lost = 0  # a data shard: the worst case for every family's planner
+    try:
+        for name in family_names():
+            fam = get_family(name)
+            base = os.path.join(workdir, name, "v1")
+            os.makedirs(os.path.dirname(base), exist_ok=True)
+            with open(base + ".dat", "wb") as f:
+                f.write(payload)
+            t0 = time.perf_counter()
+            write_ec_files(base, family=fam,
+                           large_block_size=1 << 20,
+                           small_block_size=64 << 10)
+            enc_s = time.perf_counter() - t0
+            want = shard_file_crc32c(base + to_ext(lost))
+            os.remove(base + to_ext(lost))
+            stats: dict = {}
+            t0 = time.perf_counter()
+            crcs = rebuild_ec_files(base, family=fam, stats=stats)
+            reb_s = time.perf_counter() - t0
+            families[name] = {
+                "plan": stats["plan"],
+                "read_amp": stats["read_amp"],
+                "read_mib": round(stats["read_bytes"] / (1 << 20), 2),
+                "rebuilt_mib": round(stats["rebuilt_bytes"] / (1 << 20), 2),
+                "rebuild_mib_s": round(
+                    stats["rebuilt_bytes"] / reb_s / (1 << 20), 1)
+                    if reb_s else 0.0,
+                "encode_mib_s": round(
+                    data_bytes / enc_s / (1 << 20), 1) if enc_s else 0.0,
+                "crc_ok": crcs.get(lost) == want,
+            }
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+    rs_amp = families.get("rs_vandermonde", {}).get("read_amp") or 0.0
+    for r in families.values():
+        r["read_amp_vs_rs"] = (round(r["read_amp"] / rs_amp, 3)
+                               if rs_amp else 0.0)
+    return {
+        "metric": "ec_rebuild_read_amp",
+        "unit": "bytes_read_per_rebuilt_byte",
+        "data_mib": round(data_bytes / (1 << 20), 1),
+        "lost_shard": lost,
+        "families": families,
+        "pm_msr_vs_rs_read_amp":
+            families.get("pm_msr", {}).get("read_amp_vs_rs", 0.0),
+    }
+
+
 def bench_qos_isolation(num_files: int = 800, read_reqs: int = 3000,
                         scrub_vols: int = 3,
                         scrub_vol_bytes: int = 8 << 20) -> dict:
@@ -1334,6 +1405,13 @@ def main():
     except Exception as e:
         print(f"note: qos isolation bench failed: {e}", file=sys.stderr)
 
+    # -- coding-tier rebuild read amplification ------------------------------
+    ec_rebuild_stats: dict = {}
+    try:
+        ec_rebuild_stats = bench_ec_rebuild()
+    except Exception as e:
+        print(f"note: ec rebuild bench failed: {e}", file=sys.stderr)
+
     # -- S3 gateway vs filer data plane --------------------------------------
     s3_stats: dict = {}
     try:
@@ -1405,6 +1483,7 @@ def main():
         "ec_degraded_read_stages": deg_stages,
         "ec_degraded_read_error": deg_err,
         "qos_isolation": qos_iso,
+        "ec_rebuild": ec_rebuild_stats,
         "s3_put_rps": round(s3_stats.get("s3_put_rps", 0.0), 1),
         "s3_get_rps": round(s3_stats.get("s3_get_rps", 0.0), 1),
         "filer_put_rps": round(s3_stats.get("filer_put_rps", 0.0), 1),
@@ -1428,4 +1507,13 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    # single-phase mode: `python bench.py ec_rebuild` runs one phase and
+    # prints its JSON alone — the full suite stays the no-argument default
+    _phases = {"ec_rebuild": bench_ec_rebuild}
+    if len(sys.argv) > 1:
+        if sys.argv[1] not in _phases:
+            sys.exit(f"unknown bench phase {sys.argv[1]!r}; "
+                     f"one of: {', '.join(sorted(_phases))}")
+        print(json.dumps(_phases[sys.argv[1]]()))
+    else:
+        main()

@@ -29,6 +29,9 @@ class PathConf:
     fsync: bool = False
     read_only: bool = False
     max_file_name_length: int = 0
+    # erasure-coding code family for volumes in this collection
+    # ("rs_vandermonde" / "cauchy" / "pm_msr"; "" = cluster default)
+    ec_code: str = ""
     # s3.bucket.quota: MiB budget for the bucket this rule covers
     # (negative = configured but disabled); quota_read_only records that
     # read_only was set BY quota enforcement so it can be auto-cleared

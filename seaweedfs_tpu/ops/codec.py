@@ -143,7 +143,7 @@ def _apply_rows_host(rows: np.ndarray, inputs: np.ndarray) -> np.ndarray:
 def reconstruct_span(survivors, inputs: np.ndarray, target: int,
                      data_shards: int = 10,
                      total_shards: int = 14,
-                     slab_key=None) -> np.ndarray:
+                     slab_key=None, family=None) -> np.ndarray:
     """Target-row reconstruction: rebuild ONE shard's span from the
     (d, L) survivor stack via the cached decode plan — one GF mat-vec,
     never a full Reconstruct.  `inputs[i]` must be the span read from
@@ -157,8 +157,27 @@ def reconstruct_span(survivors, inputs: np.ndarray, target: int,
     device slab pool (ops/device_pool.py) keyed by (survivors, content):
     consecutive decodes against the same survivor spans — a different
     missing target, or a block re-recovered after LRU eviction — hit the
-    HBM-resident slab instead of re-uploading over the link."""
-    rows = decode_rows(data_shards, total_shards, survivors, (target,))
+    HBM-resident slab instead of re-uploading over the link.
+
+    family: an erasure_coding.codes CodeFamily.  None (or the RS default)
+    keeps the classic (total, data) path; other families supply their own
+    cached decode plan (each family's cheap inversion), and vector codes
+    (sub_shards > 1) run the same kernels over the lane-interleaved view
+    of the survivor stack."""
+    fam_name = getattr(family, "name", None)
+    if family is not None and fam_name != "rs_vandermonde":
+        rows = family.decode_rows(tuple(survivors), (target,))
+        to_dev = family.to_lanes(np.ascontiguousarray(inputs))
+        out_rows = len(rows)
+    else:
+        family = None
+        rows = decode_rows(data_shards, total_shards, survivors, (target,))
+        to_dev = inputs
+        out_rows = 1
+
+    def _finish(out: np.ndarray) -> np.ndarray:
+        return out[0] if family is None else family.from_lanes(out)[0]
+
     if inputs.nbytes >= recover_device_min_bytes() \
             and recover_device_enabled():
         try:
@@ -170,27 +189,28 @@ def reconstruct_span(survivors, inputs: np.ndarray, target: int,
             method = "pallas" if on_tpu() else "swar"
             if slab_key is not None:
                 pool = get_pool()
-                key = ("recover", tuple(survivors), slab_key)
+                key = ("recover", fam_name, tuple(survivors), slab_key)
 
                 def _upload():
-                    dev = jnp.asarray(inputs)
-                    pool.note_h2d(inputs.nbytes)
+                    dev = jnp.asarray(to_dev)
+                    pool.note_h2d(to_dev.nbytes)
                     return dev
 
                 dev_in = pool.acquire_resident(key, _upload,
-                                               inputs.nbytes)
+                                               to_dev.nbytes)
                 try:
                     out = np.asarray(apply_matrix(
-                        np.asarray(rows), dev_in, method=method))[0]
+                        np.asarray(rows), dev_in,
+                        method=method))[:out_rows]
                 finally:
                     pool.release_resident(key)
                 pool.note_d2h(out.nbytes)
-                return out
-            return np.asarray(apply_matrix(
-                np.asarray(rows), inputs, method=method))[0]
+                return _finish(out)
+            return _finish(np.asarray(apply_matrix(
+                np.asarray(rows), to_dev, method=method))[:out_rows])
         except Exception:
             pass  # device hiccup mid-incident: the host path always works
-    return _apply_rows_host(rows, inputs)[0]
+    return _finish(_apply_rows_host(rows, to_dev)[:out_rows])
 
 
 def new_host_encoder(data_shards: int = 10, parity_shards: int = 4):

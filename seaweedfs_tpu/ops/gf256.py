@@ -168,6 +168,60 @@ def parity_matrix(data_shards: int, total_shards: int) -> np.ndarray:
     return build_matrix(data_shards, total_shards)[data_shards:]
 
 
+def cauchy_matrix(xs: tuple[int, ...], ys: tuple[int, ...]) -> np.ndarray:
+    """Cauchy matrix C[i, j] = 1 / (xs[i] + ys[j]) over GF(2^8).
+
+    Requires xs and ys to be disjoint (so no denominator is zero); any square
+    submatrix of a Cauchy matrix is then invertible, which makes [I; C] an MDS
+    generator matrix.
+    """
+    if set(xs) & set(ys):
+        raise ValueError("cauchy_matrix: xs and ys must be disjoint")
+    c = np.zeros((len(xs), len(ys)), dtype=np.uint8)
+    for i, x in enumerate(xs):
+        for j, y in enumerate(ys):
+            c[i, j] = gf_inverse(x ^ y)
+    return c
+
+
+@functools.lru_cache(maxsize=32)
+def build_cauchy_matrix(data_shards: int, total_shards: int) -> np.ndarray:
+    """Systematic [I; C] generator with ys = 0..data-1, xs = data..total-1."""
+    ys = tuple(range(data_shards))
+    xs = tuple(range(data_shards, total_shards))
+    m = np.concatenate([gf_identity(data_shards), cauchy_matrix(xs, ys)])
+    m.setflags(write=False)
+    return m
+
+
+def cauchy_inverse(xs: tuple[int, ...], ys: tuple[int, ...]) -> np.ndarray:
+    """Closed-form inverse of the square Cauchy matrix C[i, j] = 1/(xs[i]+ys[j]).
+
+    B[j, i] = prod_k(xs[i]+ys[k]) * prod_k(xs[k]+ys[j])
+              / ((xs[i]+ys[j]) * prod_{k!=i}(xs[i]+xs[k]) * prod_{k!=j}(ys[j]+ys[k]))
+
+    O(e^2) per matrix after O(e^2) prefix products — no Gauss-Jordan sweep.
+    """
+    e = len(xs)
+    if len(ys) != e:
+        raise ValueError("cauchy_inverse: needs a square system")
+    inv = np.zeros((e, e), dtype=np.uint8)
+    for i in range(e):
+        for j in range(e):
+            num = 1
+            for k in range(e):
+                num = gf_mul(num, xs[i] ^ ys[k])
+                num = gf_mul(num, xs[k] ^ ys[j])
+            den = xs[i] ^ ys[j]
+            for k in range(e):
+                if k != i:
+                    den = gf_mul(den, xs[i] ^ xs[k])
+                if k != j:
+                    den = gf_mul(den, ys[j] ^ ys[k])
+            inv[j, i] = gf_div(num, den)
+    return inv
+
+
 # ---------------------------------------------------------------------------
 # GF(2) bit-matrix form: every GF(2^8) linear map is linear over GF(2).
 # Used by the TPU MXU kernel (XOR == addition mod 2 == int matmul + mod 2).

@@ -110,7 +110,8 @@ _PARITY_STEP_CACHE: dict = {}
 
 
 def make_parity_step(mesh: Mesh, data_shards: int = 10,
-                     parity_shards: int = 4):
+                     parity_shards: int = 4,
+                     matrix=None, key=None):
     """Persistent parity-only step for the pooled device dispatch path:
     (data32 (k, B, W) int32 packed bytes, out (p, B, W) int32 DONATED)
     -> (p, B, W) int32 parity words.
@@ -128,14 +129,27 @@ def make_parity_step(mesh: Mesh, data_shards: int = 10,
 
     One jitted callable per (mesh, geometry), shared across encode calls;
     XLA's shape-keyed trace cache handles the per-k retraces.
+
+    matrix / key: an alternative GF(2^8) coefficient matrix (a code
+    family's parity or lane generator rows) with an optional hashable
+    cache identity (e.g. the family name); omitted, the classic RS
+    Vandermonde parity rows are built.  Nothing else about the step —
+    donation, sharding, the SWAR bit-plane kernel — changes, so every
+    code family rides the same persistent jitted dispatch.
     """
     from ..ops.rs_jax import _SPREAD, _bit_constants_cached
 
-    cache_key = (mesh, data_shards, parity_shards)
+    if matrix is None:
+        cache_key = (mesh, data_shards, parity_shards)
+    else:
+        matrix = np.ascontiguousarray(matrix, dtype=np.uint8)
+        cache_key = (mesh, key if key is not None else matrix.tobytes())
     cached = _PARITY_STEP_CACHE.get(cache_key)
     if cached is not None:
         return cached
-    matrix = gf256.parity_matrix(data_shards, data_shards + parity_shards)
+    if matrix is None:
+        matrix = gf256.parity_matrix(data_shards,
+                                     data_shards + parity_shards)
     consts = jnp.asarray(_bit_constants_cached(*_matrix_key(matrix)))
 
     def _parity(data32, out):

@@ -195,6 +195,23 @@ def ec_encode_auto(env: CommandEnv, collection: str = "",
             for vid in vids]
 
 
+def _collection_ec_code(env: CommandEnv, collection: str) -> str:
+    """The ``ec_code`` of the filer path-config rule that targets this
+    collection (fs.configure -ecCode), "" when no filer / no rule.  The
+    env-var overrides still win — the volume server's policy resolution
+    (codes.family_for_collection) checks them first."""
+    try:
+        from ..filer.filer_conf import FILER_CONF_PATH
+        from .commands_fs import _get_json_config, find_filer
+        conf = _get_json_config(find_filer(env), FILER_CONF_PATH)
+    except Exception:  # no filer in this deployment, or conf unreadable
+        return ""
+    for loc in conf.get("locations", []):
+        if loc.get("collection", "") == collection and loc.get("ec_code"):
+            return loc["ec_code"]
+    return ""
+
+
 def ec_encode(env: CommandEnv, vid: int, collection: str = "",
               plan_only: bool = False) -> dict:
     lookup = env.master(f"/dir/lookup?volumeId={vid}")
@@ -216,8 +233,14 @@ def ec_encode(env: CommandEnv, vid: int, collection: str = "",
     # 1. freeze writes on every replica
     for url in locations:
         call(url, "/admin/readonly", {"volume": vid, "readonly": True})
-    # 2. generate the 14 shard files + .ecx on the source (TPU encode)
-    call(source, "/admin/ec/generate", {"volume": vid}, timeout=3600)
+    # 2. generate the 14 shard files + .ecx on the source (TPU encode);
+    # the filer's per-collection ec_code rule rides along so the volume
+    # server's policy resolution sees the path-config layer too
+    payload: dict = {"volume": vid}
+    ec_code = _collection_ec_code(env, collection)
+    if ec_code:
+        payload["code_family"] = ec_code
+    call(source, "/admin/ec/generate", payload, timeout=3600)
     # 3/4. spread + mount
     for url, shard_ids in allocation.items():
         if url != source:
@@ -291,6 +314,31 @@ def ec_decode(env: CommandEnv, vid: int, collection: str = "",
 # -- ec.rebuild --------------------------------------------------------------
 
 
+def _volume_family_info(vid: int, shard_locations: dict[int, list[str]]
+                        ) -> dict:
+    """Ask any shard holder which code family the volume was encoded with
+    (served from its .vif record via /admin/ec/codes).  Holders predating
+    the coding tier, or unreachable ones, fall back to the RS default so
+    mixed clusters keep rebuilding the way they always did."""
+    fallback = {"family": "rs_vandermonde",
+                "data_shards": TOTAL_SHARDS_COUNT - 4, "repair_helpers": 0}
+    holders = sorted({u for urls in shard_locations.values() for u in urls})
+    for url in holders:
+        try:
+            info = call(url, f"/admin/ec/codes?volume={vid}")
+        except (RpcError, OSError):
+            continue
+        vol = (info.get("volumes") or {}).get(str(vid))
+        if not vol:
+            continue
+        fam = (info.get("families") or {}).get(vol.get("family", ""), {})
+        return {"family": vol.get("family", fallback["family"]),
+                "data_shards": fam.get("data_shards",
+                                       fallback["data_shards"]),
+                "repair_helpers": fam.get("repair_helpers", 0)}
+    return fallback
+
+
 def ec_rebuild(env: CommandEnv, vid: int, collection: str = "",
                plan_only: bool = False) -> dict:
     lookup = env.master(f"/ec/lookup?volumeId={vid}")
@@ -302,18 +350,54 @@ def ec_rebuild(env: CommandEnv, vid: int, collection: str = "",
     missing = [s for s in range(TOTAL_SHARDS_COUNT) if s not in present]
     if not missing:
         return {"volume": vid, "missing": [], "rebuilder": None}
-    if len(present) < TOTAL_SHARDS_COUNT - 4:
+    fam = _volume_family_info(vid, shard_locations)
+    # repairability bound is the family's, not RS's: any MDS family decodes
+    # from data_shards survivors (pm_msr tolerates 9 losses, not 4)
+    if len(present) < fam["data_shards"]:
         raise RpcError(
-            f"ec volume {vid} has only {len(present)} shards, unrepairable",
+            f"ec volume {vid} has only {len(present)} shards "
+            f"({fam['family']} needs {fam['data_shards']}), unrepairable",
             500)
     nodes = collect_ec_nodes(env)
     rebuilder = max(nodes, key=lambda n: n.free_slots)
-    plan = {"volume": vid, "missing": missing, "rebuilder": rebuilder.url}
+    plan = {"volume": vid, "missing": missing, "rebuilder": rebuilder.url,
+            "family": fam["family"], "mode": "copy_decode"}
     if plan_only:
+        if (fam["repair_helpers"] and len(missing) == 1
+                and len(present) >= fam["repair_helpers"]):
+            plan["mode"] = "projection"
         return plan
 
-    # gather surviving shards on the rebuilder
     local = rebuilder.shards.get(vid, [])
+    if (fam["repair_helpers"] and len(missing) == 1
+            and len(present) >= fam["repair_helpers"]):
+        # repair-optimal path: helpers stream sub-shard projections, the
+        # rebuilder combines them — d/alpha of the lost bytes on the wire
+        # instead of data_shards full shards
+        try:
+            if not local:
+                # sidecars (.ecx/.vif) needed to mount + CRC-check the result
+                call(rebuilder.url, "/admin/ec/copy",
+                     {"volume": vid, "collection": collection,
+                      "shard_ids": [], "source": shard_locations[present[0]][0],
+                      "copy_ecx_file": True}, timeout=3600)
+            sources = [{"shard_id": sid, "url": shard_locations[sid][0]}
+                       for sid in present]
+            reply = call(rebuilder.url, "/admin/ec/rebuild_projected",
+                         {"volume": vid, "collection": collection,
+                          "shard": missing[0], "sources": sources},
+                         timeout=3600)
+            call(rebuilder.url, "/admin/ec/mount",
+                 {"volume": vid, "collection": collection,
+                  "shard_ids": missing})
+            plan.update(mode="projection",
+                        read_bytes=reply.get("read_bytes"),
+                        read_amp=reply.get("read_amp"))
+            return plan
+        except (RpcError, OSError):
+            pass  # older holders / transient failure: full copy-decode below
+
+    # gather surviving shards on the rebuilder
     for sid in present:
         if sid in local:
             continue
@@ -335,6 +419,46 @@ def ec_rebuild(env: CommandEnv, vid: int, collection: str = "",
              {"volume": vid, "collection": collection,
               "shard_ids": copied})
     return plan
+
+
+# -- ec.codes ----------------------------------------------------------------
+
+
+def ec_codes(env: CommandEnv, vid: Optional[int] = None) -> dict:
+    """Cluster view of the coding tier: registered families plus the
+    family each mounted EC volume was encoded with, fanned over every
+    volume server's /admin/ec/codes."""
+    topo = env.master("/dir/status")
+    urls = sorted({n["url"]
+                   for dc in topo.get("datacenters", [])
+                   for rack in dc.get("racks", [])
+                   for n in rack.get("nodes", [])})
+    path = "/admin/ec/codes" + (f"?volume={vid}" if vid is not None else "")
+    futs = {url: _fanout().submit(call, url, path, timeout=30)
+            for url in urls}
+    report: dict = {"families": {}, "default_family": None,
+                    "volumes": {}, "rebuild_read_amp": {}, "errors": []}
+    for url in sorted(futs):
+        try:
+            r = futs[url].result()
+        except (RpcError, OSError) as e:
+            report["errors"].append({"node": url, "error": str(e)})
+            continue
+        report["families"].update(r.get("families", {}))
+        report["default_family"] = (report["default_family"]
+                                    or r.get("default_family"))
+        for v, meta in (r.get("volumes") or {}).items():
+            entry = report["volumes"].setdefault(
+                v, {**meta, "shards": [], "holders": {}})
+            entry["holders"][url] = sorted(meta.get("shards", []))
+            entry["shards"] = sorted(
+                set(entry["shards"]) | set(meta.get("shards", [])))
+        if r.get("rebuild_read_amp"):
+            # per-node snapshots: rebuild counters live on the rebuilder
+            report["rebuild_read_amp"][url] = r["rebuild_read_amp"]
+    if not report["errors"]:
+        del report["errors"]
+    return report
 
 
 # -- ec.balance --------------------------------------------------------------
@@ -509,7 +633,13 @@ def ec_scrub(env: CommandEnv, vid: Optional[int] = None,
             report["errors"] = errors
         degraded = report["corrupt"] or missing
         if degraded and repair and not plan_only:
-            if len(clean_union) < 10:  # DATA_SHARDS intact copies needed
+            # rebuild needs the volume's family's data_shards intact
+            # copies (10 for RS/Cauchy, 5 for pm_msr)
+            shard_locations = {
+                e["shard_id"]: [loc["url"] for loc in e["locations"]]
+                for e in lookup.get("shard_id_locations", [])}
+            need = _volume_family_info(v, shard_locations)["data_shards"]
+            if len(clean_union) < need:
                 report["rebuild_error"] = (
                     f"only {len(clean_union)} clean shards — corrupt "
                     "copies left in place for manual recovery")

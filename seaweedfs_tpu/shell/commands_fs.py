@@ -286,6 +286,7 @@ def fs_configure(env: CommandEnv, location_prefix: str,
                  collection: str = "", replication: str = "",
                  ttl: str = "", read_only: Optional[bool] = None,
                  max_file_name_length: int = 0,
+                 ec_code: str = "",
                  delete: bool = False) -> dict:
     """command_fs_configure.go: edit the per-path rules stored at
     /etc/seaweedfs/filer.conf in the filer itself."""
@@ -313,6 +314,12 @@ def fs_configure(env: CommandEnv, location_prefix: str,
             rule["read_only"] = read_only
         if max_file_name_length:
             rule["max_file_name_length"] = max_file_name_length
+        if ec_code:
+            # validate before persisting: a typo'd family name must fail
+            # here, not at encode time months later
+            from ..storage.erasure_coding.codes import get_family
+            get_family(ec_code)
+            rule["ec_code"] = ec_code
         locations.append(rule)
     conf["locations"] = locations
     call(filer, FILER_CONF_PATH, raw=json.dumps(conf, indent=2).encode(),

@@ -479,6 +479,23 @@ MaintScrubbedBytesCounter = REGISTRY.counter(
 MaintPacerRateGauge = REGISTRY.gauge(
     "SeaweedFS_volumeServer_maintenance_pacer_bytes_per_second",
     "effective maintenance byte rate after foreground-load backoff")
+# repair-efficient coding tier (storage/erasure_coding/codes): rebuild
+# traffic by code family — read_bytes counts survivor bytes CONSUMED by
+# the rebuilder (post-projection for regenerating codes, i.e. what a
+# distributed rebuild moves over the network)
+MaintEcRebuildReadBytes = REGISTRY.counter(
+    "SeaweedFS_volumeServer_maintenance_ec_rebuild_read_bytes_total",
+    "survivor bytes consumed by EC rebuilds, by code family",
+    ("family",))
+MaintEcRebuildRebuiltBytes = REGISTRY.counter(
+    "SeaweedFS_volumeServer_maintenance_ec_rebuild_rebuilt_bytes_total",
+    "shard bytes written by EC rebuilds, by code family",
+    ("family",))
+MaintEcRebuildReadAmpGauge = REGISTRY.gauge(
+    "SeaweedFS_volumeServer_maintenance_ec_rebuild_read_amp",
+    "bytes read per rebuilt byte across this process's EC rebuilds, "
+    "by code family",
+    ("family",))
 
 
 # -- cluster QoS: tenant-aware admission, weighted-fair queues, and the

@@ -83,15 +83,18 @@ def find_dat_file_size(data_base_file_name: str,
 
 def write_dat_file(base_file_name: str, dat_file_size: int,
                    large_block_size: int = LARGE_BLOCK_SIZE,
-                   small_block_size: int = SMALL_BLOCK_SIZE):
-    """Reassemble .dat by interleaved copy of the 10 data shards
-    (WriteDatFile, ec_decoder.go:154-195)."""
+                   small_block_size: int = SMALL_BLOCK_SIZE,
+                   data_shards: int = DATA_SHARDS_COUNT):
+    """Reassemble .dat by interleaved copy of the data shards
+    (WriteDatFile, ec_decoder.go:154-195).  All code families are
+    systematic, so this is a pure copy regardless of family — only the
+    stripe width (``data_shards``) differs."""
     inputs = [open(base_file_name + to_ext(i), "rb")
-              for i in range(DATA_SHARDS_COUNT)]
+              for i in range(data_shards)]
     try:
         with open(base_file_name + ".dat", "wb") as dat:
             remaining = dat_file_size
-            while remaining >= DATA_SHARDS_COUNT * large_block_size:
+            while remaining >= data_shards * large_block_size:
                 for f in inputs:
                     block = f.read(large_block_size)
                     if len(block) != large_block_size:

@@ -93,6 +93,11 @@ class ShardBits:
     def __eq__(self, other):
         return isinstance(other, ShardBits) and self.bits == other.bits
 
+    def __hash__(self):
+        # __eq__ without __hash__ made instances unhashable (None __hash__),
+        # silently breaking set/dict membership; hash the identity __eq__ uses
+        return hash(self.bits)
+
     def __repr__(self):
         return f"ShardBits({self.shard_ids()})"
 
@@ -171,6 +176,13 @@ class EcVolume:
         self.shards: dict[int, EcVolumeShard] = {}
         self.shard_locations: dict[int, list[str]] = {}  # shard id -> addrs
         self.remote_reader: Optional[ShardReader] = None
+        # code family rides in .vif metadata: volumes encoded before the
+        # coding tier existed have no record and resolve to the RS default,
+        # so mixed clusters keep reading old volumes correctly
+        from .codes import get_family
+        from .encoder import load_volume_info
+        info = load_volume_info(self.base_file_name()) or {}
+        self.family = get_family(info.get("code_family"))
         # lazy: backend selection probes device availability, which must
         # not stall mount/admin paths — only reconstruction needs it
         self._encoder = encoder
@@ -244,8 +256,9 @@ class EcVolume:
             raise EcDeletedError(f"needle {needle_id:x} deleted")
         intervals = locate_data(
             self.large_block_size, self.small_block_size,
-            DATA_SHARDS_COUNT * self.shard_size,
-            offset, get_actual_size(size, self.version))
+            self.family.data_shards * self.shard_size,
+            offset, get_actual_size(size, self.version),
+            data_shards=self.family.data_shards)
         return offset, size, intervals
 
     def read_needle(self, needle_id: int,
@@ -261,7 +274,8 @@ class EcVolume:
 
     def _read_interval(self, iv: Interval) -> bytes:
         shard_id, inner_offset = iv.to_shard_id_and_offset(
-            self.large_block_size, self.small_block_size)
+            self.large_block_size, self.small_block_size,
+            data_shards=self.family.data_shards)
         return self.read_shard_span(shard_id, inner_offset, iv.size)
 
     def read_shard_span(self, shard_id: int, offset: int, size: int) -> bytes:
@@ -312,13 +326,20 @@ class EcVolume:
                       "size": size}) as sp:
             cache_bytes, block, coalesce = recover_knobs()
             shard_size = self.shard_size
+            # recovery units must be sub-shard-aligned so vector codes
+            # (alpha > 1) see whole interleaved lane groups; the KB-sized
+            # block knob is always a multiple of alpha already
+            align = self.family.sub_shards
             if block <= 0 or shard_size <= 0:
-                spans = [(offset, size)]
+                lo = (offset // align) * align
+                end = -(-(offset + size) // align) * align
+                spans = [(lo, end - lo)]
             else:
                 lo = (offset // block) * block
                 end = max(offset + size,
                           min(shard_size,
                               -(-(offset + size) // block) * block))
+                end = -(-end // align) * align
                 spans = [(s, min(block, end - s))
                          for s in range(lo, end, block)]
             parts = []
@@ -378,8 +399,10 @@ class EcVolume:
         a degraded read during an outage costs ~one RPC round-trip, not
         ten serial ones.  Queued stragglers are cancelled; in-flight
         ones drain on the shared pool (remote_reader RPCs carry their
-        own timeouts).  Returns (sorted survivor ids, (10, L) stack in
-        that order) — the decode-plan cache key and its matching input."""
+        own timeouts).  Returns (sorted survivor ids, (k, L) stack in
+        that order) — the decode-plan cache key and its matching input;
+        k is the volume's code family's data-shard count."""
+        k = self.family.data_shards
         shards: dict[int, np.ndarray] = {}
         remote_candidates: list[int] = []
         for sid in range(TOTAL_SHARDS_COUNT):
@@ -387,14 +410,14 @@ class EcVolume:
                 continue
             shard = self.shards.get(sid)
             if shard is not None:
-                if len(shards) >= DATA_SHARDS_COUNT:
-                    continue  # reconstruct needs exactly 10 survivors
+                if len(shards) >= k:
+                    continue  # reconstruct needs exactly k survivors
                 data = shard.read_at(size, offset)
                 if len(data) == size:
                     shards[sid] = np.frombuffer(data, dtype=np.uint8)
             elif self.remote_reader is not None:
                 remote_candidates.append(sid)
-        if len(shards) < DATA_SHARDS_COUNT and remote_candidates:
+        if len(shards) < k and remote_candidates:
             import concurrent.futures as cf
 
             from ...qos import classify as qos_classify
@@ -429,25 +452,28 @@ class EcVolume:
                     if data is not None and len(data) == size:
                         shards[futs[fut]] = np.frombuffer(data,
                                                           dtype=np.uint8)
-                        if len(shards) >= DATA_SHARDS_COUNT:
+                        if len(shards) >= k:
                             break
             finally:
                 for fut in futs:
                     fut.cancel()
-        if len(shards) < DATA_SHARDS_COUNT:
+        if len(shards) < k:
             raise EcError(
-                f"need {DATA_SHARDS_COUNT} shards to recover shard "
+                f"need {k} shards to recover shard "
                 f"{target_shard}, only {len(shards)} available")
-        survivors = tuple(sorted(shards))[:DATA_SHARDS_COUNT]
+        survivors = tuple(sorted(shards))[:k]
         return survivors, np.stack([shards[sid] for sid in survivors])
 
     def _decode_span(self, survivors: tuple, target: int,
                      inputs: np.ndarray) -> np.ndarray:
         """The batcher's decode hook: one cached decode row applied to
         the (possibly multi-span) survivor stack.  An explicitly-pinned
-        encoder backend decodes through reconstruct_one on that backend;
-        the default rides the size-dispatched reconstruct_span."""
-        if self._encoder is not None:
+        encoder backend decodes through reconstruct_one on that backend
+        (RS volumes only — pinned backends speak the RS layout); the
+        default rides the size-dispatched reconstruct_span with this
+        volume's code family."""
+        if self._encoder is not None \
+                and self.family.name == "rs_vandermonde":
             shard_list: list[Optional[np.ndarray]] = \
                 [None] * TOTAL_SHARDS_COUNT
             for i, sid in enumerate(survivors):
@@ -464,7 +490,8 @@ class EcVolume:
                 np.ascontiguousarray(inputs), digest_size=16).digest()
         return codec_mod.reconstruct_span(
             survivors, inputs, target,
-            DATA_SHARDS_COUNT, TOTAL_SHARDS_COUNT, slab_key=slab_key)
+            self.family.data_shards, TOTAL_SHARDS_COUNT,
+            slab_key=slab_key, family=self.family)
 
     # -- delete (ec_volume_delete.go) -----------------------------------------
     def delete_needle(self, needle_id: int):

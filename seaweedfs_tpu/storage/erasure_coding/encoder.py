@@ -43,12 +43,22 @@ def write_sorted_file_from_idx(base_file_name: str, ext: str = ".ecx"):
                 f.write(idx_mod.pack_entry(nid, nv.offset, nv.size))
 
 
+def _resolve_family(family):
+    """Accept a family name, a CodeFamily, or None (-> RS default)."""
+    from .codes import get_family
+
+    if hasattr(family, "data_shards"):
+        return family
+    return get_family(family)
+
+
 def write_ec_files(base_file_name: str, encoder=None,
                    large_block_size: int = LARGE_BLOCK_SIZE,
                    small_block_size: int = SMALL_BLOCK_SIZE,
                    chunk_bytes: int = DEFAULT_CHUNK_BYTES,
                    batched: Optional[bool] = None,
-                   stage_stats: Optional[dict] = None):
+                   stage_stats: Optional[dict] = None,
+                   family=None):
     """Generate .ec00..ec13 from .dat (WriteEcFiles, ec_encoder.go:57-59).
 
     Default path (no explicit codec): auto-selected by PREDICTED
@@ -66,7 +76,18 @@ def write_ec_files(base_file_name: str, encoder=None,
     stage_stats: optional dict the host pipeline fills with per-stage
     busy seconds (read / encode_crc / write / flush) and fractions —
     see parallel/batched_encode._encode_units_host.
+
+    family: code-family name or CodeFamily (storage/erasure_coding/codes).
+    None / the RS default keeps every path above unchanged; other families
+    stripe over their own data-shard count and encode through the family's
+    generator on the best host kernel, returning the 14 shard CRC32Cs.
     """
+    if family is not None:
+        fam = _resolve_family(family)
+        if fam.name != "rs_vandermonde":
+            return _write_ec_files_family(
+                base_file_name, fam, large_block_size, small_block_size,
+                chunk_bytes)
     auto_host = False
     if batched is None:
         from ...util.platform import prefer_batched_encode
@@ -143,9 +164,64 @@ def _encode_one_row(dat, encoder, block_size: int, outputs,
                 np.ascontiguousarray(parity[i]).tobytes())
 
 
+def _write_ec_files_family(base_file_name: str, fam,
+                           large_block_size: int, small_block_size: int,
+                           chunk_bytes: int) -> list:
+    """Host encode loop for a non-default code family: stripe the .dat
+    over the family's k data shards and run its generator on the best
+    host GF kernel (the native backend's _apply takes any matrix, so the
+    GFNI/AVX2 path serves every family).  Returns the 14 shard CRC32Cs,
+    chained as the shards are written — same record the batched RS
+    pipeline fuses, so .vif scrub verification works identically."""
+    from ...ops.crc32c import crc32c
+
+    fam.check_block(large_block_size)
+    fam.check_block(small_block_size)
+    chunk_bytes = max(fam.sub_shards,
+                      (chunk_bytes // fam.sub_shards) * fam.sub_shards)
+    kernel = codec_mod.new_host_encoder(fam.data_shards, fam.parity_shards)
+    k = fam.data_shards
+    dat_size = os.path.getsize(base_file_name + ".dat")
+    outputs = [open(base_file_name + to_ext(i), "wb")
+               for i in range(TOTAL_SHARDS_COUNT)]
+    crcs = [0] * TOTAL_SHARDS_COUNT
+    try:
+        with open(base_file_name + ".dat", "rb") as dat:
+            remaining = dat_size
+            while remaining > 0:
+                block_size = (large_block_size
+                              if remaining > large_block_size * k
+                              else small_block_size)
+                blocks = []
+                for _ in range(k):
+                    block = dat.read(block_size)
+                    if len(block) < block_size:
+                        block = block + b"\x00" * (block_size - len(block))
+                    blocks.append(np.frombuffer(block, dtype=np.uint8))
+                data = np.stack(blocks)  # (k, block_size)
+                for start in range(0, block_size, chunk_bytes):
+                    end = min(start + chunk_bytes, block_size)
+                    parity = fam.encode_blocks(data[:, start:end],
+                                               apply_fn=kernel._apply)
+                    for i in range(k):
+                        chunk = data[i, start:end].tobytes()
+                        outputs[i].write(chunk)
+                        crcs[i] = crc32c(chunk, crcs[i])
+                    for i in range(fam.parity_shards):
+                        chunk = np.ascontiguousarray(parity[i]).tobytes()
+                        outputs[k + i].write(chunk)
+                        crcs[k + i] = crc32c(chunk, crcs[k + i])
+                remaining -= block_size * k
+    finally:
+        for f in outputs:
+            f.close()
+    return crcs
+
+
 def rebuild_ec_files(base_file_name: str, encoder=None,
                      buffer_size: int = SMALL_BLOCK_SIZE,
-                     batched: Optional[bool] = None) -> dict:
+                     batched: Optional[bool] = None,
+                     family=None, stats: Optional[dict] = None) -> dict:
     """Regenerate missing .ecNN files from survivors
     (RebuildEcFiles/generateMissingEcFiles, ec_encoder.go:61-118,233-287).
     Returns {shard_id: crc32c-or-None} of the generated shards — CRCs
@@ -157,7 +233,18 @@ def rebuild_ec_files(base_file_name: str, encoder=None,
     faster than the host codec (same auto-selection as write_ec_files).
     Falls back to the synchronous host loop with an explicit `encoder`,
     batched=False, or an unreachable JAX backend.
+
+    family / stats: a non-default code family (name or CodeFamily), or any
+    request for read accounting (stats dict), routes through the planned
+    rebuild below — the family's repair planner picks the read set (k
+    survivors for MDS decode, d sub-shard projections for pm_msr) instead
+    of opening every present shard.
     """
+    if family is not None or stats is not None:
+        fam = _resolve_family(family)
+        if fam.name != "rs_vandermonde" or stats is not None:
+            return rebuild_ec_files_planned(base_file_name, fam,
+                                            buffer_size, stats)
     if batched is None:
         from ...util.platform import prefer_batched_encode
 
@@ -202,6 +289,115 @@ def rebuild_ec_files(base_file_name: str, encoder=None,
             f.close()
         for f in outputs.values():
             f.close()
+
+
+def rebuild_ec_files_planned(base_file_name: str, fam,
+                             buffer_size: int = SMALL_BLOCK_SIZE,
+                             stats: Optional[dict] = None) -> dict:
+    """Repair-plan-driven rebuild: read only what the family's planner
+    asks for.  MDS decode plans read k full survivors (vs every present
+    shard in the legacy loop); pm_msr single-shard plans read the d
+    helper *projections* — 1/alpha of each helper — which is the
+    regenerating-code bandwidth win.  Returns {shard_id: crc32c}; fills
+    `stats` with plan kind and read/rebuilt byte counts, where
+    read_bytes counts survivor bytes *consumed* (post-projection, i.e.
+    what a distributed rebuild moves over the network)."""
+    from ...ops.crc32c import crc32c
+
+    a = fam.sub_shards
+    buffer_size = max(a, (buffer_size // a) * a)
+    has_data = [os.path.exists(base_file_name + to_ext(i))
+                for i in range(TOTAL_SHARDS_COUNT)]
+    generated = [i for i in range(TOTAL_SHARDS_COUNT) if not has_data[i]]
+    present = [i for i in range(TOTAL_SHARDS_COUNT) if has_data[i]]
+    out_stats = stats if stats is not None else {}
+    out_stats.update({"plan": None, "read_bytes": 0, "rebuilt_bytes": 0,
+                      "read_amp": None, "helpers": ()})
+    if not generated:
+        return {}
+    plan = None
+    if len(generated) == 1:
+        plan = fam.repair_plan(generated[0], present)
+    kernel = codec_mod.new_host_encoder(fam.data_shards, fam.parity_shards)
+    read_bytes = rebuilt_bytes = 0
+    crcs = {i: 0 for i in generated}
+    if plan is not None and plan.kind == "projection":
+        lost = generated[0]
+        inputs = {h: open(base_file_name + to_ext(h), "rb")
+                  for h in plan.helpers}
+        try:
+            with open(base_file_name + to_ext(lost), "wb") as out:
+                while True:
+                    chunks = []
+                    n = None
+                    for h in plan.helpers:
+                        buf = inputs[h].read(buffer_size)
+                        if n is None:
+                            n = len(buf)
+                        elif len(buf) != n:
+                            raise ValueError(
+                                f"ec shard size expected {n} "
+                                f"actual {len(buf)}")
+                        chunks.append(buf)
+                    if not n:
+                        break
+                    projs = np.stack([
+                        fam.project(np.frombuffer(c, dtype=np.uint8),
+                                    plan.vector) for c in chunks])
+                    restored = np.ascontiguousarray(
+                        fam.combine_projections(plan, projs)).tobytes()
+                    out.write(restored)
+                    crcs[lost] = crc32c(restored, crcs[lost])
+                    read_bytes += projs.nbytes
+                    rebuilt_bytes += n
+        finally:
+            for f in inputs.values():
+                f.close()
+    else:
+        chosen = (plan.helpers if plan is not None
+                  else fam.choose_survivors(present))
+        inputs = {i: open(base_file_name + to_ext(i), "rb")
+                  for i in chosen}
+        outputs = {i: open(base_file_name + to_ext(i), "wb")
+                   for i in generated}
+        try:
+            while True:
+                stack = []
+                n = None
+                for i in chosen:
+                    buf = inputs[i].read(buffer_size)
+                    if n is None:
+                        n = len(buf)
+                    elif len(buf) != n:
+                        raise ValueError(
+                            f"ec shard size expected {n} actual {len(buf)}")
+                    stack.append(np.frombuffer(buf, dtype=np.uint8))
+                if not n:
+                    break
+                restored = fam.decode_blocks(chosen, np.stack(stack),
+                                             generated,
+                                             apply_fn=kernel._apply)
+                for idx, i in enumerate(generated):
+                    chunk = np.ascontiguousarray(restored[idx]).tobytes()
+                    outputs[i].write(chunk)
+                    crcs[i] = crc32c(chunk, crcs[i])
+                read_bytes += n * len(chosen)
+                rebuilt_bytes += n * len(generated)
+        finally:
+            for f in inputs.values():
+                f.close()
+            for f in outputs.values():
+                f.close()
+    out_stats.update({
+        "plan": plan.kind if plan is not None else "decode",
+        "read_bytes": read_bytes,
+        "rebuilt_bytes": rebuilt_bytes,
+        "read_amp": (round(read_bytes / rebuilt_bytes, 4)
+                     if rebuilt_bytes else None),
+        "helpers": (plan.helpers if plan is not None
+                    else tuple(sorted(inputs))),
+    })
+    return crcs
 
 
 def save_volume_info(base_file_name: str, version: int,

@@ -1,12 +1,16 @@
 """Needle -> shard interval math, matching ec_locate.go bit for bit.
 
-A volume's logical .dat is striped row-major over 10 data shards: first
-nLargeRows rows of 1 GB blocks, then rows of 1 MB blocks (zero-padded).  A
-(offset, size) span in the .dat maps to one or more Intervals, each naming a
-block index + inner offset; ToShardIdAndOffset then maps a block to
-(shard id, offset within the .ecNN file).  The large/small two-tier scheme
-exists so the large-row count is derivable from a shard's file size
-(ec_locate.go:18-19).
+A volume's logical .dat is striped row-major over the family's data shards
+(10 for RS/Cauchy, the default): first nLargeRows rows of 1 GB blocks, then
+rows of 1 MB blocks (zero-padded).  A (offset, size) span in the .dat maps
+to one or more Intervals, each naming a block index + inner offset;
+ToShardIdAndOffset then maps a block to (shard id, offset within the .ecNN
+file).  The large/small two-tier scheme exists so the large-row count is
+derivable from a shard's file size (ec_locate.go:18-19).
+
+``data_shards`` defaults to the classic 10 so existing callers and volumes
+are untouched; repair-efficient code families with a different stripe width
+(pm_msr stripes over 5) pass their own.
 """
 
 from __future__ import annotations
@@ -25,25 +29,28 @@ class Interval:
     large_block_rows_count: int
 
     def to_shard_id_and_offset(self, large_block_size: int,
-                               small_block_size: int) -> tuple[int, int]:
+                               small_block_size: int,
+                               data_shards: int = DATA_SHARDS_COUNT,
+                               ) -> tuple[int, int]:
         ec_file_offset = self.inner_block_offset
-        row_index = self.block_index // DATA_SHARDS_COUNT
+        row_index = self.block_index // data_shards
         if self.is_large_block:
             ec_file_offset += row_index * large_block_size
         else:
             ec_file_offset += (self.large_block_rows_count * large_block_size
                                + row_index * small_block_size)
-        ec_file_index = self.block_index % DATA_SHARDS_COUNT
+        ec_file_index = self.block_index % data_shards
         return ec_file_index, ec_file_offset
 
 
 def locate_data(large_block_length: int, small_block_length: int,
-                dat_size: int, offset: int, size: int) -> list[Interval]:
+                dat_size: int, offset: int, size: int,
+                data_shards: int = DATA_SHARDS_COUNT) -> list[Interval]:
     block_index, is_large, inner_offset = _locate_offset(
-        large_block_length, small_block_length, dat_size, offset)
-    # +10*small ensures the large-row count is derivable from shard size
-    n_large_rows = (dat_size + DATA_SHARDS_COUNT * small_block_length) // (
-        large_block_length * DATA_SHARDS_COUNT)
+        large_block_length, small_block_length, dat_size, offset, data_shards)
+    # +k*small ensures the large-row count is derivable from shard size
+    n_large_rows = (dat_size + data_shards * small_block_length) // (
+        large_block_length * data_shards)
 
     intervals: list[Interval] = []
     while size > 0:
@@ -64,7 +71,7 @@ def locate_data(large_block_length: int, small_block_length: int,
         intervals.append(interval)
         size -= interval.size
         block_index += 1
-        if is_large and block_index == n_large_rows * DATA_SHARDS_COUNT:
+        if is_large and block_index == n_large_rows * data_shards:
             is_large = False
             block_index = 0
         inner_offset = 0
@@ -72,8 +79,10 @@ def locate_data(large_block_length: int, small_block_length: int,
 
 
 def _locate_offset(large_block_length: int, small_block_length: int,
-                   dat_size: int, offset: int) -> tuple[int, bool, int]:
-    large_row_size = large_block_length * DATA_SHARDS_COUNT
+                   dat_size: int, offset: int,
+                   data_shards: int = DATA_SHARDS_COUNT,
+                   ) -> tuple[int, bool, int]:
+    large_row_size = large_block_length * data_shards
     n_large_rows = dat_size // large_row_size
     if offset < n_large_rows * large_row_size:
         return (offset // large_block_length, True,

@@ -7,6 +7,7 @@ serves EC reads with the local/remote/reconstruct ladder.
 
 from __future__ import annotations
 
+import os
 import threading
 from typing import Callable, Optional
 
@@ -185,7 +186,7 @@ class Store:
                                      PARITY_SHARDS_COUNT, backend=backend)
         return backend
 
-    def ec_generate(self, vid: int, encoder=None):
+    def ec_generate(self, vid: int, encoder=None, code_family: str = None):
         """VolumeEcShardsGenerate: encode a local volume into shard files.
 
         Backend: -ec.backend=tpu forces the streaming batched device
@@ -193,19 +194,32 @@ class Store:
         by predicted throughput on this machine's host<->device link
         (write_ec_files).  Fused per-shard-file CRC32Cs from the batched
         path are persisted in the .vif sidecar for scrub tooling.
+
+        code_family: explicit erasure-code family; None resolves the
+        per-collection policy (WEED_EC_CODE[_<COLLECTION>], filer config,
+        default RS).  The chosen family is recorded in the .vif so every
+        later read/rebuild uses the matrices the shards were cut with.
         """
+        from .erasure_coding import codes as ec_codes
+
         v = self.find_volume(vid)
         if v is None:
             raise NotFoundError(f"volume {vid} not found")
+        family = code_family or ec_codes.family_for_collection(v.collection)
         base = v.file_name()
         v.sync()
         forced = True if (encoder is None
                           and self.ec_encoder_backend == "tpu") else None
-        crcs = ec_encoder.write_ec_files(
-            base, encoder=encoder or self._resolve_ec_encoder(),
-            batched=forced)
+        if family != ec_codes.DEFAULT_FAMILY:
+            crcs = ec_encoder.write_ec_files(base, family=family)
+        else:
+            crcs = ec_encoder.write_ec_files(
+                base, encoder=encoder or self._resolve_ec_encoder(),
+                batched=forced)
         ec_encoder.write_sorted_file_from_idx(base)
-        extra = {"shard_crc32c": crcs} if crcs else None
+        extra = {"code_family": family}
+        if crcs:
+            extra["shard_crc32c"] = crcs
         ec_encoder.save_volume_info(base, version=v.version, extra=extra)
 
     def ec_generate_batch(self, vids: list[int]):
@@ -225,21 +239,32 @@ class Store:
                 self.ec_generate(vid, encoder=enc)
             return
         from ..parallel.batched_encode import encode_volumes
+        from .erasure_coding import codes as ec_codes
 
         vols = []
         for vid in vids:
             v = self.find_volume(vid)
             if v is None:
                 raise NotFoundError(f"volume {vid} not found")
+            # the shared-dispatch device pipeline speaks the RS layout;
+            # collections whose policy picks another family encode
+            # per-volume through the family host loop
+            if (ec_codes.family_for_collection(v.collection)
+                    != ec_codes.DEFAULT_FAMILY):
+                self.ec_generate(vid)
+                continue
             v.sync()
             vols.append(v)
+        if not vols:
+            return
         crc_map = encode_volumes([v.file_name() for v in vols])
         for v in vols:
             base = v.file_name()
             ec_encoder.write_sorted_file_from_idx(base)
             ec_encoder.save_volume_info(
                 base, version=v.version,
-                extra={"shard_crc32c": crc_map[base]})
+                extra={"shard_crc32c": crc_map[base],
+                       "code_family": ec_codes.DEFAULT_FAMILY})
 
     def ec_rebuild(self, vid: int, collection: str = "") -> list[int]:
         """VolumeEcShardsRebuild: regenerate missing local shard files.
@@ -248,15 +273,42 @@ class Store:
         records the original shard CRCs, the rebuilt values are VERIFIED
         against the record — a correct rebuild reproduces the original
         bytes, so a mismatch means a survivor is silently corrupt and the
-        rebuild is reported rather than laundered into the record."""
-        from .erasure_coding import TOTAL_SHARDS_COUNT
+        rebuild is reported rather than laundered into the record.
+
+        The .vif's code family picks the rebuild path: RS volumes keep
+        the legacy device/host pipeline; other families run the planned
+        rebuild (the family's repair-optimal read set).  Either way the
+        survivor-bytes-per-rebuilt-byte traffic lands in the
+        maintenance_ec_rebuild_* metrics, labeled by family."""
+        from .erasure_coding import TOTAL_SHARDS_COUNT, to_ext
+        from .erasure_coding import codes as ec_codes
 
         loc = self.location_of(vid)
         base = (loc._base_name(collection, vid) if loc
                 else self.locations[0]._base_name(collection, vid))
-        crcs = ec_encoder.rebuild_ec_files(base,
-                                           encoder=self._resolve_ec_encoder())
         info = ec_encoder.load_volume_info(base) or {}
+        family = info.get("code_family") or ec_codes.DEFAULT_FAMILY
+        if family != ec_codes.DEFAULT_FAMILY:
+            rb_stats: dict = {}
+            crcs = ec_encoder.rebuild_ec_files(base, family=family,
+                                               stats=rb_stats)
+            if rb_stats.get("rebuilt_bytes"):
+                ec_codes.note_rebuild(family, rb_stats["read_bytes"],
+                                      rb_stats["rebuilt_bytes"])
+        else:
+            # legacy loop reads every present survivor in full: account
+            # the actual traffic from the on-disk sizes
+            present_bytes = sum(
+                os.path.getsize(base + to_ext(i))
+                for i in range(TOTAL_SHARDS_COUNT)
+                if os.path.exists(base + to_ext(i)))
+            crcs = ec_encoder.rebuild_ec_files(
+                base, encoder=self._resolve_ec_encoder())
+            rebuilt_bytes = sum(
+                os.path.getsize(base + to_ext(sid)) for sid in crcs
+                if os.path.exists(base + to_ext(sid)))
+            if crcs and rebuilt_bytes:
+                ec_codes.note_rebuild(family, present_bytes, rebuilt_bytes)
         stored = info.get("shard_crc32c")
         if isinstance(stored, list) and len(stored) == TOTAL_SHARDS_COUNT:
             bad = [sid for sid, crc in crcs.items()

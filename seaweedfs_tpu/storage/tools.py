@@ -195,9 +195,13 @@ def scrub_ec_volume(directory: str, collection: str, vid: int,
     checked, corrupt, missing = verify_shard_files(base, stored)
     repaired: list[int] = []
     if repair and (corrupt or missing):
+        from .erasure_coding.codes import get_family
         from .erasure_coding.encoder import rebuild_ec_files
 
-        if len(checked) < 10:  # DATA_SHARDS_COUNT clean survivors needed
+        # clean-survivor bound is the volume's code family's data_shards
+        # (10 for RS/Cauchy, 5 for pm_msr), recorded in the .vif
+        family = get_family(info.get("code_family"))
+        if len(checked) < family.data_shards:
             raise ValueError(
                 f"only {len(checked)} clean shards — cannot rebuild "
                 f"{sorted(corrupt + missing)}; corrupt files left in place")
@@ -206,7 +210,10 @@ def scrub_ec_volume(directory: str, collection: str, vid: int,
         for sid in corrupt:
             os.replace(base + to_ext(sid), base + to_ext(sid) + ".corrupt")
         try:
-            crcs = rebuild_ec_files(base)  # device path or host fallback
+            if family.name != "rs_vandermonde":
+                crcs = rebuild_ec_files(base, family=family)
+            else:
+                crcs = rebuild_ec_files(base)  # device path or host fallback
         except Exception:
             for sid in corrupt:  # restore the evidence
                 os.replace(base + to_ext(sid) + ".corrupt",

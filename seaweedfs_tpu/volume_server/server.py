@@ -23,6 +23,8 @@ import threading
 import time
 from typing import Optional
 
+import numpy as np
+
 from .. import profiling, qos, tracing
 from ..rpc import policy
 from ..rpc.http_rpc import (Request, Response, RpcError, RpcServer, call,
@@ -32,7 +34,9 @@ from ..security import Guard, gen_write_jwt, token_from_request
 from ..stats import metrics as stats
 from ..storage import types as t
 from ..storage.erasure_coding import TOTAL_SHARDS_COUNT, to_ext
+from ..storage.erasure_coding import codes as ec_codes
 from ..storage.erasure_coding import decoder as ec_decoder
+from ..storage.erasure_coding.encoder import load_volume_info
 from ..storage.erasure_coding.ec_volume import (EcDeletedError,
                                                 EcNotFoundError,
                                                 rebuild_ecx_file)
@@ -628,8 +632,12 @@ class VolumeServer:
         s.add("POST", "/admin/ec/to_volume", g(self._h_ec_to_volume))
         s.add("POST", "/admin/ec/scrub", g(self._h_ec_scrub))
         s.add("GET", "/admin/ec/recover_stats", g(self._h_ec_recover_stats))
+        s.add("GET", "/admin/ec/codes", g(self._h_ec_codes))
         s.add("GET", "/admin/ec/shard_file", self._h_ec_shard_file)
         s.add("GET", "/admin/ec/shard_read", self._h_ec_shard_read)
+        s.add("GET", "/admin/ec/shard_project", self._h_ec_shard_project)
+        s.add("POST", "/admin/ec/rebuild_projected",
+              g(self._h_ec_rebuild_projected))
         s.add("POST", "/admin/volume/configure_replication",
               g(self._h_configure_replication))
         s.add("POST", "/admin/volume/tier_upload", g(self._h_tier_upload))
@@ -1325,7 +1333,9 @@ class VolumeServer:
 
     # -- EC handlers (volume_grpc_erasure_coding.go) -------------------------
     def _h_ec_generate(self, req: Request):
-        self.store.ec_generate(int(req.json()["volume"]))
+        p = req.json()
+        self.store.ec_generate(int(p["volume"]),
+                               code_family=p.get("code_family") or None)
         return {}
 
     def _h_ec_rebuild(self, req: Request):
@@ -1445,7 +1455,10 @@ class VolumeServer:
         base = loc._base_name(collection, vid)
         rebuild_ecx_file(base)
         dat_size = ec_decoder.find_dat_file_size(base, base)
-        ec_decoder.write_dat_file(base, dat_size)
+        fam = ec_codes.get_family(
+            (load_volume_info(base) or {}).get("code_family"))
+        ec_decoder.write_dat_file(base, dat_size,
+                                  data_shards=fam.data_shards)
         ec_decoder.write_idx_file_from_ec_index(base)
         # unmount EC runtime, load as a normal volume
         ev = self.store.find_ec_volume(vid)
@@ -1503,6 +1516,160 @@ class VolumeServer:
         if ev is None or shard_id not in ev.shards:
             raise RpcError(f"shard {vid}.{shard_id} not found", 404)
         return ev.shards[shard_id].read_at(size, offset)
+
+    def _h_ec_codes(self, req: Request):
+        """Coding-tier introspection: registered families (geometry,
+        repair read amp, decode-plan cache hit ratios), this process's
+        rebuild read-amp counters, and each mounted EC volume's family.
+        ?volume=N narrows to one volume."""
+        want_vid = int(req.param("volume", "0"))
+        volumes = {}
+        for loc in self.store.locations:
+            for vid, ev in loc.ec_volumes.items():
+                if want_vid and vid != want_vid:
+                    continue
+                volumes[str(vid)] = {
+                    "collection": ev.collection,
+                    "family": ev.family.name,
+                    "shards": sorted(ev.shards),
+                }
+        return {
+            "default_family": ec_codes.DEFAULT_FAMILY,
+            "families": ec_codes.describe_families(),
+            "rebuild_read_amp": ec_codes.rebuild_read_amp_snapshot(),
+            "volumes": volumes,
+        }
+
+    def _h_ec_shard_project(self, req: Request):
+        """Sub-shard read RPC: stream GF(2^8) projection ``vec @ lanes``
+        of a locally-mounted shard — the helper side of a regenerating-
+        code repair.  The reply is 1/alpha the shard's size, which is
+        the whole point: the rebuilder pulls d of these instead of k
+        full shards."""
+        vid = int(req.param("volume", "0"))
+        shard_id = int(req.param("shard", "0"))
+        vec = tuple(int(x) for x in req.param("vec", "").split(",") if x)
+        ev = self.store.find_ec_volume(vid)
+        if ev is None or shard_id not in ev.shards:
+            raise RpcError(f"shard {vid}.{shard_id} not found", 404)
+        fam = ev.family
+        if fam.sub_shards <= 1:
+            raise RpcError(
+                f"volume {vid} family {fam.name} has no sub-shards", 400)
+        if len(vec) != fam.sub_shards:
+            raise RpcError(
+                f"vec needs {fam.sub_shards} coefficients", 400)
+        shard = ev.shards[shard_id]
+        total = shard.ecd_file_size
+        chunk = (4 << 20) // fam.sub_shards * fam.sub_shards
+
+        def gen():
+            pos = 0
+            while pos < total:
+                n = min(chunk, total - pos)
+                buf = shard.read_at(n, pos)
+                if len(buf) != n:
+                    raise RpcError(
+                        f"short read shard {vid}.{shard_id}", 500)
+                yield fam.project(
+                    np.frombuffer(buf, dtype=np.uint8), vec).tobytes()
+                pos += n
+
+        return Response(gen(), content_type="application/octet-stream")
+
+    def _h_ec_rebuild_projected(self, req: Request):
+        """Projection rebuild: pull d helper projections over the wire
+        and combine them into the lost shard locally — the repair-optimal
+        rebuild for regenerating families (moves shard_size * d / alpha
+        bytes instead of shard_size * k).  Verifies the rebuilt CRC
+        against the .vif record when one exists and feeds the
+        maintenance_ec_rebuild_* read-amp metrics."""
+        import concurrent.futures as cf
+
+        from ..ops.crc32c import crc32c
+
+        p = req.json()
+        vid = int(p["volume"])
+        collection = p.get("collection", "")
+        lost = int(p["shard"])
+        sources = {int(s["shard_id"]): s["url"] for s in p["sources"]}
+        loc = self.store.location_of(vid) or self.store.locations[0]
+        base = loc._base_name(collection, vid)
+        info = load_volume_info(base) or {}
+        fam = ec_codes.get_family(info.get("code_family"))
+        plan = fam.repair_plan(lost, sources)
+        if plan.kind != "projection":
+            raise RpcError(
+                f"family {fam.name} has no projection repair for shard "
+                f"{lost} from {sorted(sources)}", 400)
+        vec_param = ",".join(str(x) for x in plan.vector)
+
+        def pull(h: int) -> str:
+            path = f"{base}.proj{h:02d}"
+            chunks = call_stream(
+                sources[h],
+                f"/admin/ec/shard_project?volume={vid}&shard={h}"
+                f"&vec={vec_param}", timeout=600)
+            with open(path, "wb") as f:
+                for chunk in chunks:
+                    f.write(chunk)
+            return path
+
+        proj_paths: dict[int, str] = {}
+        with self._vid_copy_lock(vid):
+            try:
+                with cf.ThreadPoolExecutor(
+                        max_workers=len(plan.helpers),
+                        thread_name_prefix="ec-project") as pool:
+                    futs = {h: pool.submit(pull, h) for h in plan.helpers}
+                    for h, fut in futs.items():
+                        proj_paths[h] = fut.result()
+                widths = {os.path.getsize(path)
+                          for path in proj_paths.values()}
+                if len(widths) != 1:
+                    raise RpcError(
+                        f"helper projections disagree on size: {widths}",
+                        502)
+                width = widths.pop()
+                crc = 0
+                step = (1 << 20)
+                files = [open(proj_paths[h], "rb") for h in plan.helpers]
+                try:
+                    with open(base + to_ext(lost) + ".cpy", "wb") as out:
+                        pos = 0
+                        while pos < width:
+                            n = min(step, width - pos)
+                            stack = np.stack([
+                                np.frombuffer(f.read(n), dtype=np.uint8)
+                                for f in files])
+                            restored = np.ascontiguousarray(
+                                fam.combine_projections(plan, stack)
+                            ).tobytes()
+                            out.write(restored)
+                            crc = crc32c(restored, crc)
+                            pos += n
+                finally:
+                    for f in files:
+                        f.close()
+                stored = info.get("shard_crc32c")
+                if (isinstance(stored, list)
+                        and len(stored) == TOTAL_SHARDS_COUNT
+                        and crc != stored[lost]):
+                    _remove_quiet(base + to_ext(lost) + ".cpy")
+                    raise RpcError(
+                        f"projected rebuild of shard {vid}.{lost} does "
+                        "not match the recorded CRC — a helper shard is "
+                        "corrupt", 502)
+                os.replace(base + to_ext(lost) + ".cpy", base + to_ext(lost))
+            finally:
+                _remove_quiet(*proj_paths.values())
+        read_bytes = width * len(plan.helpers)
+        rebuilt_bytes = width * fam.sub_shards
+        ec_codes.note_rebuild(fam.name, read_bytes, rebuilt_bytes)
+        return {"rebuilt_shard_ids": [lost], "read_bytes": read_bytes,
+                "rebuilt_bytes": rebuilt_bytes,
+                "read_amp": round(read_bytes / rebuilt_bytes, 4),
+                "crc32c": crc}
 
     # -- remote EC shard fetch (store_ec.go read ladder) ---------------------
     def _make_remote_reader(self, vid: int):

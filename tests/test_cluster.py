@@ -235,6 +235,68 @@ class TestEcOrchestration:
         ec = call(master.address, f"/ec/lookup?volumeId={vid}")
         assert len(ec["shard_id_locations"]) == 14
 
+    def test_pm_msr_projected_rebuild_and_ec_codes(self, cluster,
+                                                   monkeypatch):
+        """Coding-tier end to end: encode a volume with the pm_msr
+        regenerating family via the WEED_EC_CODE policy, read needles
+        degraded across servers, then lose ONE shard — ec.rebuild must
+        take the projection path (d=8 sub-shard projections over the
+        wire, read amp 2.0 instead of RS's 10.0) and the rebuilt volume
+        must keep serving byte-identical needles."""
+        master, servers = cluster
+        stored = self._fill_volume(master)
+        env = sh.CommandEnv(master.address)
+        vid = sorted({int(fid.split(",")[0]) for fid in stored})[0]
+        monkeypatch.setenv("WEED_EC_CODE", "pm_msr")
+        sh.ec_encode(env, vid)
+        for vs in servers:
+            vs.heartbeat_once()
+
+        # the coding-tier inventory knows the volume's family
+        codes = sh.ec_codes(env, vid)
+        assert codes["default_family"] == "rs_vandermonde"
+        assert codes["volumes"][str(vid)]["family"] == "pm_msr"
+        assert codes["families"]["pm_msr"]["repair_helpers"] == 8
+        assert sorted(codes["volumes"][str(vid)]["shards"]) == list(range(14))
+
+        def check_reads():
+            lookup = call(master.address, f"/dir/lookup?volumeId={vid}")
+            serve = lookup["locations"][0]["url"]
+            for fid, (_, payload) in stored.items():
+                if int(fid.split(",")[0]) == vid:
+                    assert call(serve, f"/{fid}") == payload
+
+        check_reads()
+
+        # lose exactly one shard somewhere
+        lost_sid = None
+        for vs in servers:
+            for loc in vs.store.locations:
+                ev = loc.ec_volumes.get(vid)
+                if ev and ev.shards:
+                    lost_sid = sorted(ev.shards)[0]
+                    vs.store.ec_unmount(vid, [lost_sid])
+                    os.remove(loc._base_name("", vid) + to_ext(lost_sid))
+                    break
+            if lost_sid is not None:
+                vs.heartbeat_once()
+                break
+        assert lost_sid is not None
+
+        plan = sh.ec_rebuild(env, vid, plan_only=True)
+        assert plan["missing"] == [lost_sid]
+        assert plan["family"] == "pm_msr"
+        assert plan["mode"] == "projection"
+
+        result = sh.ec_rebuild(env, vid)
+        assert result["mode"] == "projection"
+        assert result["read_amp"] == pytest.approx(2.0)
+        for vs in servers:
+            vs.heartbeat_once()
+        ec = call(master.address, f"/ec/lookup?volumeId={vid}")
+        assert len(ec["shard_id_locations"]) == 14
+        check_reads()
+
     def test_ec_decode_back_to_volume(self, cluster):
         master, servers = cluster
         stored = self._fill_volume(master)

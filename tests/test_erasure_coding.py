@@ -533,6 +533,19 @@ class TestShardBits:
         assert b.minus(ShardBits().add(0)).shard_ids() == [5, 13]
         assert b.plus(ShardBits().add(1)).shard_ids() == [0, 1, 5, 13]
 
+    def test_hash_consistent_with_eq(self):
+        """ShardBits defines __eq__, so it must define __hash__ too —
+        without it, equal values land in different dict/set buckets and
+        ShardBits silently stops working as a topology map key."""
+        a = ShardBits().add(3).add(7)
+        b = ShardBits().add(7).add(3)
+        assert a == b
+        assert hash(a) == hash(b)
+        assert len({a, b}) == 1
+        d = {a: "x"}
+        assert d[b] == "x"
+        assert hash(a) != hash(a.add(1))  # distinct sets hash apart
+
 
 @pytest.mark.skipif(reference_fixture("weed/storage/erasure_coding/1.dat")
                     is None, reason="reference fixture not mounted")

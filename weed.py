@@ -432,6 +432,12 @@ def _shell_handlers(env):
             vid=(lambda v: int(v[0]) if v else None)(
                 [x for x in a if not x.startswith("-")]),
             repair="-repair" in a, plan_only=plan(a))),
+        # coding-tier inventory: registered code families plus the family
+        # each mounted EC volume was encoded with
+        "ec.codes": lambda a: show(sh.ec_codes(
+            env,
+            vid=(lambda v: int(v[0]) if v else None)(
+                [x for x in a if not x.startswith("-")]))),
         # maintenance family — curator status/queue on the master
         "maintenance.status": lambda a: show(mnt.maintenance_status(env)),
         "maintenance.queue": lambda a: show(mnt.maintenance_queue(env)),
@@ -485,6 +491,7 @@ def _shell_handlers(env):
             replication=flag(a, "replication", ""),
             ttl=flag(a, "ttl", ""),
             read_only=True if "-readOnly" in a else None,
+            ec_code=flag(a, "ecCode", ""),
             delete="-delete" in a)),
         # remote storage family
         "remote.configure": lambda a: show(rem.remote_configure(
