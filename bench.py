@@ -346,6 +346,81 @@ def bench_e2e_scale(n_vols: int, vol_bytes: int, workdir: str
     return n_vols * vol_bytes / GIB / dt, peak_rss_mb, st
 
 
+# Child process of the device-scale curve: the XLA device count is
+# fixed at backend init, so every mesh width needs its own interpreter.
+# argv: n_devices workdir n_vols vol_bytes repo_root
+_SCALE_CHILD = r"""
+import json, os, sys, time
+n, workdir = int(sys.argv[1]), sys.argv[2]
+n_vols, vol_bytes = int(sys.argv[3]), int(sys.argv[4])
+sys.path.insert(0, sys.argv[5])
+import jax
+from bench import GIB, _cleanup, _write_volume
+from seaweedfs_tpu.parallel.batched_encode import encode_volumes
+from seaweedfs_tpu.parallel.mesh import make_ec_mesh
+mesh = make_ec_mesh(jax.devices("cpu"))
+assert mesh.devices.size == n, (mesh.devices.shape, n)
+wbases = []
+for i in range(min(n_vols, 4)):
+    b = os.path.join(workdir, "scw%d_%d" % (n, i))
+    _write_volume(b, vol_bytes, seed=40 + i)
+    wbases.append(b)
+encode_volumes(wbases, mesh=mesh)  # warm the per-geometry compile
+_cleanup(workdir, "scw%d_" % n)
+bases = []
+for i in range(n_vols):
+    b = os.path.join(workdir, "scv%d_%d" % (n, i))
+    _write_volume(b, vol_bytes, seed=i)
+    bases.append(b)
+st = {}
+t0 = time.perf_counter()
+encode_volumes(bases, mesh=mesh, stage_stats=st)
+dt = time.perf_counter() - t0
+_cleanup(workdir, "scv%d_" % n)
+print(json.dumps({"gibps": n_vols * vol_bytes / GIB / dt,
+                  "backend": st.get("backend"),
+                  "crc_path": st.get("crc_path"),
+                  "devices": st.get("devices")}))
+"""
+
+
+def bench_device_scale_curve(workdir: str, vol_bytes: int = 4 << 20,
+                             n_vols: int = 16,
+                             counts=(1, 2, 4)) -> dict:
+    """Per-device-count scaling of the sharded dispatch path on the CPU
+    harness: one subprocess per mesh width (1/2/4 virtual devices via
+    --xla_force_host_platform_device_count), WEED_EC_DEVICE_SHARD pinned
+    to the width so the shard_map partitioning is what is measured.
+    Returns {"1": GiB/s, "2": ..., "4": ...} (None where a width
+    failed)."""
+    import re as _re
+    import subprocess
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    curve: dict = {}
+    for n in counts:
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        flags = _re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                        env.get("XLA_FLAGS", ""))
+        env["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}").strip()
+        env["WEED_EC_DEVICE_SHARD"] = str(n)
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", _SCALE_CHILD, str(n), workdir,
+                 str(n_vols), str(vol_bytes), root],
+                env=env, cwd=root, capture_output=True, text=True,
+                timeout=600, check=True)
+            payload = json.loads(out.stdout.strip().splitlines()[-1])
+            curve[str(n)] = round(payload["gibps"], 3)
+        except Exception as e:  # one width failing shouldn't kill the run
+            print(f"note: scale-curve width {n} failed: {e}",
+                  file=sys.stderr)
+            curve[str(n)] = None
+    return curve
+
+
 def bench_e2e_device_scale(n_vols: int, vol_bytes: int, workdir: str,
                            link_capped: bool) -> tuple[float, dict]:
     """100-volume count through the DEVICE-dispatch pipeline path:
@@ -361,9 +436,13 @@ def bench_e2e_device_scale(n_vols: int, vol_bytes: int, workdir: str,
     if link_capped:
         import jax
 
-        from seaweedfs_tpu.parallel.mesh import make_mesh
+        from seaweedfs_tpu.parallel.mesh import make_ec_mesh
 
-        mesh = make_mesh(jax.devices("cpu"))
+        # the EC mesh (WEED_EC_DEVICE_SHARD): on a CPU harness "auto"
+        # caps the shard width at the usable cores — virtual devices
+        # beyond that only add partitioning overhead, and a 1-device
+        # mesh restores the zero-copy dlpack H2D path
+        mesh = make_ec_mesh(jax.devices("cpu"))
     # Warm at the MEASURED shape: the persistent parity step compiles per
     # (k, batch) geometry, and this phase's small volumes compact to a
     # shorter k than the 60 MB generic warm volume — warming there would
@@ -1346,6 +1425,14 @@ def main():
             scale_vols, 4 << 20, workdir, link_capped)
     except Exception as e:
         print(f"note: device scale e2e failed: {e}", file=sys.stderr)
+    dev_scale_curve: dict = {}
+    try:
+        # per-mesh-width scaling of the sharded dispatch path (always on
+        # the CPU harness — the curve isolates the shard_map scaling
+        # from link and backend effects)
+        dev_scale_curve = bench_device_scale_curve(workdir)
+    except Exception as e:
+        print(f"note: device scale curve failed: {e}", file=sys.stderr)
     try:
         maint_scrub_rate, maint_scrub_stages = \
             bench_maintenance_deep_scrub(
@@ -1447,6 +1534,7 @@ def main():
         "e2e_device_dispatch_100vol_gibps": round(dev_scale_rate, 3),
         "e2e_device_dispatch_backend": dev_scale_stages.get("backend", ""),
         "e2e_device_dispatch_stages": dev_scale_stages,
+        "e2e_device_scale_curve": dev_scale_curve,
         "maintenance_deep_scrub_gibps": round(maint_scrub_rate, 3),
         "maintenance_deep_scrub_backend":
             maint_scrub_stages.get("backend", ""),

@@ -203,10 +203,10 @@ def deep_scrub(targets: list, mesh=None,
         from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
         from ..ops.device_pool import get_pool
-        from ..parallel.mesh import make_mesh, make_parity_step
+        from ..parallel.mesh import make_ec_mesh, make_parity_step
 
         if mesh is None:
-            mesh = make_mesh()
+            mesh = make_ec_mesh()
         n_data, n_block = mesh.devices.shape
         width = chunk // 4
         if width % n_block:
@@ -224,6 +224,8 @@ def deep_scrub(targets: list, mesh=None,
         pool = get_pool()
         single = mesh.devices.size == 1
         dev0 = mesh.devices.flat[0]
+        dev_label = (str(dev0) if single
+                     else f"sharded:{mesh.devices.size}")
         sharding_kb = NamedSharding(mesh, P(None, "data", "block"))
         zero_copy = single and dev0 == jax.devices("cpu")[0]
         pool_before = pool.snapshot()
@@ -236,7 +238,8 @@ def deep_scrub(targets: list, mesh=None,
 
         okey = ("maint-out", mesh, oshape)
         out_leases = [pool.lease(okey, _out_factory,
-                                 PARITY_SHARDS_COUNT * b * chunk)
+                                 PARITY_SHARDS_COUNT * b * chunk,
+                                 device=dev_label)
                       for _ in range(depth + 1)]
         out_ring = deque(out_leases)
         # staging ring: a buffer is refilled only after its batch has
@@ -250,7 +253,7 @@ def deep_scrub(targets: list, mesh=None,
             out, buf, metas, t_disp = pending.popleft()
             t0 = time.perf_counter()
             parity = np.asarray(out.payload)  # blocks until ready
-            pool.note_d2h(parity.nbytes)
+            pool.note_d2h(parity.nbytes, device=dev_label)
             pbytes = parity.view(np.uint8).reshape(
                 PARITY_SHARDS_COUNT, b, chunk)
             for k, (ti, off) in enumerate(metas):
@@ -301,7 +304,7 @@ def deep_scrub(targets: list, mesh=None,
                 else:
                     din = jax.device_put(
                         words, dev0 if single else sharding_kb)
-                    pool.note_h2d(words.nbytes)
+                    pool.note_h2d(words.nbytes, device=dev_label)
                 out = out_ring.popleft()
                 # donation swap: the step aliases its result into the
                 # leased slot; the old handle is dead

@@ -188,12 +188,18 @@ def reconstruct_span(survivors, inputs: np.ndarray, target: int,
 
             method = "pallas" if on_tpu() else "swar"
             if slab_key is not None:
+                import jax
+
                 pool = get_pool()
+                # survivor slabs upload to the default device; labeling
+                # the transfers/residency keeps the recover traffic
+                # distinguishable from the sharded encode meshes'
+                dev_label = str(jax.devices()[0])
                 key = ("recover", fam_name, tuple(survivors), slab_key)
 
                 def _upload():
                     dev = jnp.asarray(to_dev)
-                    pool.note_h2d(to_dev.nbytes)
+                    pool.note_h2d(to_dev.nbytes, device=dev_label)
                     return dev
 
                 dev_in = pool.acquire_resident(key, _upload,
@@ -204,7 +210,7 @@ def reconstruct_span(survivors, inputs: np.ndarray, target: int,
                         method=method))[:out_rows]
                 finally:
                     pool.release_resident(key)
-                pool.note_d2h(out.nbytes)
+                pool.note_d2h(out.nbytes, device=dev_label)
                 return _finish(out)
             return _finish(np.asarray(apply_matrix(
                 np.asarray(rows), to_dev, method=method))[:out_rows])

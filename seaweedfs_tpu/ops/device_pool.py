@@ -57,14 +57,17 @@ class Lease:
     staging buffer or jax device array).  Callers may swap `payload`
     while holding the lease (donation returns a new handle aliasing the
     same device memory); the swap travels back into the pool on
-    release."""
+    release.  `device` is the placement label the slab was leased for —
+    part of the free-list identity, so a slab leased for one device is
+    never handed to a caller staging for another."""
 
-    __slots__ = ("key", "payload", "nbytes")
+    __slots__ = ("key", "payload", "nbytes", "device")
 
-    def __init__(self, key, payload, nbytes: int):
+    def __init__(self, key, payload, nbytes: int, device=None):
         self.key = key
         self.payload = payload
         self.nbytes = nbytes
+        self.device = device
 
 
 class _Resident:
@@ -96,6 +99,11 @@ class DevicePool:
         self.evictions = 0
         self.h2d_bytes = 0
         self.d2h_bytes = 0
+        # per-device breakdowns (device label -> bytes): slab residency
+        # from the lease accounting, link traffic from note_h2d/note_d2h
+        self._dev_bytes: dict[str, int] = {}
+        self._dev_h2d: dict[str, int] = {}
+        self._dev_d2h: dict[str, int] = {}
         self._evictions_published = 0
         # HBM occupancy telemetry: peak bytes ever held, plus wall time
         # accrued while occupancy sat at >=95% of that peak (a pool
@@ -108,12 +116,22 @@ class DevicePool:
 
     # -- transfer/compute slots ---------------------------------------
 
-    def lease(self, key, factory: Callable[[], Any], nbytes: int) -> Lease:
-        """A slab for `key`: a previously released one, else
+    @staticmethod
+    def _dev_label(device) -> str:
+        return "host" if device is None else str(device)
+
+    def lease(self, key, factory: Callable[[], Any], nbytes: int,
+              device=None) -> Lease:
+        """A slab for `(key, device)`: a previously released one, else
         `factory()`.  The factory runs outside the lock (jax allocation
-        can be slow and reentrant)."""
+        can be slow and reentrant).  `device` is part of the free-list
+        identity: two callers leasing the same geometry for different
+        devices never alias slabs (a payload materialized on device A
+        handed to a dispatch against device B would silently re-upload
+        — or worse, compute against stale memory)."""
+        bucket_key = (key, self._dev_label(device))
         with self._lock:
-            bucket = self._free.get(key)
+            bucket = self._free.get(bucket_key)
             if bucket:
                 ls = bucket.pop()
                 self._free_order.remove(ls)
@@ -124,10 +142,12 @@ class DevicePool:
                 self._publish()
                 return ls
         payload = factory()
-        ls = Lease(key, payload, nbytes)
+        ls = Lease(bucket_key, payload, nbytes, self._dev_label(device))
         with self._lock:
             self.allocs += 1
             self._leased_bytes += nbytes
+            self._dev_bytes[ls.device] = \
+                self._dev_bytes.get(ls.device, 0) + nbytes
             self._leased_count += 1
             self._publish()
         return ls
@@ -147,7 +167,16 @@ class DevicePool:
         with self._lock:
             self._leased_bytes -= lease.nbytes
             self._leased_count -= 1
+            self._drop_dev_bytes_locked(lease)
             self._publish()
+
+    def _drop_dev_bytes_locked(self, lease: Lease):
+        dev = getattr(lease, "device", None) or "host"
+        left = self._dev_bytes.get(dev, 0) - lease.nbytes
+        if left > 0:
+            self._dev_bytes[dev] = left
+        else:
+            self._dev_bytes.pop(dev, None)
 
     # -- ref-counted resident content slabs ---------------------------
 
@@ -207,6 +236,7 @@ class DevicePool:
             if not self._free[ls.key]:
                 del self._free[ls.key]
             self._free_bytes -= ls.nbytes
+            self._drop_dev_bytes_locked(ls)
             self.evictions += 1
         while idle() > cap:
             victims = sorted(
@@ -219,17 +249,21 @@ class DevicePool:
             self._resident_bytes -= v.nbytes
             self.evictions += 1
 
-    def note_h2d(self, nbytes: int):
+    def note_h2d(self, nbytes: int, device=None):
+        dev = self._dev_label(device)
         with self._lock:
             self.h2d_bytes += nbytes
+            self._dev_h2d[dev] = self._dev_h2d.get(dev, 0) + nbytes
         from ..stats import metrics as stats
-        stats.EcDeviceH2dBytesCounter.inc(nbytes)
+        stats.EcDeviceH2dBytesCounter.labels(dev).inc(nbytes)
 
-    def note_d2h(self, nbytes: int):
+    def note_d2h(self, nbytes: int, device=None):
+        dev = self._dev_label(device)
         with self._lock:
             self.d2h_bytes += nbytes
+            self._dev_d2h[dev] = self._dev_d2h.get(dev, 0) + nbytes
         from ..stats import metrics as stats
-        stats.EcDeviceD2hBytesCounter.inc(nbytes)
+        stats.EcDeviceD2hBytesCounter.labels(dev).inc(nbytes)
 
     def _note_occupancy_locked(self):
         """Advance the watermark clock (lock held).  Time since the last
@@ -255,6 +289,8 @@ class DevicePool:
             return
         stats.DevicePoolHwmBytesGauge.set(self._hwm_bytes)
         stats.DevicePoolHwmSecondsGauge.set(self._hwm_seconds)
+        for dev, nbytes in self._dev_bytes.items():
+            stats.DevicePoolDeviceBytesGauge.labels(dev).set(nbytes)
         stats.DevicePoolSlotsGauge.labels("free").set(
             len(self._free_order))
         stats.DevicePoolSlotsGauge.labels("leased").set(self._leased_count)
@@ -289,11 +325,23 @@ class DevicePool:
                 "evictions": self.evictions,
                 "h2d_bytes": self.h2d_bytes,
                 "d2h_bytes": self.d2h_bytes,
+                "devices": {
+                    dev: {
+                        "bytes": self._dev_bytes.get(dev, 0),
+                        "h2d_bytes": self._dev_h2d.get(dev, 0),
+                        "d2h_bytes": self._dev_d2h.get(dev, 0),
+                    }
+                    for dev in sorted(set(self._dev_bytes)
+                                      | set(self._dev_h2d)
+                                      | set(self._dev_d2h))
+                },
                 "lanes": LANES.snapshot(),
             }
 
     def clear(self):
         with self._lock:
+            for ls in self._free_order:
+                self._drop_dev_bytes_locked(ls)
             self._free.clear()
             self._free_order.clear()
             self._residents.clear()

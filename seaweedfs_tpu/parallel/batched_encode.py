@@ -552,12 +552,30 @@ class _PipelineIO:
 def _device_inflight() -> int:
     """WEED_EC_DEVICE_INFLIGHT: device dispatches in flight before the
     completion thread must drain one (default 3).  Depth hides dispatch
-    and transfer latency — H2D, compute and D2H genuinely overlap."""
+    and transfer latency — H2D, compute and D2H genuinely overlap: the
+    staging slots (depth + 1 or more) are the double-buffered H2D ring,
+    the donated output slots (depth + 1) the D2H drain ring."""
     try:
         return max(1, int(
             os.environ.get("WEED_EC_DEVICE_INFLIGHT", "") or _INFLIGHT))
     except ValueError:
         return _INFLIGHT
+
+
+def _fused_crc_on(platform: str) -> bool:
+    """WEED_EC_FUSED_CRC: whether the pooled parity step also computes
+    every shard row's CRC32C on device ("1"/"0" force it; "auto" — the
+    default — fuses off-CPU and keeps the host crc32c walk on CPU
+    meshes, where the native kernel is ~30x the GF(2) bit-matmul CRC's
+    rate).  With the fused path active the host CRC walk leaves the
+    completion thread entirely — the pipeline's critical path is
+    read/dispatch/write only."""
+    raw = os.environ.get("WEED_EC_FUSED_CRC", "auto").strip().lower()
+    if raw in ("1", "on", "true", "fused", "yes"):
+        return True
+    if raw in ("0", "off", "false", "host", "no"):
+        return False
+    return platform != "cpu"
 
 
 def _encode_units_device(plans, units, chunk, writers, mesh,
@@ -570,20 +588,34 @@ def _encode_units_device(plans, units, chunk, writers, mesh,
     from ..ops import crc32c as crc_host
     from ..ops.crc_device import finalize
     from ..ops.device_pool import get_pool
-    from .mesh import (make_mesh, make_parity_step, make_sharded_encoder,
-                       words_capable)
+    from .mesh import (make_ec_mesh, make_parity_step,
+                       make_sharded_encoder, words_capable)
 
     wall0 = time.perf_counter()
     if mesh is None:
-        mesh = make_mesh()
+        mesh = make_ec_mesh()  # WEED_EC_DEVICE_SHARD picks the width
     n_data, n_block = mesh.devices.shape
-    # CPU meshes run the pooled persistent SWAR parity step and CRC on
-    # host (the device GF(2) CRC bit-matmul is ~30x slower than the
-    # native host kernel there — it was 97% of the old step's time);
-    # TPU meshes keep the fused device-CRC steps.
-    host_crc = (mesh.devices.flat[0].platform == "cpu" and chunk % 4 == 0)
-    width = (chunk // 4) if host_crc else chunk  # sharded trailing axis
-    if width % n_block:
+    platform = mesh.devices.flat[0].platform
+    # Path selection: the single-TPU-device Pallas words step when it
+    # can serve; otherwise the pooled persistent kb step (shard_map over
+    # the batch axis on multi-device meshes) whenever the chunk packs
+    # into int32 words; the bk XLA step is the odd-chunk fallback.
+    use_words = words_capable(mesh, chunk)
+    pooled = (not use_words) and chunk % 4 == 0
+    # Pooled-path CRC placement (WEED_EC_FUSED_CRC): fused — the parity
+    # step also emits every shard row's raw CRC32C image from the same
+    # HBM-resident words — or the host crc32c walk on the completion
+    # thread (the CPU-mesh default: the native host kernel is ~30x the
+    # GF(2) bit-matmul CRC's rate there).
+    fused = pooled and _fused_crc_on(platform)
+    host_crc = pooled and not fused
+    width = (chunk // 4) if pooled else chunk  # sharded trailing axis
+    if pooled and n_block != 1:
+        # the kb step shards the batch axis only — a shard row's CRC
+        # reduces over its whole width, so byte columns stay device-local
+        mesh = Mesh(mesh.devices.reshape(-1, 1), mesh.axis_names)
+        n_data, n_block = mesh.devices.shape
+    elif not pooled and width % n_block:
         mesh = Mesh(mesh.devices.reshape(-1, 1), mesh.axis_names)
         n_data, n_block = mesh.devices.shape
 
@@ -598,14 +630,18 @@ def _encode_units_device(plans, units, chunk, writers, mesh,
     pool = get_pool()
     single = mesh.devices.size == 1
     dev0 = mesh.devices.flat[0]
+    # the pool's free-lists and the link counters key per device; a
+    # sharded slab spans the mesh, so it accounts under one composite
+    # placement label
+    dev_label = str(dev0) if single else f"sharded:{mesh.devices.size}"
     sharding = NamedSharding(mesh, P("data", None, "block"))
     sharding_kb = NamedSharding(mesh, P(None, "data", "block"))
 
-    use_words = False
-    if host_crc:
-        step = make_parity_step(mesh)
+    if pooled:
+        step = make_parity_step(mesh, fused_crc=fused)
         layout = "kb"
-        backend = "device-pooled-swar"
+        backend = ("device-pooled-swar-fused-crc" if fused
+                   else "device-pooled-swar")
         # numpy -> jax via dlpack is ZERO-copy on the CPU backend: the
         # staging slot IS the device buffer, so H2D costs nothing (the
         # slot is recycled only after the completion thread synchronized
@@ -614,12 +650,13 @@ def _encode_units_device(plans, units, chunk, writers, mesh,
     else:
         # word-layout fast path: packed int32 views move host<->device
         # with no device bitcasts (the relayout costs 10x the kernel)
-        use_words = words_capable(mesh, chunk)
         step = make_sharded_encoder(mesh, words=use_words)
         layout = "bk"
         backend = "device-words" if use_words else "device-xla"
         zero_copy = False
 
+    # the staging slots double as the H2D ring: the reader fills slot
+    # N+1 while slot N's transfer/compute is in flight, at any depth
     n_slots = max(_SLOTS, depth + 1)
     io = _PipelineIO(plans, units, chunk, writers, b, layout, pool,
                      n_slots=n_slots)
@@ -627,10 +664,12 @@ def _encode_units_device(plans, units, chunk, writers, mesh,
 
     # donated output-slot ring (pooled path): depth+1 device slots the
     # persistent step aliases its parity into — the donation swap means
-    # the steady state allocates nothing on device per batch
+    # the steady state allocates nothing on device per batch; the ring
+    # is also the D2H drain buffer (the completion thread copies out of
+    # slot N while slot N+1 is still computing)
     out_ring: "queue.Queue" = queue.Queue()
     out_leases: list = []
-    if host_crc:
+    if pooled:
         oshape = (PARITY_SHARDS, b, width)
 
         def _out_factory():
@@ -639,7 +678,8 @@ def _encode_units_device(plans, units, chunk, writers, mesh,
 
         okey = ("ec-out", mesh, oshape)
         for _ in range(depth + 1):
-            ls = pool.lease(okey, _out_factory, PARITY_SHARDS * b * chunk)
+            ls = pool.lease(okey, _out_factory, PARITY_SHARDS * b * chunk,
+                            device=dev_label)
             out_leases.append(ls)
             out_ring.put(ls)
 
@@ -648,14 +688,15 @@ def _encode_units_device(plans, units, chunk, writers, mesh,
     k_shapes: set = set()
     kernel_lats: list = []  # host-timed dispatch->ready per batch
 
-    def _complete(slot, batch, out, t_disp, k_rows):
+    def _complete(slot, batch, out, crc_dev, t_disp, k_rows):
         """Synchronize one batch: D2H, per-chunk CRCs chained into the
         rolling shard-file CRCs (FIFO order — CRC chaining is order-
         dependent), slots recycled, parity handed to the writer."""
         buf = slot.payload
         t0 = time.perf_counter()
-        if host_crc:
+        if pooled:
             parity = None
+            fin = None
             if out is not None:
                 # copies out of the donated slot (required: the slot is
                 # re-donated for a later batch while the writer thread
@@ -664,22 +705,50 @@ def _encode_units_device(plans, units, chunk, writers, mesh,
                 lat = time.perf_counter() - t_disp
                 kernel_lats.append(lat)
                 profiling.record_device_batch(lat, units=len(batch),
-                                              k=k_rows)
-                pool.note_d2h(parity32.nbytes)
+                                              k=k_rows,
+                                              devices=mesh.devices.size)
+                pool.note_d2h(parity32.nbytes, device=dev_label)
                 out_ring.put(out)
                 parity = parity32.view(np.uint8).reshape(
                     PARITY_SHARDS, b, chunk)
-            for k, u in enumerate(batch):
-                w = writers[u.vol]
-                r = u.real_rows
-                for i in range(DATA_SHARDS):
-                    c = crc_host.crc32c(buf[i, k]) if i < r else zcrc
-                    w.crcs[i] = crc_host.crc32c_combine(
-                        w.crcs[i], c, chunk)
-                for j in range(PARITY_SHARDS):
-                    c = crc_host.crc32c(parity[j, k]) if r else zcrc
-                    w.crcs[DATA_SHARDS + j] = crc_host.crc32c_combine(
-                        w.crcs[DATA_SHARDS + j], c, chunk)
+                if fused:
+                    raw = np.asarray(crc_dev)
+                    pool.note_d2h(raw.nbytes, device=dev_label)
+                    fin = finalize(raw, chunk)  # (k_rows + 4, b)
+            if fused:
+                # the device already CRC'd every row (padding rows were
+                # zeroed in staging, so their image equals the cached
+                # zeros CRC) — only the O(1)-per-chunk combines remain
+                for k, u in enumerate(batch):
+                    w = writers[u.vol]
+                    r = u.real_rows
+                    for i in range(DATA_SHARDS):
+                        c = int(fin[i, k]) if i < k_rows else zcrc
+                        w.crcs[i] = crc_host.crc32c_combine(
+                            w.crcs[i], c, chunk)
+                    for j in range(PARITY_SHARDS):
+                        c = int(fin[k_rows + j, k]) if r else zcrc
+                        w.crcs[DATA_SHARDS + j] = crc_host.crc32c_combine(
+                            w.crcs[DATA_SHARDS + j], c, chunk)
+            else:
+                t_crc = time.perf_counter()
+                for k, u in enumerate(batch):
+                    w = writers[u.vol]
+                    r = u.real_rows
+                    for i in range(DATA_SHARDS):
+                        c = crc_host.crc32c(buf[i, k]) if i < r else zcrc
+                        w.crcs[i] = crc_host.crc32c_combine(
+                            w.crcs[i], c, chunk)
+                    for j in range(PARITY_SHARDS):
+                        c = crc_host.crc32c(parity[j, k]) if r else zcrc
+                        w.crcs[DATA_SHARDS + j] = crc_host.crc32c_combine(
+                            w.crcs[DATA_SHARDS + j], c, chunk)
+                with io.tlock:
+                    # distinct timer: this key's absence from the stage
+                    # stats is the proof the fused path took host CRC
+                    # off the critical path
+                    timers["host_crc"] = timers.get("host_crc", 0.0) \
+                        + (time.perf_counter() - t_crc)
             with io.tlock:
                 timers["encode_crc"] += time.perf_counter() - t0
             io.free_slots.put(slot)
@@ -693,8 +762,9 @@ def _encode_units_device(plans, units, chunk, writers, mesh,
             parity = np.ascontiguousarray(np.asarray(parity_dev))
             lat = time.perf_counter() - t_disp
             kernel_lats.append(lat)
-            profiling.record_device_batch(lat, units=len(batch), k=k_rows)
-            pool.note_d2h(parity.nbytes)
+            profiling.record_device_batch(lat, units=len(batch), k=k_rows,
+                                          devices=mesh.devices.size)
+            pool.note_d2h(parity.nbytes, device=dev_label)
             if use_words:  # packed int32 parity words -> bytes
                 parity = parity.view(np.uint8).reshape(
                     parity.shape[0], PARITY_SHARDS, chunk)
@@ -738,7 +808,8 @@ def _encode_units_device(plans, units, chunk, writers, mesh,
                     timers["lane_wait"] = timers.get("lane_wait", 0.0) \
                         + lane_wait
             t0 = time.perf_counter()
-            if host_crc:
+            crc_dev = None
+            if pooled:
                 out = None
                 if k_max > 0:
                     k_shapes.add(k_max)
@@ -748,13 +819,16 @@ def _encode_units_device(plans, units, chunk, writers, mesh,
                     else:
                         din = jax.device_put(
                             words, dev0 if single else sharding_kb)
-                        pool.note_h2d(words.nbytes)
+                        pool.note_h2d(words.nbytes, device=dev_label)
                     out = io.get(out_ring)  # backpressure at `depth`
                     if out is None:
                         break
                     # donation swap: the step aliases its result into
                     # the slot's buffer; the old handle is dead
-                    out.payload = step(din, out.payload)
+                    if fused:
+                        out.payload, crc_dev = step(din, out.payload)
+                    else:
+                        out.payload = step(din, out.payload)
             else:
                 if use_words:
                     # pin to the mesh's device: the caller may run
@@ -762,11 +836,11 @@ def _encode_units_device(plans, units, chunk, writers, mesh,
                     din = jax.device_put(buf.view(np.int32), dev0)
                 else:
                     din = jax.device_put(buf, sharding)
-                pool.note_h2d(buf.nbytes)
+                pool.note_h2d(buf.nbytes, device=dev_label)
                 out = step(din)
             with io.tlock:
                 timers["dispatch"] += time.perf_counter() - t0
-            if not io.put(done_q, (slot, batch, out, t0, k_max)):
+            if not io.put(done_q, (slot, batch, out, crc_dev, t0, k_max)):
                 break
         io.put(done_q, None)
         ct.join(timeout=600)
@@ -786,11 +860,11 @@ def _encode_units_device(plans, units, chunk, writers, mesh,
     # XLA cost analysis once per compiled geometry (pooled SWAR path;
     # StableHLO-level, no backend compile — see mesh.step_cost_analysis)
     kernel_cost = {}
-    if host_crc:
+    if pooled:
         from .mesh import step_cost_analysis
 
         for k in sorted(k_shapes):
-            geom = f"k{k}xb{b}xw{width}"
+            geom = f"k{k}xb{b}xw{width}" + ("f" if fused else "")
             entry = step_cost_analysis(
                 step, geom,
                 jax.ShapeDtypeStruct((k, b, width), np.int32),
@@ -805,7 +879,11 @@ def _encode_units_device(plans, units, chunk, writers, mesh,
         stage_stats["batch_units"] = b
         stage_stats["k_shapes"] = sorted(k_shapes)
         stage_stats["inflight"] = depth
+        stage_stats["staging_slots"] = n_slots
         stage_stats["zero_copy_h2d"] = zero_copy
+        stage_stats["devices"] = mesh.devices.size
+        stage_stats["device_shard"] = dev_label
+        stage_stats["crc_path"] = "host" if host_crc else "fused-device"
         for k in ("read", "dispatch", "encode_crc", "write"):
             stage_stats[f"{k}_frac"] = (
                 round(timers[k] / wall, 3) if wall > 0 else 0.0)
@@ -1305,7 +1383,7 @@ def rebuild_shards(base: str, mesh=None,
     from ..ops.crc_device import finalize
     from ..ops.device_pool import get_pool
     from ..storage.erasure_coding import to_ext
-    from .mesh import make_mesh, make_sharded_apply
+    from .mesh import make_ec_mesh, make_sharded_apply
 
     present = [i for i in range(TOTAL_SHARDS)
                if os.path.exists(base + to_ext(i))]
@@ -1329,7 +1407,7 @@ def rebuild_shards(base: str, mesh=None,
     offsets = list(range(0, shard_size, chunk))
 
     if mesh is None:
-        mesh = make_mesh()
+        mesh = make_ec_mesh()
     n_data, n_block = mesh.devices.shape
     if chunk % n_block:
         mesh = Mesh(mesh.devices.reshape(-1, 1), mesh.axis_names)
@@ -1342,15 +1420,20 @@ def rebuild_shards(base: str, mesh=None,
     step = make_sharded_apply(mesh, matrix)
     sharding = NamedSharding(mesh, P("data", None, "block"))
     pool = get_pool()
+    dev_label = (str(mesh.devices.flat[0]) if mesh.devices.size == 1
+                 else f"sharded:{mesh.devices.size}")
     # two pooled staging buffers: a buffer is refilled only after its
     # batch drained (which implies the host->device transfer completed);
     # leased from the slab pool so consecutive rebuilds with the same
-    # geometry reuse them instead of reallocating
+    # geometry reuse them instead of reallocating.  The lease carries
+    # the mesh's placement label: a rebuild against one device set must
+    # never be handed a slab staged for a different one.
     skey = ("rebuild-stage", (b, DATA_SHARDS, chunk))
     slots = [pool.lease(skey,
                         lambda: np.zeros((b, DATA_SHARDS, chunk),
                                          dtype=np.uint8),
-                        b * DATA_SHARDS * chunk) for _ in range(2)]
+                        b * DATA_SHARDS * chunk, device=dev_label)
+             for _ in range(2)]
 
     inputs = [open(base + to_ext(i), "rb") for i in chosen]
     _, _, flush_bytes, drop_cache = _write_knobs()
@@ -1391,7 +1474,7 @@ def rebuild_shards(base: str, mesh=None,
         def drain_one():
             batch_offs, out_dev, crc_dev = inflight.pop(0)
             out = np.ascontiguousarray(np.asarray(out_dev))
-            pool.note_d2h(out.nbytes)
+            pool.note_d2h(out.nbytes, device=dev_label)
             raw = np.asarray(crc_dev)
             for k, off in enumerate(batch_offs):
                 width = min(chunk, shard_size - off)
@@ -1427,7 +1510,7 @@ def rebuild_shards(base: str, mesh=None,
                     if width < chunk:
                         buf[k, i, width:] = 0
             dev = jax.device_put(buf, sharding)
-            pool.note_h2d(buf.nbytes)
+            pool.note_h2d(buf.nbytes, device=dev_label)
             out_dev, crc_dev = step(dev)
             inflight.append((batch_offs, out_dev, crc_dev))
             if len(inflight) >= 2:

@@ -18,10 +18,12 @@ over ICI.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops import gf256
@@ -42,6 +44,45 @@ def make_mesh(devices=None, axes: tuple[str, str] = ("data", "block")
             break
     arr = np.array(devices).reshape(n // block, block)
     return Mesh(arr, axes)
+
+
+def shard_devices(devices=None) -> list:
+    """The device set the EC dispatch path shards batches over, governed
+    by WEED_EC_DEVICE_SHARD:
+
+      <int>  — exactly that many devices (clamped to what exists)
+      "auto" / unset — every device on real accelerators; on CPU
+               backends, min(devices, usable host cores).  XLA's virtual
+               CPU devices beyond the physical core count only add
+               partitioning overhead, and a 1-device mesh restores the
+               zero-copy dlpack H2D path — on a 1-core box "auto"
+               collapses the 8-way virtual mesh back to the fast path.
+    """
+    if devices is None:
+        devices = jax.devices()
+    raw = os.environ.get("WEED_EC_DEVICE_SHARD", "").strip().lower()
+    n = len(devices)
+    if raw and raw != "auto":
+        try:
+            n = max(1, min(len(devices), int(raw)))
+        except ValueError:
+            pass
+    elif devices[0].platform == "cpu":
+        try:
+            cores = len(os.sched_getaffinity(0))
+        except AttributeError:  # pragma: no cover - non-linux
+            cores = os.cpu_count() or 1
+        n = max(1, min(len(devices), cores))
+    return list(devices)[:n]
+
+
+def make_ec_mesh(devices=None) -> Mesh:
+    """The EC dispatch mesh: shard_devices() laid out (n, 1) — batches
+    shard over the "data" axis only.  The fused CRC reduces over a whole
+    shard row, so the byte-column ("block") axis stays device-local and
+    every per-row CRC completes without a cross-device combine."""
+    devs = shard_devices(devices)
+    return Mesh(np.array(devs).reshape(-1, 1), ("data", "block"))
 
 
 def _parity_bits_matmul(bit_matrix, data):
@@ -111,10 +152,13 @@ _PARITY_STEP_CACHE: dict = {}
 
 def make_parity_step(mesh: Mesh, data_shards: int = 10,
                      parity_shards: int = 4,
-                     matrix=None, key=None):
-    """Persistent parity-only step for the pooled device dispatch path:
+                     matrix=None, key=None, fused_crc: bool = False):
+    """Persistent parity step for the pooled device dispatch path:
     (data32 (k, B, W) int32 packed bytes, out (p, B, W) int32 DONATED)
-    -> (p, B, W) int32 parity words.
+    -> (p, B, W) int32 parity words, plus — with fused_crc — the raw
+    CRC32C images (k + p, B) uint32 of every data and parity row,
+    computed on device over the same HBM-resident words the parity SWAR
+    reads (host side finalizes with crc_device.finalize).
 
     The k axis is the COMPACTED data-row count: trailing all-zero shard
     rows (the format's zero-padded tail striping) contribute nothing to
@@ -122,13 +166,21 @@ def make_parity_step(mesh: Mesh, data_shards: int = 10,
     distinct k (bounded by data_shards shapes).  The donated `out` slot
     makes XLA alias the result into the same device buffer every batch,
     which is what lets the steady state run with zero per-batch device
-    allocations.  CRCs are deliberately NOT fused here: this step serves
-    CPU meshes, where the host crc32c kernel is ~30x the GF(2) bit-matmul
-    CRC's rate, so the pipeline CRCs on host while the next batch is in
-    flight (TPU meshes keep the fused device-CRC steps below).
+    allocations.
 
-    One jitted callable per (mesh, geometry), shared across encode calls;
-    XLA's shape-keyed trace cache handles the per-k retraces.
+    Multi-device meshes run the step through shard_map: the batch axis
+    partitions over "data" with PartitionSpec, every device computes the
+    parity (and fused CRC) of its own batch slice, and no collective is
+    needed because a shard row's bytes never cross devices (the mesh's
+    "block" axis must be 1 when fused_crc is set — the CRC reduces over
+    the whole W axis).
+
+    fused_crc=False keeps the CPU-mesh default: the host crc32c kernel
+    is ~30x the GF(2) bit-matmul CRC's rate on CPU, so the pipeline CRCs
+    on host while the next batch is in flight.  TPU meshes fuse.
+
+    One jitted callable per (mesh, geometry, fused_crc), shared across
+    encode calls; XLA's shape-keyed trace cache handles per-k retraces.
 
     matrix / key: an alternative GF(2^8) coefficient matrix (a code
     family's parity or lane generator rows) with an optional hashable
@@ -137,13 +189,15 @@ def make_parity_step(mesh: Mesh, data_shards: int = 10,
     donation, sharding, the SWAR bit-plane kernel — changes, so every
     code family rides the same persistent jitted dispatch.
     """
+    from ..ops.crc_device import batched_crc32c_raw
     from ..ops.rs_jax import _SPREAD, _bit_constants_cached
 
     if matrix is None:
-        cache_key = (mesh, data_shards, parity_shards)
+        cache_key = (mesh, data_shards, parity_shards, fused_crc)
     else:
         matrix = np.ascontiguousarray(matrix, dtype=np.uint8)
-        cache_key = (mesh, key if key is not None else matrix.tobytes())
+        cache_key = (mesh, key if key is not None else matrix.tobytes(),
+                     fused_crc)
     cached = _PARITY_STEP_CACHE.get(cache_key)
     if cached is not None:
         return cached
@@ -151,6 +205,10 @@ def make_parity_step(mesh: Mesh, data_shards: int = 10,
         matrix = gf256.parity_matrix(data_shards,
                                      data_shards + parity_shards)
     consts = jnp.asarray(_bit_constants_cached(*_matrix_key(matrix)))
+    if fused_crc and mesh.devices.shape[1] != 1:
+        raise ValueError(
+            "fused-CRC parity step needs a (n, 1) mesh: the CRC reduces "
+            f"over the block axis, got mesh shape {mesh.devices.shape}")
 
     def _parity(data32, out):
         # SWAR over packed words, batched over (B, W): one set bit per
@@ -166,12 +224,31 @@ def make_parity_step(mesh: Mesh, data_shards: int = 10,
                 acc = acc ^ (t[None, :, :] * consts[:, j, bit][:, None, None])
         return acc
 
+    def _fused(data32, out):
+        parity = _parity(data32, out)
+        full = jnp.concatenate([data32, parity], axis=0)  # (k+p, B, W)
+        # int32 words -> the row's byte stream: little-endian byte order
+        # within a word matches memory order, so the bitcast+reshape is
+        # layout-free
+        byts = jax.lax.bitcast_convert_type(full, jnp.uint8)
+        byts = byts.reshape(full.shape[0], full.shape[1], -1)
+        return parity, batched_crc32c_raw(byts)
+
+    body = _fused if fused_crc else _parity
     if mesh.devices.size == 1:
-        step = jax.jit(_parity, donate_argnums=(1,))
+        step = jax.jit(body, donate_argnums=(1,))
+    elif fused_crc:
+        sh = P(None, "data", None)
+        step = jax.jit(
+            shard_map(body, mesh=mesh, in_specs=(sh, sh),
+                      out_specs=(sh, P(None, "data")), check_rep=False),
+            donate_argnums=(1,))
     else:
-        sh = NamedSharding(mesh, P(None, "data", "block"))
-        step = jax.jit(_parity, in_shardings=(sh, sh), out_shardings=sh,
-                       donate_argnums=(1,))
+        sh = P(None, "data", "block")
+        step = jax.jit(
+            shard_map(body, mesh=mesh, in_specs=(sh, sh), out_specs=sh,
+                      check_rep=False),
+            donate_argnums=(1,))
     _PARITY_STEP_CACHE[cache_key] = step
     return step
 

@@ -295,15 +295,19 @@ _DEVICE_TIMELINE: "deque[dict]" = deque(maxlen=512)
 _KERNEL_COST: dict[str, dict] = {}
 
 
-def record_device_batch(latency_s: float, units: int = 0, k: int = 0):
+def record_device_batch(latency_s: float, units: int = 0, k: int = 0,
+                        devices: int = 1):
     """One EC device batch completed: host-observed dispatch->ready
-    latency (rides the WEED_EC_DEVICE_INFLIGHT completion FIFO)."""
-    _stats.EcKernelDispatchHistogram.observe(latency_s)
+    latency (rides the WEED_EC_DEVICE_INFLIGHT completion FIFO).
+    `devices` is the shard width of the dispatch — the histogram is
+    labeled by it, so a stall that only appears at a given mesh width
+    shows up as its own latency series."""
+    _stats.EcKernelDispatchHistogram.labels(str(devices)).observe(latency_s)
     with _tl_lock:
         _DEVICE_TIMELINE.append({
             "ts": round(time.time(), 3),
             "dispatch_ready_ms": round(latency_s * 1e3, 3),
-            "units": units, "k": k})
+            "units": units, "k": k, "devices": devices})
 
 
 def record_kernel_cost(geometry: str, flops: float, bytes_accessed: float,

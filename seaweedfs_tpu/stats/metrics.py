@@ -336,10 +336,17 @@ DevicePoolEvictionsCounter = REGISTRY.counter(
     "idle EC device-pool slabs evicted by the WEED_EC_DEVICE_POOL_MB cap")
 EcDeviceH2dBytesCounter = REGISTRY.counter(
     "SeaweedFS_volumeServer_ec_device_h2d_bytes_total",
-    "bytes staged host->device by the EC device dispatch paths")
+    "bytes staged host->device by the EC device dispatch paths, by "
+    "target device (\"host\" = host staging, \"sharded:N\" = an N-way "
+    "sharded mesh transfer)", ("device",))
 EcDeviceD2hBytesCounter = REGISTRY.counter(
     "SeaweedFS_volumeServer_ec_device_d2h_bytes_total",
-    "bytes fetched device->host by the EC device dispatch paths")
+    "bytes fetched device->host by the EC device dispatch paths, by "
+    "source device", ("device",))
+DevicePoolDeviceBytesGauge = REGISTRY.gauge(
+    "SeaweedFS_volumeServer_device_pool_device_bytes",
+    "EC device-pool slab bytes by placement (per-device free-lists "
+    "never cross devices)", ("device",))
 FilerChunkCacheCounter = REGISTRY.counter(
     "SeaweedFS_filer_chunk_cache_total",
     "filer chunk cache lookups", ("result",))
@@ -443,7 +450,8 @@ ProfilerRouteSamplesCounter = REGISTRY.counter(
     ("route",))
 EcKernelDispatchHistogram = REGISTRY.histogram(
     "SeaweedFS_volumeServer_ec_kernel_dispatch_ready_seconds",
-    "host-observed dispatch->ready latency per EC device batch")
+    "host-observed dispatch->ready latency per EC device batch, by the "
+    "device count the batch was sharded over", ("devices",))
 EcKernelFlopsGauge = REGISTRY.gauge(
     "SeaweedFS_volumeServer_ec_kernel_flops",
     "XLA cost-analysis flops per compiled EC parity geometry",

@@ -45,3 +45,21 @@ def reference_fixture(relpath):
 # Custom markers are registered in pytest.ini (the shared config) —
 # tests/test_markers_registered.py fails tier-1 if a test file uses a
 # marker that is not listed there.
+
+
+def pytest_collection_modifyitems(config, items):
+    """@pytest.mark.multidevice needs the >=4-device mesh this conftest
+    forces above (8 virtual CPU devices).  If the backend came up
+    smaller anyway — an outer XLA_FLAGS pinning the count, or a jax
+    build that ignores the flag — skip rather than shard a 1-device
+    mesh and silently not exercise the sharded path."""
+    import pytest
+
+    if jax.device_count() >= 4:
+        return
+    skip = pytest.mark.skip(
+        reason=f"multidevice needs >=4 devices, backend has "
+               f"{jax.device_count()}")
+    for item in items:
+        if "multidevice" in item.keywords:
+            item.add_marker(skip)

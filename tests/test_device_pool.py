@@ -52,6 +52,36 @@ class TestLeases:
         snap = pool.snapshot()
         assert snap["free_slots"] == 0 and snap["bytes"] == 0
 
+    def test_per_device_free_lists_never_alias(self, pool):
+        """Regression: a slab released for one device must never be
+        handed to a lease against another — same geometry key, different
+        device, different slab (a device-A payload served to a device-B
+        dispatch would recompute against the wrong memory)."""
+        key = ("ec-out", (4, 8, 256))
+        a = pool.lease(key, lambda: "slab-dev0", 1 << 10, device="cpu:0")
+        pool.release(a)
+        b = pool.lease(key, lambda: "slab-dev1", 1 << 10, device="cpu:1")
+        assert b.payload == "slab-dev1"  # NOT the released dev0 slab
+        assert pool.snapshot()["allocs"] == 2
+        # same device re-leases the released slab
+        c = pool.lease(key, lambda: "fresh", 1 << 10, device="cpu:0")
+        assert c.payload == "slab-dev0"
+        assert pool.snapshot()["lease_hits"] == 1
+
+    def test_per_device_accounting_in_snapshot(self, pool):
+        a = pool.lease("k", lambda: "a", 512, device="cpu:0")
+        pool.lease("k", lambda: "b", 256, device="cpu:1")
+        pool.note_h2d(100, device="cpu:0")
+        pool.note_d2h(40, device="cpu:1")
+        devs = pool.snapshot()["devices"]
+        assert devs["cpu:0"]["bytes"] == 512
+        assert devs["cpu:0"]["h2d_bytes"] == 100
+        assert devs["cpu:1"]["bytes"] == 256
+        assert devs["cpu:1"]["d2h_bytes"] == 40
+        pool.discard(a)
+        assert "cpu:0" not in pool.snapshot()["devices"] or \
+            pool.snapshot()["devices"]["cpu:0"]["bytes"] == 0
+
     def test_lru_eviction_under_cap(self, pool, monkeypatch):
         monkeypatch.setenv("WEED_EC_DEVICE_POOL_MB", "0.002")  # 2 KiB
         leases = _lease_some(pool, "k", 3, nbytes=1 << 10)
