@@ -824,6 +824,109 @@ def bench_ec_rebuild(data_bytes: int = 24 << 20) -> dict:
     }
 
 
+def bench_master_failover(warmup_acks: int = 25,
+                          settle_acks: int = 25) -> dict:
+    """Control-plane HA cost: write-unavailability window across a raft
+    leader kill.  Three in-process masters replicate the control FSM; a
+    writer assigns fids and stores 1 KB needles through whichever master
+    answers, time-stamping every ack.  Mid-storm the leader is killed
+    (server + raft stopped, no goodbye), and the window is the gap from
+    the last ack before the kill to the first ack after re-election —
+    the number a client actually experiences.  Reported in the bench
+    JSON so every future PR sees the failover cost."""
+    import socket
+    import tempfile
+
+    from seaweedfs_tpu.master.server import MasterServer
+    from seaweedfs_tpu.rpc.http_rpc import RpcError, call
+    from seaweedfs_tpu.volume_server.server import VolumeServer
+
+    socks = []
+    for _ in range(3):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    addrs = [f"127.0.0.1:{p}" for p in ports]
+
+    workdir = tempfile.mkdtemp(prefix="swbench_failover_")
+    masters = []
+    for i, p in enumerate(ports):
+        d = os.path.join(workdir, f"m{i}")
+        os.makedirs(d)
+        m = MasterServer(port=p, peers=list(addrs), raft_dir=d,
+                         raft_election_timeout=0.3, pulse_seconds=0.5,
+                         volume_size_limit_mb=256)
+        m.start()
+        masters.append(m)
+    vdir = os.path.join(workdir, "vol")
+    os.makedirs(vdir)
+    vs = VolumeServer([vdir], ",".join(addrs), port=0,
+                      pulse_seconds=0.3, max_volume_counts=[8])
+    vs.start()
+    vs.heartbeat_once()
+
+    payload = b"x" * 1024
+    alive = list(masters)
+
+    def write_once(timeout: float) -> bool:
+        # one assign+store attempt through any answering master;
+        # counts as an ack only when the needle is durably stored
+        for m in alive:
+            try:
+                a = call(m.address, "/dir/assign", timeout=timeout)
+                call(a["url"], f"/{a['fid']}", raw=payload,
+                     method="POST", timeout=timeout)
+                return True
+            except RpcError:
+                continue
+        return False
+
+    acks: list[float] = []
+
+    def storm(target: int, deadline_s: float) -> None:
+        deadline = time.monotonic() + deadline_s
+        got = 0
+        while got < target and time.monotonic() < deadline:
+            if write_once(timeout=2):
+                acks.append(time.monotonic())
+                got += 1
+            else:
+                time.sleep(0.02)
+
+    window = -1.0
+    elections = 0
+    try:
+        storm(warmup_acks, deadline_s=30)
+        leader = next((m for m in masters if m.raft.is_leader), None)
+        if leader is not None and acks:
+            pre_term = max(m.raft.term for m in masters)
+            alive = [m for m in masters if m is not leader]
+            last_before = acks[-1]
+            leader.stop()
+            storm(settle_acks, deadline_s=30)
+            after = [t for t in acks if t > last_before]
+            if after:
+                window = after[0] - last_before
+            elections = max(m.raft.term for m in alive) - pre_term
+    finally:
+        vs.stop()
+        for m in alive:
+            m.stop()
+        shutil.rmtree(workdir, ignore_errors=True)
+    return {
+        "metric": "master_failover_unavailability",
+        "unit": "seconds",
+        "masters": len(masters),
+        "election_timeout_s": 0.3,
+        "acked_writes": len(acks),
+        "terms_advanced": elections,
+        "unavailability_window_s": round(window, 3),
+    }
+
+
 def bench_qos_isolation(num_files: int = 800, read_reqs: int = 3000,
                         scrub_vols: int = 3,
                         scrub_vol_bytes: int = 8 << 20) -> dict:
@@ -1499,6 +1602,14 @@ def main():
     except Exception as e:
         print(f"note: ec rebuild bench failed: {e}", file=sys.stderr)
 
+    # -- master leader-kill write-unavailability window ----------------------
+    failover_stats: dict = {}
+    try:
+        _policy.reset_state()
+        failover_stats = bench_master_failover()
+    except Exception as e:
+        print(f"note: master failover bench failed: {e}", file=sys.stderr)
+
     # -- S3 gateway vs filer data plane --------------------------------------
     s3_stats: dict = {}
     try:
@@ -1572,6 +1683,7 @@ def main():
         "ec_degraded_read_error": deg_err,
         "qos_isolation": qos_iso,
         "ec_rebuild": ec_rebuild_stats,
+        "master_failover": failover_stats,
         "s3_put_rps": round(s3_stats.get("s3_put_rps", 0.0), 1),
         "s3_get_rps": round(s3_stats.get("s3_get_rps", 0.0), 1),
         "filer_put_rps": round(s3_stats.get("filer_put_rps", 0.0), 1),
@@ -1597,7 +1709,8 @@ def main():
 if __name__ == "__main__":
     # single-phase mode: `python bench.py ec_rebuild` runs one phase and
     # prints its JSON alone — the full suite stays the no-argument default
-    _phases = {"ec_rebuild": bench_ec_rebuild}
+    _phases = {"ec_rebuild": bench_ec_rebuild,
+               "master_failover": bench_master_failover}
     if len(sys.argv) > 1:
         if sys.argv[1] not in _phases:
             sys.exit(f"unknown bench phase {sys.argv[1]!r}; "

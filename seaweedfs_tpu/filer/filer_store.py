@@ -196,21 +196,40 @@ class ShardedSqliteStore(FilerStore):
     hashed sub-DBs) — spreading directories over independent databases
     keeps per-file lock contention and compaction local to a shard."""
 
-    def __init__(self, directory: str, shard_count: int = 8):
+    def __init__(self, directory: str, shard_count: Optional[int] = None):
         import os
+
+        from .shard_map import default_slots
 
         os.makedirs(directory, exist_ok=True)
         self.directory = directory
-        self.shard_count = shard_count
+        self.shard_count = shard_count or default_slots()
         self._shards = [
             SqliteStore(os.path.join(directory, f"meta_{i:02x}.db"))
-            for i in range(shard_count)]
+            for i in range(self.shard_count)]
 
     def _shard(self, dir_path: str) -> SqliteStore:
-        import hashlib as _hashlib
+        from .shard_map import slot_of
 
-        digest = _hashlib.md5(dir_path.encode()).digest()
-        return self._shards[digest[0] % self.shard_count]
+        return self._shards[slot_of(dir_path, self.shard_count)]
+
+    # -- slot-level access (cluster mode: handover + dump) --------------------
+    def slot_store(self, slot: int) -> SqliteStore:
+        return self._shards[slot % self.shard_count]
+
+    def dump_slot(self, slot: int, limit: int = 100_000) -> list[dict]:
+        """Every entry in one shard slot, for lease handover to the next
+        holder.  Slot i is exactly the local meta_{i:02x}.db file, since
+        the cluster shard map hashes with the same function."""
+        rows = self.slot_store(slot)._conn().execute(
+            "SELECT meta FROM filemeta ORDER BY dir, name LIMIT ?",
+            (limit,)).fetchall()
+        return [json.loads(r[0]) for r in rows]
+
+    def load_slot(self, slot: int, entries: list[dict]):
+        store = self.slot_store(slot)
+        for d in entries:
+            store.insert_entry(Entry.from_dict(d))
 
     def insert_entry(self, entry: Entry):
         self._shard(entry.parent).insert_entry(entry)

@@ -34,6 +34,7 @@ from .entry import Attr, Entry, FileChunk, total_size
 from .filechunk_manifest import (MANIFEST_BATCH, has_chunk_manifest,
                                  maybe_manifestize, resolve_chunk_manifest)
 from .filechunks import etag_of_chunks, read_chunk_views
+from ..wdclient.masterclient import MasterClient
 from .filer import Filer
 from .filer_conf import FilerConf
 from .filer_store import FilerStore, NotFoundError
@@ -69,7 +70,13 @@ class FilerServer:
                  cipher: bool = False,
                  cache_dir: str = "",
                  cache_disk_bytes: int = 1 << 30):
-        self.master_address = master_address
+        # -master may name the whole raft trio ("a,b,c"): every
+        # master call then fails over through the MasterClient (leader
+        # hints, per-master breakers) instead of pinning one address
+        self.masters = [m.strip() for m in master_address.split(",")
+                        if m.strip()]
+        self.master_address = self.masters[0]
+        self._master_client = MasterClient(self.masters, name="filer")
         self.chunk_size = chunk_size
         self.replication = replication
         self.collection = collection
@@ -195,10 +202,22 @@ class FilerServer:
         interval = 5.0
         while not self._stop_event.is_set():
             try:
-                r = call(self.master_address, "/cluster/register",
-                         {"type": "filer", "address": self.address},
-                         timeout=10)
-                interval = min(5.0, float(r.get("pulse_seconds", 5.0)))
+                # every master keeps its own in-memory membership
+                # registry, so announce to all of them — the one that
+                # wins the next election must already know this filer
+                reachable = 0
+                for m in self.masters:
+                    try:
+                        r = call(m, "/cluster/register",
+                                 {"type": "filer",
+                                  "address": self.address}, timeout=10)
+                        reachable += 1
+                        interval = min(5.0,
+                                       float(r.get("pulse_seconds", 5.0)))
+                    except RpcError:
+                        continue
+                if not reachable:
+                    raise RpcError("no master reachable", 503)
             except RpcError:
                 pass
             self._stop_event.wait(interval)
@@ -215,17 +234,15 @@ class FilerServer:
             # per-path TTL rules land chunks on TTL volume layouts the
             # master expires wholesale (filer_conf.go -> assign ttl)
             query += f"&ttl={ttl}"
-        return policy.call_policy(
-            self.master_address, f"/dir/assign?{query}", timeout=30,
-            idempotent=True)
+        return self._master_client.call(f"/dir/assign?{query}",
+                                        timeout=30)
 
     def _lookup_urls(self, fid: str) -> list[str]:
         """All replica holders of a fid's volume, via the policy layer
         (lookup GETs retry with jittered backoff on a flaky master)."""
         vid = fid.split(",")[0]
-        found = policy.call_policy(
-            self.master_address, f"/dir/lookup?volumeId={vid}",
-            timeout=10)
+        found = self._master_client.call(
+            f"/dir/lookup?volumeId={vid}", timeout=10)
         return [l["url"] for l in found["locations"]]
 
     def _lookup_url(self, fid: str) -> str:

@@ -1,0 +1,139 @@
+"""Replicated directory-prefix shard map for filer metadata.
+
+The filer's ShardedSqliteStore hashes each directory into one of N
+slots (md5(dir)[0] % N).  To scale that across machines, the master FSM
+holds this map: slot -> lease holder, with per-holder fair-share
+acquisition and lease expiry.  Store servers renew through the raft log
+(`filer.lease` commands), so a failed-over master serves the exact same
+assignment and two holders can never both believe they own a slot
+beyond one lease TTL.
+
+Deterministic by construction: every input (holder, now, ttl) rides in
+the replicated command; no wall-clock or RNG reads happen here.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from typing import Optional
+
+
+def default_slots() -> int:
+    try:
+        return int(os.environ.get("WEED_FILER_SHARDS", "") or 8)
+    except ValueError:
+        return 8
+
+
+def slot_of(dir_path: str, slots: int) -> int:
+    """Same hash the ShardedSqliteStore uses for its local files, so
+    slot i of the map is exactly the holder's local meta_{i:02x}.db."""
+    return hashlib.md5(dir_path.encode()).digest()[0] % slots
+
+
+class ShardMap:
+    def __init__(self, slots: Optional[int] = None):
+        self.slots = int(slots) if slots else default_slots()
+        # slot -> {"holder": addr, "expires": epoch-seconds}
+        self.holders: dict[int, dict] = {}
+        # slot -> last holder that gave it up (handover source)
+        self.prev: dict[int, str] = {}
+        # holder -> lease expiry; the membership that fair shares are
+        # computed over (a newly-joined holder must count toward the
+        # divisor BEFORE it owns any slot, or incumbents never shed)
+        self.members: dict[str, float] = {}
+        self.epoch = 0
+
+    # -- lease protocol (applied under the master FSM) ------------------------
+    def _drop(self, slot: int):
+        entry = self.holders.pop(slot, None)
+        if entry is not None:
+            self.prev[slot] = entry["holder"]
+
+    def _expire(self, now: float) -> bool:
+        changed = False
+        for slot in [s for s, h in self.holders.items()
+                     if h["expires"] <= now]:
+            self._drop(slot)
+            changed = True
+        for m in [m for m, exp in self.members.items() if exp <= now]:
+            del self.members[m]
+        return changed
+
+    def lease(self, holder: str, now: float, ttl: float) -> dict:
+        """Renew the holder's fair share and grant free slots up to it.
+        Slots over the fair share are shed at renewal (recorded in
+        `prev` for handover) — the response tells the holder exactly
+        what it still owns, so there is never a moment with two live
+        owners; membership churn converges within ~one lease TTL."""
+        changed = self._expire(now)
+        self.members[holder] = now + ttl
+        active = ({h["holder"] for h in self.holders.values()}
+                  | set(self.members))
+        fair = -(-self.slots // max(1, len(active)))  # ceil
+        held = sorted(s for s, h in self.holders.items()
+                      if h["holder"] == holder)
+        keep, shed = held[:fair], held[fair:]
+        for slot in keep:
+            self.holders[slot]["expires"] = now + ttl
+        for slot in shed:
+            self._drop(slot)
+            changed = True
+        for slot in range(self.slots):
+            if len(keep) >= fair:
+                break
+            if slot not in self.holders:
+                self.holders[slot] = {"holder": holder,
+                                      "expires": now + ttl}
+                keep.append(slot)
+                changed = True
+        if changed:
+            self.epoch += 1
+        return {"epoch": self.epoch, "slots": sorted(keep), "ttl": ttl,
+                "prev": {str(s): self.prev.get(s, "") for s in keep},
+                "map": self.assignments()}
+
+    def release(self, holder: str, now: float) -> dict:
+        """Graceful departure: free every slot immediately (the holder
+        stays up long enough for successors to pull a handover dump)."""
+        freed = [s for s, h in self.holders.items()
+                 if h["holder"] == holder]
+        for slot in freed:
+            self._drop(slot)
+        self.members.pop(holder, None)
+        if freed:
+            self.epoch += 1
+        return {"epoch": self.epoch, "released": sorted(freed),
+                "map": self.assignments()}
+
+    # -- views ----------------------------------------------------------------
+    def assignments(self) -> dict:
+        return {str(s): h["holder"]
+                for s, h in sorted(self.holders.items())}
+
+    def holder_of(self, dir_path: str) -> str:
+        entry = self.holders.get(slot_of(dir_path, self.slots))
+        return entry["holder"] if entry else ""
+
+    def to_dict(self) -> dict:
+        return {"slots": self.slots, "epoch": self.epoch,
+                "holders": {str(s): dict(h)
+                            for s, h in sorted(self.holders.items())},
+                "prev": {str(s): p
+                         for s, p in sorted(self.prev.items())},
+                "members": {m: exp
+                            for m, exp in sorted(self.members.items())}}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ShardMap":
+        d = d or {}
+        m = cls(slots=d.get("slots") or None)
+        m.epoch = int(d.get("epoch", 0))
+        m.holders = {int(s): {"holder": h["holder"],
+                              "expires": float(h["expires"])}
+                     for s, h in d.get("holders", {}).items()}
+        m.prev = {int(s): p for s, p in d.get("prev", {}).items()}
+        m.members = {k: float(v)
+                     for k, v in d.get("members", {}).items()}
+        return m

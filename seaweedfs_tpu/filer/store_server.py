@@ -9,18 +9,40 @@ embedded store kind behind HTTP/JSON routes, and RemoteStore is a
 FilerStore client speaking to it over pooled keep-alive connections.
 Filers configured with `-store remote -storeAddress host:port` keep no
 local metadata at all — kill one, start another, same namespace.
+
+Cluster mode (`-master` given): multiple store servers split the
+directory-hash shard space.  The authoritative slot→holder map lives in
+the MASTER's replicated FSM (filer/shard_map.py): each server leases its
+fair share through `/filer/shard_lease` (a raft-committed command), so
+a failed-over master serves the identical assignment.  Requests for a
+slot held elsewhere are proxied to the holder (one hop, loop-guarded by
+X-Shard-Hop); newly-acquired slots pull a handover dump from the
+previous holder when it is still alive.
 """
 
 from __future__ import annotations
 
+import os
 import threading
+import urllib.parse
 from typing import Optional
 
 from ..rpc.http_rpc import Request, RpcError, RpcServer, call
+from ..util import glog
 from .entry import Entry
 from .filer_store import (FilerStore, MemoryStore, NotFoundError,
                           PerBucketStoreRouter, ShardedSqliteStore,
                           SqliteStore)
+from .shard_map import default_slots, slot_of
+
+HOP_HEADER = "X-Shard-Hop"  # one proxy hop max, never a forwarding loop
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
 
 
 def make_store(kind: str, directory: Optional[str] = None) -> FilerStore:
@@ -46,13 +68,25 @@ class FilerStoreServer:
     """`weed filer.store`: host one embedded store for many filers."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 store: Optional[FilerStore] = None):
+                 store: Optional[FilerStore] = None,
+                 masters: Optional[list[str]] = None):
         self.store = store or MemoryStore()
+        self.masters = [m for m in (masters or []) if m]
         # one writer lock: the embedded stores are already thread-safe,
         # but insert/update of the SAME path from two filers must not
         # interleave partially (universal_redis_store serialises per key
         # through redis itself)
         self._lock = threading.RLock()
+        # cluster-mode shard state (all under _lock)
+        self._slots = getattr(self.store, "shard_count", 0) \
+            or default_slots()
+        self._held: set[int] = set()
+        self._map: dict[int, str] = {}
+        self._epoch = 0
+        self._lease_ttl = _env_float("WEED_FILER_SHARD_LEASE", 10.0)
+        self._lease_stop = threading.Event()
+        self._lease_thread: Optional[threading.Thread] = None
+        self._pulled: set[int] = set()  # slots already handover-filled
         self.server = RpcServer(host, port)
         self.server.add("POST", "/store/insert", self._h_insert)
         self.server.add("POST", "/store/update", self._h_insert)
@@ -61,6 +95,8 @@ class FilerStoreServer:
         self.server.add("POST", "/store/delete_children",
                         self._h_delete_children)
         self.server.add("GET", "/store/list", self._h_list)
+        self.server.add("POST", "/store/rename", self._h_rename)
+        self.server.add("GET", "/store/dump", self._h_dump)
         self.server.add("GET", "/store/status", self._h_status)
 
     @property
@@ -69,45 +105,268 @@ class FilerStoreServer:
 
     def start(self):
         self.server.start()
+        if self.masters:
+            try:
+                self._lease_once()  # serve with slots from the start
+            except RpcError as e:
+                glog.warningf("filer.store: initial shard lease "
+                              "failed: %s", e)
+            self._lease_thread = threading.Thread(
+                target=self._lease_loop, daemon=True)
+            self._lease_thread.start()
 
     def stop(self):
+        self._lease_stop.set()
+        if self._lease_thread is not None:
+            self._lease_thread.join(timeout=5)
+            self._lease_thread = None
+        if self.masters:
+            try:  # graceful: free slots now so peers take over instantly
+                self._master_call("/filer/shard_lease",
+                                  {"holder": self.address,
+                                   "release": True})
+            except RpcError:
+                pass  # the lease TTL frees them anyway
         self.server.stop()
         self.store.close()
 
+    # -- shard-lease protocol -------------------------------------------------
+    def _master_call(self, path: str, payload: dict) -> dict:
+        last: Optional[RpcError] = None
+        for addr in self.masters:
+            try:
+                return call(addr, path, payload=payload, method="POST",
+                            timeout=5)
+            except RpcError as e:
+                # a follower names the leader: honor the hint directly
+                hint = (e.headers or {}).get("X-Raft-Leader", "")
+                if hint and hint != addr:
+                    try:
+                        return call(hint, path, payload=payload,
+                                    method="POST", timeout=5)
+                    except RpcError as e2:
+                        last = e2
+                        continue
+                last = e
+        raise last or RpcError("no master reachable", 503)
+
+    def _lease_once(self):
+        r = self._master_call("/filer/shard_lease",
+                              {"holder": self.address,
+                               "ttl": self._lease_ttl})
+        granted = set(int(s) for s in r.get("slots", []))
+        prev = {int(k): v
+                for k, v in (r.get("prev") or {}).items() if v}
+        with self._lock:
+            fresh = granted - self._held
+        # pull handovers BEFORE exposing the slots as held: a freshly
+        # granted slot must not answer "not found" for entries its
+        # previous holder still has (requests 503 until then — the
+        # clients' retry window, not a wrong answer)
+        for slot in sorted(fresh):
+            self._pull_handover(slot, prev.get(slot, ""))
+        with self._lock:
+            self._held = granted
+            self._map = {int(k): v
+                         for k, v in (r.get("map") or {}).items()}
+            self._epoch = int(r.get("epoch", 0))
+
+    def _pull_handover(self, slot: int, prev_holder: str):
+        """Best-effort: copy a newly-granted slot's entries from its
+        previous holder (graceful rebalance keeps data; after a crash the
+        slot starts empty but WRITABLE — availability over history)."""
+        if not prev_holder or prev_holder == self.address \
+                or slot in self._pulled:
+            return
+        if not hasattr(self.store, "load_slot"):
+            return
+        try:
+            r = call(prev_holder, f"/store/dump?slot={slot}", timeout=30)
+            self.store.load_slot(slot, r.get("entries", []))
+            self._pulled.add(slot)
+            glog.infof("filer.store: slot %d handover from %s "
+                       "(%d entries)", slot, prev_holder,
+                       len(r.get("entries", [])))
+        except RpcError:
+            self._pulled.add(slot)  # holder gone: take over empty
+
+    def _lease_loop(self):
+        period = max(0.5, self._lease_ttl / 3.0)
+        while not self._lease_stop.wait(period):
+            try:
+                self._lease_once()
+            except RpcError as e:
+                glog.v(1).infof("filer.store: shard lease renewal "
+                                "failed: %s", e)
+
+    # -- shard routing ---------------------------------------------------------
+    def _owner(self, dir_path: str) -> Optional[str]:
+        """None = serve locally; otherwise the holder to proxy to.
+        Raises 503 for an unheld, unassigned slot (a holder's lease must
+        land before writes for it can be accepted anywhere)."""
+        if not self.masters:
+            return None  # standalone mode: this server owns everything
+        slot = slot_of(dir_path, self._slots)
+        with self._lock:
+            if slot in self._held:
+                return None
+            owner = self._map.get(slot, "")
+        if owner and owner != self.address:
+            return owner
+        raise RpcError(f"shard slot {slot} has no lease holder", 503)
+
+    def _proxy(self, req: Request, owner: str, path: str,
+               payload: Optional[dict] = None, method: str = "POST"):
+        if req.headers.get(HOP_HEADER):
+            # already one hop deep: the map is in flux between us and the
+            # first server; fail fast instead of bouncing around
+            raise RpcError(
+                f"shard map disagreement proxying {path}", 503)
+        return call(owner, path, payload=payload, method=method,
+                    timeout=20, headers={HOP_HEADER: "1"})
+
+    # -- handlers --------------------------------------------------------------
     def _h_insert(self, req: Request):
-        entry = Entry.from_dict(req.json())
+        d = req.json()
+        entry = Entry.from_dict(d)
+        owner = self._owner(entry.parent)
+        if owner:
+            return self._proxy(req, owner, "/store/insert", payload=d)
         with self._lock:
             self.store.insert_entry(entry)
         return {}
 
     def _h_find(self, req: Request):
         path = req.param("path", "") or "/"
+        parent = path.rsplit("/", 1)[0] or "/"
+        owner = self._owner(parent)
+        if owner:
+            return self._proxy(
+                req, owner,
+                "/store/find?path=" + urllib.parse.quote(path, safe="/"),
+                method="GET")
         try:
             return self.store.find_entry(path).to_dict()
         except NotFoundError:
             raise RpcError(f"{path} not found", 404)
 
     def _h_delete(self, req: Request):
+        d = req.json()
+        path = d.get("path", "")
+        parent = path.rsplit("/", 1)[0] or "/"
+        owner = self._owner(parent)
+        if owner:
+            return self._proxy(req, owner, "/store/delete", payload=d)
         with self._lock:
-            self.store.delete_entry(req.json().get("path", ""))
+            self.store.delete_entry(path)
         return {}
 
     def _h_delete_children(self, req: Request):
+        d = req.json()
         with self._lock:
-            self.store.delete_folder_children(req.json().get("path", ""))
+            self.store.delete_folder_children(d.get("path", ""))
+            holders = (set(self._map.values()) - {self.address}
+                       if not req.headers.get(HOP_HEADER) else set())
+        # descendant dirs hash to arbitrary slots: fan out to every
+        # holder (each fans over its LOCAL shards only — hop guard stops
+        # re-broadcast)
+        for holder in sorted(holders):
+            try:
+                call(holder, "/store/delete_children", payload=d,
+                     method="POST", timeout=30,
+                     headers={HOP_HEADER: "1"})
+            except RpcError as e:
+                glog.warningf("filer.store: delete_children fan-out to "
+                              "%s failed: %s", holder, e)
         return {}
 
     def _h_list(self, req: Request):
+        dir_path = req.param("dir", "") or "/"
+        owner = self._owner(dir_path)
+        if owner:
+            q = urllib.parse.urlencode({
+                "dir": dir_path,
+                "start": req.param("start", "") or "",
+                "include_start": req.param("include_start") or "false",
+                "limit": req.param("limit", "1024"),
+                "prefix": req.param("prefix", "") or ""})
+            return self._proxy(req, owner, "/store/list?" + q,
+                               method="GET")
         entries = self.store.list_directory(
-            req.param("dir", "") or "/",
+            dir_path,
             start_file=req.param("start", "") or "",
             include_start=req.param("include_start") == "true",
             limit=int(req.param("limit", "1024")),
             prefix=req.param("prefix", "") or "")
         return {"entries": [e.to_dict() for e in entries]}
 
+    def _h_rename(self, req: Request):
+        """Cross-shard rename: src and dst may live on different
+        holders; read src (routed), write dst (routed), delete src
+        (routed).  Not atomic across holders — same contract as the
+        reference's cross-store moves, where the filer retries."""
+        d = req.json()
+        src, dst = d.get("path", ""), d.get("new_path", "")
+        if not src or not dst:
+            raise RpcError("path and new_path required", 400)
+        found = self._h_find_path(req, src)
+        found["full_path"] = dst
+        self._h_insert_routed(req, found)
+        self._h_delete_routed(req, src)
+        return {"renamed": src, "to": dst}
+
+    def _h_find_path(self, req: Request, path: str) -> dict:
+        parent = path.rsplit("/", 1)[0] or "/"
+        owner = self._owner(parent)
+        if owner:
+            return self._proxy(
+                req, owner,
+                "/store/find?path=" + urllib.parse.quote(path, safe="/"),
+                method="GET")
+        try:
+            return self.store.find_entry(path).to_dict()
+        except NotFoundError:
+            raise RpcError(f"{path} not found", 404)
+
+    def _h_insert_routed(self, req: Request, d: dict):
+        entry = Entry.from_dict(d)
+        owner = self._owner(entry.parent)
+        if owner:
+            self._proxy(req, owner, "/store/insert", payload=d)
+            return
+        with self._lock:
+            self.store.insert_entry(entry)
+
+    def _h_delete_routed(self, req: Request, path: str):
+        parent = path.rsplit("/", 1)[0] or "/"
+        owner = self._owner(parent)
+        if owner:
+            self._proxy(req, owner, "/store/delete",
+                        payload={"path": path})
+            return
+        with self._lock:
+            self.store.delete_entry(path)
+
+    def _h_dump(self, req: Request):
+        """Slot handover source: every entry in one local shard slot."""
+        slot = int(req.param("slot", "-1"))
+        if slot < 0:
+            raise RpcError("slot required", 400)
+        if not hasattr(self.store, "dump_slot"):
+            raise RpcError(
+                f"{type(self.store).__name__} is not slot-addressable",
+                400)
+        return {"slot": slot, "entries": self.store.dump_slot(slot)}
+
     def _h_status(self, req: Request):
-        return {"store": type(self.store).__name__}
+        with self._lock:
+            return {"store": type(self.store).__name__,
+                    "cluster": bool(self.masters),
+                    "slots": self._slots,
+                    "held": sorted(self._held),
+                    "epoch": self._epoch,
+                    "map": {str(k): v
+                            for k, v in sorted(self._map.items())}}
 
 
 class RemoteStore(FilerStore):
@@ -145,6 +404,12 @@ class RemoteStore(FilerStore):
 
     def delete_folder_children(self, path: str):
         self._call("/store/delete_children", payload={"path": path},
+                   method="POST")
+
+    def rename_entry(self, path: str, new_path: str):
+        """Server-side (possibly cross-shard) rename."""
+        self._call("/store/rename",
+                   payload={"path": path, "new_path": new_path},
                    method="POST")
 
     def list_directory(self, dir_path: str, start_file: str = "",

@@ -20,7 +20,7 @@ from typing import Optional
 
 from ..util import glog
 from . import detectors
-from .jobs import JOB_TYPES, TYPE_DEEP_SCRUB, TYPE_EC_REBUILD
+from .jobs import JOB_TYPES, LEASED, TYPE_DEEP_SCRUB, TYPE_EC_REBUILD, Job
 from .queue import JobQueue
 
 
@@ -31,14 +31,124 @@ def _env_float(name: str, default: float) -> float:
         return default
 
 
+class RaftQueueProxy:
+    """JobQueue facade that commits every mutation through the raft log
+    before acknowledging it.  Reads come straight from the local FSM's
+    queue (each replica applies the same committed commands, so the view
+    is the replicated truth); writes become `curator.*` commands whose
+    knob-derived inputs (lease duration, attempt cap, backoff) are
+    pinned by THIS proposer, keeping the apply deterministic across
+    replicas with drifted env config.
+
+    On a follower, every mutation raises the raft 409 with a leader
+    hint — exactly what /maintenance/* should return there."""
+
+    def __init__(self, raft):
+        self.raft = raft
+        self.now = time.time  # fake-clock seam, mirrors JobQueue
+
+    @property
+    def _q(self) -> JobQueue:
+        return self.raft.fsm.queue
+
+    # -- replicated mutations -------------------------------------------------
+    def enqueue(self, type_: str, volume: int = 0, collection: str = "",
+                params: Optional[dict] = None,
+                priority: Optional[int] = None) -> Optional[str]:
+        return self.raft.propose({
+            "type": "curator.enqueue", "now": self.now(),
+            "job_type": type_, "volume": int(volume),
+            "collection": collection, "params": dict(params or {}),
+            "priority": priority})
+
+    def lease(self, worker: str, types: Optional[list] = None,
+              limit: int = 1,
+              ec_volumes: Optional[list] = None) -> list[dict]:
+        return self.raft.propose({
+            "type": "curator.lease", "now": self.now(),
+            "worker": worker, "types": types, "limit": int(limit),
+            "ec_volumes": ec_volumes,
+            "lease_seconds": self.lease_seconds}) or []
+
+    def renew(self, job_id: str, worker: str) -> bool:
+        return bool(self.raft.propose({
+            "type": "curator.renew", "now": self.now(),
+            "id": job_id, "worker": worker,
+            "lease_seconds": self.lease_seconds}))
+
+    def complete(self, job_id: str, worker: str,
+                 outcome: str = "ok") -> Optional[Job]:
+        d = self.raft.propose({
+            "type": "curator.done", "now": self.now(),
+            "id": job_id, "worker": worker, "outcome": outcome})
+        return Job.from_dict(d) if d else None
+
+    def fail(self, job_id: str, worker: str, error: str) -> Optional[Job]:
+        d = self.raft.propose({
+            "type": "curator.fail", "now": self.now(),
+            "id": job_id, "worker": worker, "error": str(error),
+            "max_attempts": self._q.max_attempts,
+            "backoff": self._q.retry_backoff})
+        return Job.from_dict(d) if d else None
+
+    def expire_leases(self) -> list[str]:
+        # probe locally first: proposing an expire command on every tick
+        # would grow the log with no-ops, so only pay a quorum round when
+        # some lease has actually lapsed
+        now = self.now()
+        q = self._q
+        with q._lock:
+            any_expired = any(
+                j.state == LEASED and j.lease_expires < now
+                for j in q._jobs.values())
+        if not any_expired:
+            return []
+        return self.raft.propose(
+            {"type": "curator.expire", "now": now}) or []
+
+    @property
+    def paused(self) -> bool:
+        return self._q.paused
+
+    @paused.setter
+    def paused(self, value: bool):
+        self.raft.propose({"type": "curator.pause", "now": self.now(),
+                           "paused": bool(value)})
+
+    # -- read-through views ---------------------------------------------------
+    @property
+    def lease_seconds(self) -> float:
+        return self._q.lease_seconds
+
+    @property
+    def history(self):
+        return self._q.history
+
+    def get(self, job_id: str) -> Optional[Job]:
+        return self._q.get(job_id)
+
+    def stats(self) -> dict:
+        return self._q.stats()
+
+    def jobs(self) -> list[dict]:
+        return self._q.jobs()
+
+
 class Curator:
     def __init__(self, master, journal_dir: str = "",
                  interval: Optional[float] = None):
         self.master = master
         self._interval = interval
-        journal = (os.path.join(journal_dir, "maintenance.jlog")
-                   if journal_dir else "")
-        self.queue = JobQueue(journal_path=journal)
+        raft = getattr(master, "raft", None)
+        if getattr(raft, "fsm", None) is not None \
+                and hasattr(raft, "propose"):
+            # the raft log IS the journal: a failed-over leader resumes
+            # with the exact pending/leased set, committed before ack
+            self.queue = RaftQueueProxy(raft)
+        else:
+            journal = (os.path.join(journal_dir, "maintenance.jlog")
+                       if journal_dir else "")
+            self.queue = JobQueue(journal_path=journal)
         self.last_scrub: dict[int, float] = {}
         self._recent: dict[tuple, float] = {}  # (type, vid) -> done at
         self._stop = threading.Event()

@@ -103,12 +103,26 @@ class JobQueue:
             self._compact()
 
     def _compact(self):
+        # crash-atomic: the live set is fully durable in the tmp file
+        # BEFORE the rename swaps it in, so a kill at any instant leaves
+        # either the complete old journal or the complete new one
         tmp = self.journal_path + ".tmp"
         with open(tmp, "w") as f:
             for job in self._jobs.values():
                 f.write(json.dumps({"op": "set", "job": job.to_dict()},
                                    separators=(",", ":")) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, self.journal_path)
+        try:
+            dir_fd = os.open(os.path.dirname(self.journal_path) or ".",
+                             os.O_RDONLY)
+            try:
+                os.fsync(dir_fd)
+            finally:
+                os.close(dir_fd)
+        except OSError:
+            pass  # the rename itself is still atomic without the dir sync
         self._journal_lines = len(self._jobs)
 
     def _sync_metrics(self):

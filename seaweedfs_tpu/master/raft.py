@@ -5,15 +5,21 @@ replicated state is MaxVolumeId (weed/server/raft_server.go:52-100 — the
 FSM's Apply handles one command type, MaxVolumeIdCommand), persisted in
 boltdb with snapshots.
 
-This implementation keeps that FSM but runs the full raft machinery over
-it: a persisted replicated LOG of MaxVolumeId commands with
-prev-index/term consistency checks, per-follower next/match tracking,
-majority commit, and log-compaction snapshots (the applied FSM value +
-last included index/term) shipped to stragglers.  Volume-id allocation is
-at-most-once: an id is returned only after its log entry COMMITS — a
-failed quorum leaves the entry uncommitted and the value unreturned, so a
-competing leader can never hand out the same committed id
-(the round-2 review's id-burn-on-failed-quorum hazard).
+This implementation runs the full raft machinery — a persisted replicated
+LOG with prev-index/term consistency checks, per-follower next/match
+tracking, majority commit, and log-compaction snapshots shipped to
+stragglers — over a COMMAND-TYPED FSM (master/fsm.py): volume-id
+allocation, topology epochs, every curator queue mutation, and the filer
+shard map all commit through quorum before they are acknowledged.  A
+failed-over leader on a different node resumes with the exact
+pending/leased curator set and never double-allocates an id: propose()
+returns only after the entry COMMITS, so a failed quorum leaves the
+entry uncommitted and the result unreturned (at-most-once).
+
+Seams for deterministic testing: `clock` (monotonic source), `rpc`
+(peer transport) and `rand` (election jitter) are instance attributes,
+so the fuzz suite drives whole clusters in-process on a fake clock with
+partitionable transports and zero threads.
 """
 
 from __future__ import annotations
@@ -27,23 +33,44 @@ from typing import Callable, Optional
 
 from ..rpc.http_rpc import RpcError, call
 from ..util import glog
+from .fsm import ControlFSM
 
 FOLLOWER, CANDIDATE, LEADER = "follower", "candidate", "leader"
 
 SNAPSHOT_THRESHOLD = 64  # applied entries kept before compaction
+
+# propose() results retained past the commit point, so a proposer that
+# lost the race to _advance_commit can still collect its return value
+_RESULT_WINDOW = 512
+
+
+def _upgrade_entry(e: dict) -> dict:
+    """Accept pre-command-log persisted entries ({"max_volume_id": N})
+    by rewriting them as volume.assign commands."""
+    if "cmd" in e:
+        return e
+    return {"index": int(e["index"]), "term": int(e["term"]),
+            "cmd": {"type": "volume.assign",
+                    "value": int(e.get("max_volume_id", 0))}}
 
 
 class RaftNode:
     def __init__(self, self_address: str, peers: list[str],
                  state_dir: str = "",
                  election_timeout: float = 0.8,
-                 heartbeat_interval: float = 0.25):
+                 heartbeat_interval: float = 0.25,
+                 clock: Optional[Callable[[], float]] = None,
+                 transport: Optional[Callable] = None,
+                 fsm: Optional[ControlFSM] = None):
         """peers includes self_address."""
         self.address = self_address
         self.peers = sorted(set(peers) | {self_address})
         self.state_dir = state_dir
         self.election_timeout = election_timeout
         self.heartbeat_interval = heartbeat_interval
+        self.clock = clock or time.monotonic
+        self.rpc = transport or call
+        self.rand = random.random
 
         self.lock = threading.RLock()
         self.state = FOLLOWER
@@ -53,21 +80,27 @@ class RaftNode:
         self.on_become_leader: Optional[Callable[[], None]] = None
 
         # -- replicated log + snapshot (boltdb store analogue) ---------------
-        # entry: {"index": i, "term": t, "max_volume_id": N}; the entry at
+        # entry: {"index": i, "term": t, "cmd": {...}}; the entry at
         # global index i lives at log[i - snapshot_index - 1]
+        self.fsm = fsm or ControlFSM()
         self.log: list[dict] = []
         self.snapshot_index = 0
         self.snapshot_term = 0
-        self.snapshot_value = 0  # FSM value at the snapshot point
+        self.snapshot_fsm: dict = {}  # FSM snapshot at the compaction point
         self.commit_index = 0
-        self.max_volume_id = 0   # the applied FSM value
+        self.applied_index = 0
+        self._apply_results: dict[int, object] = {}
         self._next_index: dict[str, int] = {}
         self._match_index: dict[str, int] = {}
+        # leader lease: the last instant a quorum acknowledged this
+        # leader; clients treat the hinted leader as fresh within it
+        self._lease_until = 0.0
 
-        self._last_heard = time.monotonic()
+        self._last_heard = self.clock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._load_state()
+        self._sync_metrics()
         if len(self.peers) > 1 and not self.state_dir:
             # raft safety requires durable term/vote: a restarted node with
             # amnesia can double-vote in one term and elect two leaders
@@ -75,6 +108,11 @@ class RaftNode:
                 "raft: %d-peer cluster without -mdir: term/vote/log state "
                 "is NOT persisted; a master restart can elect split leaders",
                 len(self.peers))
+
+    # -- FSM views -----------------------------------------------------------
+    @property
+    def max_volume_id(self) -> int:
+        return self.fsm.max_volume_id
 
     # -- log helpers (lock held) ----------------------------------------------
     def _last_index(self) -> int:
@@ -98,11 +136,13 @@ class RaftNode:
     def _pending_value(self) -> int:
         """Highest MaxVolumeId anywhere in the log (committed or not) —
         the allocation floor, so concurrent/unacked entries never collide."""
-        value = self.max_volume_id
+        value = self.fsm.max_volume_id
         for e in self.log:
-            if e["max_volume_id"] > value:
-                value = e["max_volume_id"]
-        return max(value, self.snapshot_value)
+            cmd = e["cmd"]
+            if cmd.get("type") == "volume.assign" \
+                    and int(cmd.get("value", 0)) > value:
+                value = int(cmd["value"])
+        return value
 
     def _advance_commit(self, new_commit: int):
         """Apply newly-committed entries to the FSM, then maybe compact."""
@@ -111,11 +151,17 @@ class RaftNode:
             return
         for i in range(self.commit_index + 1, new_commit + 1):
             e = self._entry(i)
-            if e and e["max_volume_id"] > self.max_volume_id:
-                self.max_volume_id = e["max_volume_id"]
+            if e is not None:
+                self._apply_results[i] = self.fsm.apply(e["cmd"])
         self.commit_index = new_commit
+        self.applied_index = new_commit
+        if len(self._apply_results) > _RESULT_WINDOW:
+            floor = new_commit - _RESULT_WINDOW
+            for i in [i for i in self._apply_results if i <= floor]:
+                del self._apply_results[i]
         self._maybe_snapshot()
         self._save_state()
+        self._sync_metrics()
 
     def _maybe_snapshot(self):
         """Compact the applied prefix once it outgrows the threshold
@@ -127,8 +173,20 @@ class RaftNode:
         self.snapshot_term = self._term_at(self.commit_index) or \
             self.snapshot_term
         self.snapshot_index = self.commit_index
-        self.snapshot_value = self.max_volume_id
+        self.snapshot_fsm = self.fsm.snapshot()
         self.log = self.log[cut:]
+
+    def _sync_metrics(self):
+        try:
+            from ..stats import metrics as _m
+
+            _m.RaftTermGauge.labels(self.address).set(self.term)
+            _m.RaftCommitIndexGauge.labels(self.address) \
+                .set(self.commit_index)
+            _m.RaftAppliedLagGauge.labels(self.address) \
+                .set(self._last_index() - self.applied_index)
+        except Exception:
+            pass  # metrics must never wedge consensus
 
     # -- persistence -----------------------------------------------------------
     def _state_path(self) -> str:
@@ -145,17 +203,22 @@ class RaftNode:
             snap = d.get("snapshot", {})
             self.snapshot_index = int(snap.get("index", 0))
             self.snapshot_term = int(snap.get("term", 0))
-            self.snapshot_value = int(snap.get("max_volume_id",
-                                               d.get("max_volume_id", 0)))
-            self.log = list(d.get("log", []))
+            fsm_snap = snap.get("fsm")
+            if fsm_snap is None:
+                # legacy MaxVolumeId-only snapshot
+                fsm_snap = {"max_volume_id":
+                            int(snap.get("max_volume_id",
+                                         d.get("max_volume_id", 0)))}
+            self.snapshot_fsm = fsm_snap
+            self.log = [_upgrade_entry(e) for e in d.get("log", [])]
             self.commit_index = max(int(d.get("commit_index", 0)),
                                     self.snapshot_index)
-            # replay the committed suffix into the FSM
-            self.max_volume_id = self.snapshot_value
+            # replay: restore the snapshot FSM, apply the committed suffix
+            self.fsm.restore(self.snapshot_fsm)
             for e in self.log:
-                if (e["index"] <= self.commit_index
-                        and e["max_volume_id"] > self.max_volume_id):
-                    self.max_volume_id = e["max_volume_id"]
+                if e["index"] <= self.commit_index:
+                    self.fsm.apply(e["cmd"])
+            self.applied_index = self.commit_index
             # peers are persisted only once membership was changed via
             # cluster.raft.add/remove — a plain restart keeps the
             # configured list (addresses are identity here, so saving the
@@ -175,7 +238,7 @@ class RaftNode:
             "commit_index": self.commit_index,
             "snapshot": {"index": self.snapshot_index,
                          "term": self.snapshot_term,
-                         "max_volume_id": self.snapshot_value},
+                         "fsm": self.snapshot_fsm},
             "log": self.log,
         }
         if getattr(self, "_peers_persisted", False):
@@ -192,6 +255,7 @@ class RaftNode:
             with self.lock:
                 self.state = LEADER
                 self.leader = self.address
+                self._lease_until = self.clock() + self.election_timeout
             if self.on_become_leader:
                 self.on_become_leader()
         self._thread = threading.Thread(target=self._run, daemon=True)
@@ -206,6 +270,13 @@ class RaftNode:
 
     def quorum(self) -> int:
         return len(self.peers) // 2 + 1
+
+    def _leader_hint(self) -> Optional[dict]:
+        """Response headers pointing a rejected caller at the leader."""
+        leader = self.leader
+        if leader and leader != self.address:
+            return {"X-Raft-Leader": leader}
+        return None
 
     # -- membership changes (shell cluster.raft.add/remove) ------------------
     # The reference drives these through hashicorp/raft's joint-consensus
@@ -231,8 +302,8 @@ class RaftNode:
     def _broadcast_membership(self, notify: set[str]):
         for peer in notify - {self.address}:
             try:
-                call(peer, "/raft/update_peers", {"peers": self.peers},
-                     timeout=5)
+                self.rpc(peer, "/raft/update_peers",
+                         {"peers": self.peers}, timeout=5)
             except RpcError:
                 pass  # unreachable peer adopts the list when it rejoins
 
@@ -261,16 +332,27 @@ class RaftNode:
         self._broadcast_membership(notify)
 
     # -- main loop -----------------------------------------------------------
+    def tick(self) -> float:
+        """One scheduler step (factored out of _run so tests can drive
+        a node on a fake clock without its thread).  Returns how long
+        the loop should sleep before the next step."""
+        if self.state == LEADER:
+            self._broadcast_round()
+            return self.heartbeat_interval
+        timeout = self.election_timeout * (1 + self.rand())
+        if self.clock() - self._last_heard > timeout:
+            self._campaign()
+        return 0.05
+
     def _run(self):
         while not self._stop.is_set():
-            if self.state == LEADER:
-                self._broadcast_round()
-                self._stop.wait(self.heartbeat_interval)
-            else:
-                timeout = self.election_timeout * (1 + random.random())
-                self._stop.wait(0.05)
-                if time.monotonic() - self._last_heard > timeout:
-                    self._campaign()
+            try:
+                delay = self.tick()
+            except Exception as e:  # consensus loop must never die
+                glog.warningf("raft: tick failed on %s: %s",
+                              self.address, e)
+                delay = 0.05
+            self._stop.wait(delay)
 
     def _campaign(self):
         with self.lock:
@@ -287,11 +369,11 @@ class RaftNode:
             if peer == self.address:
                 continue
             try:
-                r = call(peer, "/raft/request_vote",
-                         {"term": term, "candidate": self.address,
-                          "last_log_index": last_index,
-                          "last_log_term": last_term},
-                         timeout=1)
+                r = self.rpc(peer, "/raft/request_vote",
+                             {"term": term, "candidate": self.address,
+                              "last_log_index": last_index,
+                              "last_log_term": last_term},
+                             timeout=1)
                 if r.get("granted"):
                     votes += 1
                 elif r.get("term", 0) > term:
@@ -307,13 +389,21 @@ class RaftNode:
                            self.address, term, votes)
                 self.state = LEADER
                 self.leader = self.address
+                # no-op entry of OUR term: prior-term entries cannot
+                # commit by counting (§5.4.2), so without this the new
+                # leader's FSM would lag until the next real proposal
+                self.log.append({"index": self._last_index() + 1,
+                                 "term": self.term,
+                                 "cmd": {"type": "raft.noop"}})
                 for peer in self.peers:
-                    self._next_index[peer] = self._last_index() + 1
+                    self._next_index[peer] = self._last_index()
                     self._match_index[peer] = 0
+                self._save_state()
             else:
                 self.state = FOLLOWER
-                self._last_heard = time.monotonic()
+                self._last_heard = self.clock()
                 return
+        self._sync_metrics()
         if self.on_become_leader:
             self.on_become_leader()
         self._broadcast_round()
@@ -328,7 +418,8 @@ class RaftNode:
                 glog.infof("raft: %s stepping down at term %d",
                            self.address, term)
             self.state = FOLLOWER
-            self._last_heard = time.monotonic()
+            self._last_heard = self.clock()
+        self._sync_metrics()
 
     # -- leader-side replication ----------------------------------------------
     def _replicate_to(self, peer: str) -> bool:
@@ -346,7 +437,7 @@ class RaftNode:
                 payload["snapshot"] = {
                     "index": self.snapshot_index,
                     "term": self.snapshot_term,
-                    "max_volume_id": self.snapshot_value}
+                    "fsm": self.snapshot_fsm}
                 payload["prev_index"] = self.snapshot_index
                 payload["prev_term"] = self.snapshot_term
                 payload["entries"] = list(self.log)
@@ -357,7 +448,7 @@ class RaftNode:
                     e for e in self.log if e["index"] >= ni]
             sent_last = self._last_index()
         try:
-            r = call(peer, "/raft/append_entries", payload, timeout=1)
+            r = self.rpc(peer, "/raft/append_entries", payload, timeout=1)
         except RpcError:
             return False
         with self.lock:
@@ -387,6 +478,9 @@ class RaftNode:
         with self.lock:
             if self.state != LEADER:
                 return acked
+            if acked >= self.quorum():
+                # a quorum just heard from us: refresh the leader lease
+                self._lease_until = self.clock() + self.election_timeout
             # majority-match commit rule (only entries of the current term
             # commit by counting, per the raft paper's §5.4.2 restriction)
             for n in range(self._last_index(), self.commit_index, -1):
@@ -418,7 +512,7 @@ class RaftNode:
                               and c_last_index >= self._last_index()))
             if self.voted_for in (None, candidate) and up_to_date:
                 self.voted_for = candidate
-                self._last_heard = time.monotonic()
+                self._last_heard = self.clock()
                 self._save_state()
                 return {"granted": True, "term": self.term}
             self._save_state()
@@ -435,18 +529,20 @@ class RaftNode:
                 self.voted_for = None
             self.state = FOLLOWER
             self.leader = req["leader"]
-            self._last_heard = time.monotonic()
+            self._last_heard = self.clock()
 
             snap = req.get("snapshot")
-            if snap and snap["index"] > self.snapshot_index:
+            if snap and snap["index"] > self.snapshot_index \
+                    and snap["index"] > self.commit_index:
                 # InstallSnapshot: replace everything up to the snapshot
                 self.snapshot_index = int(snap["index"])
                 self.snapshot_term = int(snap["term"])
-                self.snapshot_value = int(snap["max_volume_id"])
+                self.snapshot_fsm = snap.get("fsm") or {
+                    "max_volume_id": int(snap.get("max_volume_id", 0))}
                 self.log = []
                 self.commit_index = self.snapshot_index
-                if self.snapshot_value > self.max_volume_id:
-                    self.max_volume_id = self.snapshot_value
+                self.applied_index = self.snapshot_index
+                self.fsm.restore(self.snapshot_fsm)
 
             prev_index = int(req.get("prev_index", 0))
             prev_term = int(req.get("prev_term", 0))
@@ -473,29 +569,40 @@ class RaftNode:
                         continue
                     self.log = self.log[:idx - self.snapshot_index - 1]
                 self.log.append({"index": idx, "term": int(e["term"]),
-                                 "max_volume_id": int(e["max_volume_id"])})
+                                 "cmd": _upgrade_entry(e)["cmd"]})
             self._advance_commit(int(req.get("commit_index", 0)))
             self._save_state()
+            self._sync_metrics()
             return {"ok": True, "term": self.term,
                     "last_index": self._last_index()}
 
-    # -- the FSM: MaxVolumeId allocation (raft_server.go:78) -----------------
-    def next_volume_id(self) -> int:
-        """Allocate the next volume id; returns only after the allocation's
-        log entry is COMMITTED (majority-replicated).  A failed quorum
-        leaves the entry uncommitted and the id unreturned — at-most-once,
-        no id can be double-allocated by competing leaders."""
+    # -- proposing commands (the generalized FSM write path) ------------------
+    def propose(self, cmd: Optional[dict] = None, *,
+                build: Optional[Callable[[], dict]] = None):
+        """Append a command, replicate it, and return its FSM apply
+        result only after the entry COMMITS (majority-replicated).  A
+        failed quorum leaves the entry uncommitted and nothing is
+        returned — at-most-once, so a competing leader can never have
+        acknowledged the same mutation.
+
+        `build` constructs the command under the raft lock — required
+        when the command reads log-dependent state (the volume-id
+        allocation floor) that must be computed atomically with the
+        append."""
         with self.lock:
             if self.state != LEADER:
-                raise RpcError("not raft leader", 409)
-            value = self._pending_value() + 1
+                raise RpcError("not raft leader", 409,
+                               headers=self._leader_hint())
+            if build is not None:
+                cmd = build()
             entry = {"index": self._last_index() + 1, "term": self.term,
-                     "max_volume_id": value}
+                     "cmd": cmd}
             self.log.append(entry)
             self._save_state()
             if len(self.peers) == 1:
                 self._advance_commit(entry["index"])
-                return value
+                self._lease_until = self.clock() + self.election_timeout
+                return self._apply_results.pop(entry["index"], None)
         # two rounds: the second lets a consistency-miss follower that
         # backed off in round one catch up and count toward the quorum
         for _ in range(2):
@@ -503,7 +610,8 @@ class RaftNode:
             with self.lock:
                 if self.commit_index >= entry["index"]:
                     if self._term_at(entry["index"]) == entry["term"]:
-                        return value
+                        return self._apply_results.pop(
+                            entry["index"], None)
                     # compacted below the snapshot horizon: the entry is
                     # committed provided WE are still the leader of its
                     # term (no competing leader could have replaced it
@@ -511,9 +619,27 @@ class RaftNode:
                     if (entry["index"] <= self.snapshot_index
                             and self.state == LEADER
                             and self.term == entry["term"]):
-                        return value
+                        return self._apply_results.pop(
+                            entry["index"], None)
+                    # a competing leader's entry committed at our index:
+                    # our command was dropped from the log, never applied
+                    raise RpcError(
+                        "leadership lost before commit", 409,
+                        headers=self._leader_hint())
         raise RpcError(
-            f"volume id {value} not replicated to quorum", 503)
+            f"entry {entry['index']} not replicated to quorum", 503,
+            headers=self._leader_hint())
+
+    # -- the MaxVolumeId surface (raft_server.go:78) ---------------------------
+    def next_volume_id(self) -> int:
+        """Allocate the next volume id; returns only after the allocation's
+        log entry is COMMITTED.  The floor is computed under the same lock
+        as the append, so concurrent proposers never collide."""
+        value = self.propose(build=lambda: {
+            "type": "volume.assign",
+            "value": self._pending_value() + 1,
+            "now": time.time()})
+        return int(value)
 
     def observe_volume_id(self, vid: int):
         """Fold in a volume id seen in a heartbeat (SetMax semantics): the
@@ -523,7 +649,47 @@ class RaftNode:
             if self.state != LEADER or vid <= self._pending_value():
                 return
             self.log.append({"index": self._last_index() + 1,
-                             "term": self.term, "max_volume_id": vid})
+                             "term": self.term,
+                             "cmd": {"type": "volume.assign",
+                                     "value": int(vid),
+                                     "now": time.time()}})
             if len(self.peers) == 1:
                 self._advance_commit(self._last_index())
             self._save_state()
+
+    # -- operator surface ------------------------------------------------------
+    def status(self) -> dict:
+        """cluster.check / raft.status view: term, commit/applied index,
+        leader lease freshness, and per-follower replication lag so a
+        straggler is visible before it matters."""
+        with self.lock:
+            followers = {}
+            if self.state == LEADER:
+                last = self._last_index()
+                for p in self.peers:
+                    if p == self.address:
+                        continue
+                    match = self._match_index.get(p, 0)
+                    followers[p] = {
+                        "match_index": match,
+                        "next_index": self._next_index.get(p, last + 1),
+                        "lag": last - match,
+                    }
+            lease = 0.0
+            if self.state == LEADER:
+                lease = max(0.0, self._lease_until - self.clock())
+            return {
+                "id": self.address,
+                "state": self.state,
+                "term": self.term,
+                "leader": self.leader or "",
+                "peers": self.peers,
+                "commit_index": self.commit_index,
+                "applied_index": self.applied_index,
+                "last_index": self._last_index(),
+                "snapshot_index": self.snapshot_index,
+                "lease_remaining": round(lease, 3),
+                "max_volume_id": self.fsm.max_volume_id,
+                "topology_epoch": self.fsm.topology_epoch,
+                "followers": followers,
+            }

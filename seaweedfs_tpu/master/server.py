@@ -11,6 +11,7 @@ Single-master; the reference's Raft FSM replicates only MaxVolumeId
 
 from __future__ import annotations
 
+import os
 import random
 import threading
 import time
@@ -31,6 +32,13 @@ from .topology import Topology
 from .volume_growth import VolumeGrowOption
 
 
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
 class MasterServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  volume_size_limit_mb: int = 1024,
@@ -40,7 +48,7 @@ class MasterServer:
                  guard: Optional[Guard] = None,
                  peers: Optional[list[str]] = None,
                  raft_dir: str = "",
-                 raft_election_timeout: float = 0.8,
+                 raft_election_timeout: Optional[float] = None,
                  auto_vacuum_interval: float = 15 * 60.0,
                  enable_native_assign: bool = False,
                  maintenance_interval: Optional[float] = None):
@@ -51,10 +59,14 @@ class MasterServer:
         self.garbage_threshold = garbage_threshold
         self.guard = guard or Guard()
         self.server = RpcServer(host, port, service_name="master")
-        self.raft = RaftNode(self.server.address,
-                             (peers or []) + [self.server.address],
-                             state_dir=raft_dir,
-                             election_timeout=raft_election_timeout)
+        if raft_election_timeout is None:
+            raft_election_timeout = _env_float("WEED_RAFT_ELECTION", 0.8)
+        self.raft = RaftNode(
+            self.server.address,
+            (peers or []) + [self.server.address],
+            state_dir=raft_dir,
+            election_timeout=raft_election_timeout,
+            heartbeat_interval=_env_float("WEED_RAFT_HEARTBEAT", 0.25))
         self.topo.vid_allocator = self.raft.next_volume_id
         self.topo.max_volume_id = self.raft.max_volume_id
         # location-change feed for /dir/watch long-polls (KeepConnected).
@@ -264,6 +276,8 @@ class MasterServer:
         s.add("POST", "/raft/update_peers",
               lambda req: (self.raft.set_peers(req.json()["peers"]),
                            {"peers": self.raft.peers})[1])
+        s.add("POST", "/filer/shard_lease", self._handle_filer_shard_lease)
+        s.add("GET", "/filer/shards", self._handle_filer_shards)
         s.add("POST", "/dir/leave", self._handle_leave)
         s.add("GET", "/col/list", self._handle_collection_list)
         s.add("POST", "/col/delete", g(self._handle_collection_delete))
@@ -437,6 +451,14 @@ class MasterServer:
                     grown += 1
                 except (ValueError, RpcError):
                     break
+            if grown:
+                # placement generation bump rides the replicated log, so
+                # a failed-over leader knows growth happened here
+                try:
+                    self.raft.propose({"type": "topology.epoch",
+                                       "now": time.time()})
+                except RpcError:
+                    pass  # lost leadership mid-grow; epoch stays behind
             return grown
 
     def _handle_grow(self, req):
@@ -464,6 +486,19 @@ class MasterServer:
         vid = int(vid_s.split(",")[0])
         collection = req.param("collection", "") or ""
         locations = self.topo.lookup(vid, collection)
+        if not locations and not self.raft.is_leader:
+            # volume locations are heartbeat soft state and heartbeats
+            # only reach the leader — forward a miss one hop so lookups
+            # against any master stay correct (hop guard: no ping-pong
+            # while leaderless)
+            leader = self.raft.leader
+            if leader and leader != self.address \
+                    and not req.headers.get("X-Lookup-Hop"):
+                q = f"volumeId={vid}"
+                if collection:
+                    q += "&collection=" + urllib.parse.quote(collection)
+                return call(leader, "/dir/lookup?" + q, timeout=5,
+                            headers={"X-Lookup-Hop": "1"})
         if not locations:
             raise RpcError(f"volume id {vid} not found", 404)
         return {"volumeId": str(vid), "locations": locations}
@@ -481,18 +516,34 @@ class MasterServer:
             "Leader": self.raft.leader or "",
             "Peers": self.raft.peers,
             "MaxVolumeId": self.topo.max_volume_id,
+            "TopologyEpoch": self.raft.fsm.topology_epoch,
         }
 
     def _handle_raft_status(self, req):
-        """cluster.raft.ps surface (shell/command_cluster_raft_ps.go)."""
-        return {
-            "id": self.raft.address,
-            "state": self.raft.state,
-            "term": self.raft.term,
-            "leader": self.raft.leader or "",
-            "peers": self.raft.peers,
-            "max_volume_id": self.raft.max_volume_id,
-        }
+        """cluster.raft.ps / cluster.check surface: term, commit/applied
+        index, per-follower replication lag."""
+        return self.raft.status()
+
+    # -- filer shard map (replicated through the master FSM) -----------------
+    def _handle_filer_shard_lease(self, req):
+        """Store servers acquire/renew/release directory-shard leases;
+        every grant commits through the raft log, so a failed-over
+        master serves the identical assignment."""
+        d = req.json()
+        return self.raft.propose({
+            "type": "filer.lease", "now": time.time(),
+            "holder": d.get("holder", ""),
+            "ttl": float(d.get("ttl", 10.0)),
+            "release": bool(d.get("release"))})
+
+    def _handle_filer_shards(self, req):
+        """Read-only shard-map view for routing clients (served from the
+        local FSM replica — any master answers)."""
+        m = self.raft.fsm.shard_map
+        with self.raft.lock:
+            return {"slots": m.slots, "epoch": m.epoch,
+                    "map": m.assignments(),
+                    "leader": self.raft.leader or ""}
 
     def _handle_leave(self, req):
         """A volume server announces departure (VolumeServerLeave);

@@ -858,10 +858,17 @@ def call(addr: str, path: str, payload: Optional[dict] = None,
                 message = json.loads(body).get("error", body.decode())
             except Exception:
                 message = body.decode(errors="replace")
+            err_headers = {}
             retry_after = resp.headers.get("Retry-After")
+            if retry_after:
+                err_headers["Retry-After"] = retry_after
+            # raft leader hint on not-leader rejections: clients retry
+            # against the hinted address before the next failover round
+            leader_hint = resp.headers.get("X-Raft-Leader")
+            if leader_hint:
+                err_headers["X-Raft-Leader"] = leader_hint
             raise RpcError(message, status, addr=addr, route=path,
-                           headers={"Retry-After": retry_after}
-                           if retry_after else None)
+                           headers=err_headers or None)
         if parse and "application/json" in ctype:
             return json.loads(body) if body else {}
         return body

@@ -556,6 +556,21 @@ def cluster_check(env: CommandEnv) -> list[str]:
         raft = env.master("/raft/status")
         if not raft.get("leader"):
             problems.append("raft: no leader elected")
+        # replication stragglers: a follower far behind the leader's
+        # log is one failover away from forcing a long catch-up (or an
+        # availability gap) — surface it before it matters
+        for peer, f in (raft.get("followers") or {}).items():
+            if f.get("lag", 0) > 16:
+                problems.append(
+                    f"raft: follower {peer} lags {f['lag']} entries "
+                    f"(match_index {f.get('match_index', 0)} vs leader "
+                    f"{raft.get('last_index', 0)})")
+        applied_lag = (raft.get("last_index", 0)
+                       - raft.get("applied_index", 0))
+        if applied_lag > 64:
+            problems.append(
+                f"raft: {applied_lag} log entries not yet applied "
+                "to the FSM")
     except RpcError as e:
         problems.append(f"master unreachable: {e}")
         return problems

@@ -504,6 +504,19 @@ MaintEcRebuildReadAmpGauge = REGISTRY.gauge(
     "bytes read per rebuilt byte across this process's EC rebuilds, "
     "by code family",
     ("family",))
+# control-plane raft (seaweedfs_tpu/master/raft.py): one series per
+# local raft node, labeled by its advertised address, so a 3-master
+# deployment shows term agreement and replication lag at a glance
+RaftTermGauge = REGISTRY.gauge(
+    "SeaweedFS_raft_term",
+    "current raft term on this master", ("node",))
+RaftCommitIndexGauge = REGISTRY.gauge(
+    "SeaweedFS_raft_commit_index",
+    "highest quorum-committed raft log index on this master", ("node",))
+RaftAppliedLagGauge = REGISTRY.gauge(
+    "SeaweedFS_raft_applied_lag",
+    "raft log entries appended but not yet applied to the FSM "
+    "(last_index - applied_index)", ("node",))
 
 
 # -- cluster QoS: tenant-aware admission, weighted-fair queues, and the

@@ -98,6 +98,13 @@ class MasterClient:
             query += f"&ttl={ttl}"
         return self._call_any(f"/dir/assign?{query}")
 
+    def call(self, path: str, payload: Optional[dict] = None,
+             timeout: float = 30):
+        """Public failover call: any master-side route, leader hints
+        honored (for callers like the filer that speak routes beyond
+        assign/lookup)."""
+        return self._call_any(path, payload=payload, timeout=timeout)
+
     def _call_any(self, path: str, payload: Optional[dict] = None,
                   timeout: float = 30):
         """Try current master first, fail over through the list
@@ -107,8 +114,20 @@ class MasterClient:
         caps the whole sweep."""
         masters = [self.current_master] + [
             m for m in self.masters if m != self.current_master]
-        result, winner = policy.failover_call(
-            masters, path, payload=payload, timeout=timeout)
+        try:
+            result, winner = policy.failover_call(
+                masters, path, payload=payload, timeout=timeout)
+        except RpcError as e:
+            # a non-leader master names the leader in its rejection:
+            # honor the hint directly instead of burning another
+            # failover round guessing through the list
+            hint = (e.headers or {}).get("X-Raft-Leader", "")
+            if not hint or hint == getattr(e, "addr", ""):
+                raise
+            result = policy.call_policy(hint, path, payload=payload,
+                                        timeout=timeout, retries=0)
+            self.current_master = hint
+            return result
         self.current_master = winner
         return result
 
@@ -167,3 +186,7 @@ class MasterClient:
         leader = r.get("leader")
         if leader and leader not in self.masters:
             glog.v(1).infof("watch leader %s outside master list", leader)
+        elif leader and leader != self.current_master:
+            # follow the announced leader so the next assign goes
+            # straight there instead of bouncing off a 409
+            self.current_master = leader

@@ -1,0 +1,241 @@
+"""Cluster-distributed filer metadata: the replicated shard map, the
+lease protocol (fair share, shed-at-renewal, expiry, handover), and the
+store-server cluster mode (routing, one-hop proxying, cross-shard
+rename, graceful handover and crash takeover).
+"""
+
+import json
+import time
+
+import pytest
+
+from seaweedfs_tpu.filer.cluster_store import ClusterStore
+from seaweedfs_tpu.filer.entry import Entry
+from seaweedfs_tpu.filer.filer_store import ShardedSqliteStore
+from seaweedfs_tpu.filer.shard_map import ShardMap, slot_of
+from seaweedfs_tpu.filer.store_server import FilerStoreServer
+from seaweedfs_tpu.master.server import MasterServer
+from seaweedfs_tpu.rpc.http_rpc import RpcError, call
+
+
+def wait_for(pred, timeout=15.0, interval=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+# ---------------------------------------------------------------------------
+# ShardMap unit behavior (pure, deterministic — applied under the FSM)
+# ---------------------------------------------------------------------------
+
+class TestShardMap:
+    def test_first_holder_takes_everything(self):
+        m = ShardMap(slots=8)
+        r = m.lease("a", now=0.0, ttl=10.0)
+        assert r["slots"] == list(range(8))
+        assert m.holder_of("/any/dir") == "a"
+
+    def test_fair_share_converges_on_join(self):
+        """A second holder joins: the incumbent sheds down to its fair
+        share at its next renewal, and the joiner picks the freed slots
+        up — convergence without ever two live owners per slot."""
+        m = ShardMap(slots=8)
+        m.lease("a", now=0.0, ttl=10.0)
+        r_b = m.lease("b", now=1.0, ttl=10.0)
+        assert r_b["slots"] == []  # nothing free yet — no revocation
+        r_a = m.lease("a", now=2.0, ttl=10.0)  # a sheds to fair share
+        assert len(r_a["slots"]) == 4
+        r_b = m.lease("b", now=3.0, ttl=10.0)
+        assert len(r_b["slots"]) == 4
+        held = set(r_a["slots"]) | set(r_b["slots"])
+        assert held == set(range(8))
+        assert set(r_a["slots"]).isdisjoint(r_b["slots"])
+        # the joiner sees the incumbent as handover source
+        assert all(p == "a" for p in r_b["prev"].values())
+
+    def test_expiry_frees_slots(self):
+        m = ShardMap(slots=8)
+        m.lease("a", now=0.0, ttl=5.0)
+        r = m.lease("b", now=6.0, ttl=5.0)  # a's lease lapsed
+        assert len(r["slots"]) == 8
+        assert all(p == "a" for p in r["prev"].values())
+
+    def test_release_frees_immediately(self):
+        m = ShardMap(slots=8)
+        m.lease("a", now=0.0, ttl=10.0)
+        m.lease("b", now=1.0, ttl=10.0)
+        r = m.release("a", now=2.0)
+        assert len(r["released"]) == 8
+        r_b = m.lease("b", now=3.0, ttl=10.0)
+        assert len(r_b["slots"]) == 8  # b is the only member left
+
+    def test_epoch_only_bumps_on_change(self):
+        m = ShardMap(slots=4)
+        e0 = m.lease("a", now=0.0, ttl=10.0)["epoch"]
+        e1 = m.lease("a", now=1.0, ttl=10.0)["epoch"]  # pure renewal
+        assert e1 == e0
+        e2 = m.lease("b", now=2.0, ttl=10.0)["epoch"]
+        assert e2 == e1  # b got nothing: no change either
+        e3 = m.lease("a", now=3.0, ttl=10.0)["epoch"]  # shed happens
+        assert e3 > e2
+
+    def test_roundtrip_and_determinism(self):
+        a, b = ShardMap(slots=8), ShardMap(slots=8)
+        script = [("lease", "x", 0.0, 10.0), ("lease", "y", 1.0, 10.0),
+                  ("lease", "x", 2.0, 10.0), ("release", "y", 3.0, 0),
+                  ("lease", "x", 4.0, 10.0)]
+        for op, holder, now, ttl in script:
+            for m in (a, b):
+                if op == "lease":
+                    m.lease(holder, now, ttl)
+                else:
+                    m.release(holder, now)
+        assert json.dumps(a.to_dict(), sort_keys=True) == \
+            json.dumps(b.to_dict(), sort_keys=True)
+        again = ShardMap.from_dict(
+            json.loads(json.dumps(a.to_dict())))
+        assert json.dumps(again.to_dict(), sort_keys=True) == \
+            json.dumps(a.to_dict(), sort_keys=True)
+
+    def test_slot_hash_matches_local_store_sharding(self, tmp_path):
+        """slot_of must agree with ShardedSqliteStore's own placement,
+        so slot i of the cluster map IS the holder's meta_{i:02x}.db."""
+        store = ShardedSqliteStore(str(tmp_path / "meta"),
+                                   shard_count=8)
+        store.insert_entry(Entry(full_path="/photos/cat.jpg"))
+        slot = slot_of("/photos", 8)
+        dumped = [d["full_path"] for d in store.dump_slot(slot)]
+        assert dumped == ["/photos/cat.jpg"]
+        for other in range(8):
+            if other != slot:
+                assert store.dump_slot(other) == []
+        store.close()
+
+
+# ---------------------------------------------------------------------------
+# Cluster integration: master-replicated map + store servers
+# ---------------------------------------------------------------------------
+
+def _dirs_for_distinct_slots(slots, a_slots, b_slots):
+    """Find directory names landing in each holder's slot set."""
+    a_dir = b_dir = None
+    for i in range(10_000):
+        d = f"/bucket{i}"
+        s = slot_of(d, slots)
+        if a_dir is None and s in a_slots:
+            a_dir = d
+        if b_dir is None and s in b_slots:
+            b_dir = d
+        if a_dir and b_dir:
+            return a_dir, b_dir
+    raise AssertionError("hash never hit both slot sets")
+
+
+@pytest.fixture
+def shard_cluster(tmp_path, monkeypatch):
+    monkeypatch.setenv("WEED_FILER_SHARD_LEASE", "1.0")
+    master = MasterServer(port=0, pulse_seconds=1.0)
+    master.start()
+    s1 = FilerStoreServer(
+        port=0, store=ShardedSqliteStore(str(tmp_path / "s1"),
+                                         shard_count=8),
+        masters=[master.address])
+    s1.start()
+    s2 = FilerStoreServer(
+        port=0, store=ShardedSqliteStore(str(tmp_path / "s2"),
+                                         shard_count=8),
+        masters=[master.address])
+    stopped = []  # servers a test already tore down (crash simulation)
+    yield master, s1, s2, stopped
+    for srv in (s1, s2):
+        if srv not in stopped:
+            srv.stop()
+    master.stop()
+
+
+class TestClusterStoreServers:
+    def test_split_route_handover_rename_takeover(self, shard_cluster):
+        master, s1, s2, stopped = shard_cluster
+
+        # s1 (alone) holds all 8 slots and serves everything locally
+        assert wait_for(lambda: len(s1._held) == 8)
+        for i in range(40):
+            call(s1.address, "/store/insert",
+                 payload=Entry(
+                     full_path=f"/seed{i}/obj").to_dict(),
+                 method="POST")
+
+        # -- join: fair-share split 4/4 within ~a lease period ---------
+        s2.start()
+        assert wait_for(
+            lambda: len(s1._held) == 4 and len(s2._held) == 4
+            and len(s1._map) == 8 and len(s2._map) == 8,
+            timeout=20), (s1._held, s2._held, s1._map)
+        assert s1._held.isdisjoint(s2._held)
+
+        # handover: entries seeded on s1 whose slots moved to s2 were
+        # pulled over the /store/dump channel — readable from s2 locally
+        moved = [f"/seed{i}" for i in range(40)
+                 if slot_of(f"/seed{i}", 8) in s2._held]
+        assert moved, "no seeded dir landed on a moved slot"
+        got = call(s2.address, "/store/find?path=" + moved[0] + "/obj")
+        assert got["full_path"] == moved[0] + "/obj"
+
+        # -- routing: a request landing on the wrong holder proxies ----
+        a_dir, b_dir = _dirs_for_distinct_slots(8, s1._held, s2._held)
+        call(s2.address, "/store/insert",
+             payload=Entry(full_path=a_dir + "/x").to_dict(),
+             method="POST")  # s2 proxies to s1
+        found = call(s1.address, "/store/find?path=" + a_dir + "/x")
+        assert found["full_path"] == a_dir + "/x"
+
+        # -- cross-shard rename ----------------------------------------
+        r = call(s1.address, "/store/rename",
+                 payload={"path": a_dir + "/x",
+                          "new_path": b_dir + "/y"}, method="POST")
+        assert r["to"] == b_dir + "/y"
+        assert call(s2.address, "/store/find?path=" + b_dir +
+                    "/y")["full_path"] == b_dir + "/y"
+        with pytest.raises(RpcError) as ei:
+            call(s1.address, "/store/find?path=" + a_dir + "/x")
+        assert ei.value.status == 404
+
+        # -- ClusterStore client routes from the master's map ----------
+        cs = ClusterStore([master.address])
+        cs.insert_entry(Entry(full_path=b_dir + "/via-client"))
+        assert cs.find_entry(
+            b_dir + "/via-client").full_path == b_dir + "/via-client"
+        names = {e.full_path for e in cs.list_directory(b_dir)}
+        assert b_dir + "/y" in names and b_dir + "/via-client" in names
+
+        # -- crash takeover: kill s2 without a goodbye -----------------
+        s2._lease_stop.set()
+        if s2._lease_thread is not None:
+            s2._lease_thread.join(timeout=5)
+        s2.server.stop()  # no release: the lease must expire (1 s TTL)
+        stopped.append(s2)
+        assert wait_for(lambda: len(s1._held) == 8, timeout=20), \
+            s1._held
+        # availability restored: the former-s2 dir is writable again
+        call(s1.address, "/store/insert",
+             payload=Entry(full_path=b_dir + "/after").to_dict(),
+             method="POST")
+        got = call(s1.address, "/store/find?path=" + b_dir + "/after")
+        assert got["full_path"] == b_dir + "/after"
+        s2.store.close()
+
+    def test_shard_map_is_replicated_fsm_state(self, shard_cluster):
+        """The map served by /filer/shards comes from the raft FSM —
+        leases survive a (single-node) master restart via the log."""
+        master, s1, s2, stopped = shard_cluster
+        stopped.append(s2)  # never started in this test
+        s2.store.close()
+        assert wait_for(lambda: len(s1._held) == 8)
+        r = call(master.address, "/filer/shards")
+        assert r["slots"] == 8
+        assert set(r["map"].values()) == {s1.address}
+        # the FSM's shard map and the HTTP view agree
+        assert r["map"] == master.raft.fsm.shard_map.assignments()

@@ -73,7 +73,10 @@ def _load_guard():
 def cmd_master(args):
     from seaweedfs_tpu.master.server import MasterServer
 
-    peers = [p for p in args.peers.split(",") if p]
+    # -peers wins; WEED_MASTER_PEERS covers fleet-managed deployments
+    # where every master gets the same env
+    peer_spec = args.peers or os.environ.get("WEED_MASTER_PEERS", "")
+    peers = [p for p in peer_spec.split(",") if p]
     m = MasterServer(host=args.ip, port=args.port,
                      volume_size_limit_mb=args.volumeSizeLimitMB,
                      default_replication=args.defaultReplication,
@@ -141,7 +144,8 @@ def cmd_volume(args):
     _wait_forever([vs])
 
 
-def _make_filer_store(kind: str, path: str, store_address: str = ""):
+def _make_filer_store(kind: str, path: str, store_address: str = "",
+                      masters: str = ""):
     from seaweedfs_tpu.filer.filer_store import (PerBucketStoreRouter,
                                                  ShardedSqliteStore,
                                                  SqliteStore)
@@ -154,9 +158,18 @@ def _make_filer_store(kind: str, path: str, store_address: str = ""):
         if not store_address:
             raise SystemExit("-store remote needs -storeAddress host:port")
         return RemoteStore(store_address)
+    if kind == "cluster":
+        # stateless filer routing by the master-replicated shard map to
+        # a fleet of `weed filer.store -master ...` slot holders
+        from seaweedfs_tpu.filer.cluster_store import ClusterStore
+
+        if not masters:
+            raise SystemExit("-store cluster needs -master host:port")
+        return ClusterStore(masters.split(","))
     if kind not in ("sqlite", "sharded", "perbucket"):
         raise SystemExit(f"unknown filer store kind {kind!r} "
-                         "(sqlite | sharded | perbucket | remote)")
+                         "(sqlite | sharded | perbucket | remote | "
+                         "cluster)")
     if not path:
         if kind != "sqlite":
             raise SystemExit(
@@ -176,9 +189,12 @@ def cmd_filer_store(args):
                                                   make_store)
 
     store = make_store(args.db_kind, args.dir)
-    s = FilerStoreServer(host=args.ip, port=args.port, store=store)
+    masters = [m for m in (args.master or "").split(",") if m]
+    s = FilerStoreServer(host=args.ip, port=args.port, store=store,
+                         masters=masters)
     s.start()
-    print(f"filer.store ({args.db_kind}) listening on {s.address}")
+    print(f"filer.store ({args.db_kind}) listening on {s.address}" +
+          (f", leasing shards from {masters}" if masters else ""))
     _wait_forever([s])
 
 
@@ -186,7 +202,8 @@ def cmd_filer(args):
     from seaweedfs_tpu.filer.server import FilerServer
 
     store = _make_filer_store(args.store, args.db,
-                              getattr(args, "storeAddress", ""))
+                              getattr(args, "storeAddress", ""),
+                              masters=args.master)
     f = FilerServer(args.master, host=args.ip, port=args.port, store=store,
                     chunk_size=args.maxMB * 1024 * 1024,
                     replication=args.replication,
@@ -311,7 +328,8 @@ def cmd_server(args):
 
     if args.filer or args.s3 or args.iam:
         store = _make_filer_store(args.store, args.db,
-                                  getattr(args, "storeAddress", ""))
+                                  getattr(args, "storeAddress", ""),
+                                  masters=master.address)
         filer = FilerServer(master.address, host=args.ip,
                             port=args.filerPort, store=store, guard=guard,
                             cipher=args.encryptVolumeData)
@@ -456,6 +474,7 @@ def _shell_handlers(env):
         "cluster.ps": lambda a: show(vol.cluster_ps(env)),
         "cluster.check": lambda a: show(vol.cluster_check(env)),
         "cluster.raft.ps": lambda a: show(vol.cluster_raft_ps(env)),
+        "raft.status": lambda a: show(vol.cluster_raft_ps(env)),
         "cluster.raft.add": lambda a: show(vol.cluster_raft_add(
             env, a[0])),
         "cluster.raft.remove": lambda a: show(vol.cluster_raft_remove(
@@ -1186,7 +1205,8 @@ def main(argv=None):
     p.add_argument("-maxMB", type=int, default=4)
     p.add_argument("-db", default="", help="sqlite path (default: memory)")
     p.add_argument("-store", default="sqlite",
-                   help="store kind: sqlite | sharded | perbucket | remote")
+                   help="store kind: sqlite | sharded | perbucket | "
+                        "remote | cluster")
     p.add_argument("-storeAddress", default="",
                    help="shared `weed filer.store` address (-store remote)")
     p.add_argument("-replication", default="")
@@ -1214,6 +1234,9 @@ def main(argv=None):
     p.add_argument("-db_kind", default="memory",
                    help="embedded kind: memory | sqlite | sharded | "
                         "perbucket")
+    p.add_argument("-master", default="",
+                   help="comma-separated masters: lease directory shards "
+                        "from the replicated map (cluster mode)")
     p.set_defaults(fn=cmd_filer_store)
 
     p = sub.add_parser("s3", help="start an s3 gateway (+embedded filer)")
