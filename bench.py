@@ -1256,6 +1256,157 @@ def bench_s3_gateway(num_objects: int = 5000) -> dict:
         gc.unfreeze()
 
 
+def bench_read_cache(num_objects: int = 3000, payload_bytes: int = 4096,
+                     workers: int = 8) -> dict:
+    """Cold vs warm GET storms through the unified read cache
+    (cache/ package): a smallfile storm on the filer object-GET path
+    (where a warm chunk-cache hit skips the internal filer->volume
+    hop entirely), an S3 object-GET storm, and a direct volume-server
+    needle storm, each run once with every cache tier cleared and once
+    warm, with per-tier hit ratios from the cache's own accounting.
+    4 KiB objects keep bodies past the filer inline limit so the chunk
+    cache is actually on the path.  The direct needle storm is
+    reported but not ratio-gated: the needle cache saves ~8 us of
+    store work per request, which is real but small next to the
+    ~100 us/request HTTP framing floor of the storm harness itself.
+    Returns {smallfile_cold_rps, smallfile_warm_rps, warm_vs_cold,
+    s3_get_cold_rps, s3_get_warm_rps, s3_warm_vs_cold,
+    volume_get_cold_rps, volume_get_warm_rps, volume_warm_vs_cold,
+    volume_cache, filer_cache}."""
+    import socket
+    import tempfile
+    from concurrent.futures import ThreadPoolExecutor
+
+    from seaweedfs_tpu.filer.server import FilerServer
+    from seaweedfs_tpu.master.server import MasterServer
+    from seaweedfs_tpu.rpc.http_rpc import call
+    from seaweedfs_tpu.s3api.server import S3ApiServer
+    from seaweedfs_tpu.volume_server.server import VolumeServer
+
+    import gc
+    gc.collect()
+    gc.freeze()
+
+    workdir = tempfile.mkdtemp(prefix="swbench_rc_")
+    master = MasterServer(port=0, pulse_seconds=1.0,
+                          volume_size_limit_mb=1024)
+    master.start()
+    vs = VolumeServer([workdir], master.address, port=0,
+                      pulse_seconds=1.0, max_volume_counts=[16],
+                      enable_tcp=True)
+    vs.start()
+    vs.heartbeat_once()
+    filer = FilerServer(master.address, port=0)
+    filer.start()
+    s3 = S3ApiServer(filer, port=0)
+    s3.start()
+    payload = b"r" * payload_bytes
+    out: dict = {}
+    try:
+        def storm(address, method, path_of, nreq, body):
+            def worker(span):
+                host, port = address.rsplit(":", 1)
+                sock = socket.create_connection((host, int(port)),
+                                                timeout=30)
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                rfile = sock.makefile("rb", buffering=65536)
+                head = f"{method} ".encode()
+                tail = (f" HTTP/1.1\r\nHost: {host}\r\n"
+                        f"Content-Length: {len(body or b'')}\r\n\r\n"
+                        ).encode() + (body or b"")
+                ok = 0
+                readline = rfile.readline
+                read = rfile.read
+                for i in span:
+                    sock.sendall(head + path_of(i).encode() + tail)
+                    line = readline()
+                    if not line:
+                        break
+                    clen = 0
+                    while True:
+                        h = readline()
+                        if h in (b"\r\n", b"\n", b""):
+                            break
+                        if h[:15].lower() == b"content-length:":
+                            clen = int(h[15:])
+                    if clen:
+                        read(clen)
+                    if line[9:12] in (b"200", b"201", b"204"):
+                        ok += 1
+                rfile.close()
+                sock.close()
+                return ok
+
+            spans = [range(w, nreq, workers) for w in range(workers)]
+            t0 = time.perf_counter()
+            with ThreadPoolExecutor(max_workers=workers) as pool:
+                oks = sum(pool.map(worker, spans))
+            secs = time.perf_counter() - t0
+            if oks < nreq * 0.99:
+                print(f"note: read-cache bench {method} errors: "
+                      f"{nreq - oks}", file=sys.stderr)
+            return oks / secs if secs else 0.0
+
+        # -- smallfile storm on the filer object-GET path (gated) --------
+        storm(filer.address, "PUT", lambda i: f"/rcache/f{i}",
+              num_objects, payload)
+        filer.chunk_cache.clear()
+        vs.read_cache.clear()
+        out["smallfile_cold_rps"] = storm(
+            filer.address, "GET", lambda i: f"/rcache/f{i}", num_objects,
+            None)
+        out["smallfile_warm_rps"] = storm(
+            filer.address, "GET", lambda i: f"/rcache/f{i}", num_objects,
+            None)
+        out["warm_vs_cold"] = (
+            round(out["smallfile_warm_rps"] / out["smallfile_cold_rps"], 2)
+            if out["smallfile_cold_rps"] else 0.0)
+
+        # -- direct volume-server needle storm (reported, not gated) -----
+        fids = []
+        for _ in range(num_objects):
+            a = call(master.address, "/dir/assign", timeout=10)
+            fid = a["fid"]
+            call(vs.address, f"/{fid}", raw=payload, method="POST",
+                 timeout=10)
+            fids.append(fid)
+        vs.read_cache.clear()
+        out["volume_get_cold_rps"] = storm(
+            vs.address, "GET", lambda i: f"/{fids[i]}", num_objects, None)
+        out["volume_get_warm_rps"] = storm(
+            vs.address, "GET", lambda i: f"/{fids[i]}", num_objects, None)
+        out["volume_warm_vs_cold"] = (
+            round(out["volume_get_warm_rps"] / out["volume_get_cold_rps"],
+                  2)
+            if out["volume_get_cold_rps"] else 0.0)
+        out["volume_cache"] = vs.read_cache.stats_snapshot()
+
+        # -- S3 object-GET storm (filer chunk cache on the path) ---------
+        storm(s3.address, "PUT", lambda i: "/rcache", 1, b"")
+        storm(s3.address, "PUT", lambda i: f"/rcache/o{i}", num_objects,
+              payload)
+        filer.chunk_cache.clear()
+        vs.read_cache.clear()
+        out["s3_get_cold_rps"] = storm(
+            s3.address, "GET", lambda i: f"/rcache/o{i}", num_objects,
+            None)
+        out["s3_get_warm_rps"] = storm(
+            s3.address, "GET", lambda i: f"/rcache/o{i}", num_objects,
+            None)
+        out["s3_warm_vs_cold"] = (
+            round(out["s3_get_warm_rps"] / out["s3_get_cold_rps"], 2)
+            if out["s3_get_cold_rps"] else 0.0)
+        out["filer_cache"] = filer.chunk_cache.stats_snapshot()
+        return out
+    finally:
+        s3.stop()
+        filer.stop()
+        vs.stop()
+        master.stop()
+        shutil.rmtree(workdir, ignore_errors=True)
+        gc.unfreeze()
+
+
 def bench_small_file_secured(num_files: int) -> tuple[float, float]:
     """Small-file data plane under PRODUCTION configuration: JWT write
     signing + replication 001 — two volume servers (the second in a
@@ -1618,6 +1769,14 @@ def main():
     except Exception as e:
         print(f"note: s3 bench failed: {e}", file=sys.stderr)
 
+    # -- unified read cache: cold vs warm GET storms -------------------------
+    read_cache_stats: dict = {}
+    try:
+        _policy.reset_state()
+        read_cache_stats = bench_read_cache()
+    except Exception as e:
+        print(f"note: read cache bench failed: {e}", file=sys.stderr)
+
     vs_baseline = hbm_fused / cpu_kernel if cpu_kernel > 0 else 0.0
     from seaweedfs_tpu.util.platform import available_cpu_count
 
@@ -1692,6 +1851,7 @@ def main():
             round(s3_stats["s3_get_rps"] / s3_stats["filer_get_rps"], 2)
             if s3_stats.get("filer_get_rps") else 0.0),
         "gateway_stages": s3_stats.get("gateway_stages", {}),
+        "read_cache": read_cache_stats,
         "smallfile_secured_vs_plain_write": (
             round(sec_write_rps / sf_write_rps, 2) if sf_write_rps
             else 0.0),
@@ -1710,7 +1870,8 @@ if __name__ == "__main__":
     # single-phase mode: `python bench.py ec_rebuild` runs one phase and
     # prints its JSON alone — the full suite stays the no-argument default
     _phases = {"ec_rebuild": bench_ec_rebuild,
-               "master_failover": bench_master_failover}
+               "master_failover": bench_master_failover,
+               "read_cache": bench_read_cache}
     if len(sys.argv) > 1:
         if sys.argv[1] not in _phases:
             sys.exit(f"unknown bench phase {sys.argv[1]!r}; "

@@ -1,0 +1,135 @@
+"""HBM tier: hottest chunks pinned in `DevicePool` resident slabs.
+
+The EC decode path already proves content-addressed device-resident
+slabs work (`ops/device_pool.py` survivor stacks); this generalizes the
+same discipline to plain GET serving.  Each pinned chunk holds one
+resident reference in the process-wide pool — held references do not
+count against ``WEED_EC_DEVICE_POOL_MB`` idle-byte eviction, so pinned
+read traffic and EC scratch coexist — and the tier keeps its own LRU
+bounded by ``WEED_READ_CACHE_HBM_MB``.
+
+On CPU-only harnesses `jax.device_put` lands in host memory, so the
+tier degrades to a second RAM copy; it is therefore off by default and
+only worth enabling where HBM is real.  Uploads/readbacks go through
+``numpy`` u8 views; if jax is unavailable the tier is inert (every put
+fails softly, every get misses).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Optional
+
+from ..ops.device_pool import get_pool
+
+
+class _ResidentLost(Exception):
+    """The pool no longer holds our slab (reset/clear raced us)."""
+
+
+def _no_refill():
+    raise _ResidentLost()
+
+
+class HbmTier:
+    def __init__(self, capacity_bytes: int):
+        self.capacity = capacity_bytes
+        self._keys: OrderedDict[str, int] = OrderedDict()  # fid -> nbytes
+        self._bytes = 0
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _pool_key(fid: str):
+        return ("read_cache", fid)
+
+    def put(self, fid: str, data) -> bool:
+        if not isinstance(data, (bytes, bytearray, memoryview)):
+            return False
+        nbytes = len(data)
+        if nbytes == 0 or nbytes > self.capacity:
+            return False
+        with self._lock:
+            if fid in self._keys:
+                self._keys.move_to_end(fid)
+                return True
+        try:
+            import jax
+            import numpy as np
+
+            host = np.frombuffer(bytes(data), dtype=np.uint8)
+            get_pool().acquire_resident(
+                self._pool_key(fid), lambda: jax.device_put(host), nbytes)
+        except Exception:
+            return False
+        with self._lock:
+            if fid in self._keys:  # lost the publish race: drop our ref
+                get_pool().release_resident(self._pool_key(fid))
+                self._keys.move_to_end(fid)
+                return True
+            self._keys[fid] = nbytes
+            self._bytes += nbytes
+            evicted = []
+            while self._bytes > self.capacity and len(self._keys) > 1:
+                old, n = self._keys.popitem(last=False)
+                self._bytes -= n
+                evicted.append(old)
+        for old in evicted:
+            get_pool().release_resident(self._pool_key(old))
+        return True
+
+    def get(self, fid: str) -> Optional[bytes]:
+        with self._lock:
+            if fid not in self._keys:
+                return None
+            self._keys.move_to_end(fid)
+        key = self._pool_key(fid)
+        try:
+            payload = get_pool().acquire_resident(key, _no_refill, 0)
+        except _ResidentLost:
+            self.pop(fid)
+            return None
+        try:
+            import numpy as np
+
+            return np.asarray(payload).tobytes()
+        except Exception:
+            return None
+        finally:
+            get_pool().release_resident(key)
+
+    def pop(self, fid: str) -> bool:
+        with self._lock:
+            n = self._keys.pop(fid, None)
+            if n is None:
+                return False
+            self._bytes -= n
+        get_pool().release_resident(self._pool_key(fid))
+        return True
+
+    def drop_prefix(self, prefix: str) -> int:
+        with self._lock:
+            stale = [k for k in self._keys if k.startswith(prefix)]
+            for k in stale:
+                self._bytes -= self._keys.pop(k)
+        for k in stale:
+            get_pool().release_resident(self._pool_key(k))
+        return len(stale)
+
+    def __len__(self) -> int:
+        return len(self._keys)
+
+    @property
+    def size_bytes(self) -> int:
+        return self._bytes
+
+    def clear(self):
+        with self._lock:
+            stale = list(self._keys)
+            self._keys.clear()
+            self._bytes = 0
+        for k in stale:
+            get_pool().release_resident(self._pool_key(k))
+
+    def close(self):
+        self.clear()

@@ -1,55 +1,15 @@
 """Chunk-level read cache for streaming filer reads.
 
-Parity with weed/filer/reader_cache.go + weed/util/chunk_cache: recently
-fetched chunks are kept in RAM (bounded by byte budget, LRU eviction) so
-sequential and repeated reads of the same file avoid re-fetching from
-volume servers.
+Parity with weed/filer/reader_cache.go + weed/util/chunk_cache:
+recently fetched chunks are kept in RAM (bounded by byte budget, LRU
+eviction) so sequential and repeated reads of the same file avoid
+re-fetching from volume servers.
+
+The implementation now lives in the unified read-through cache package
+(`seaweedfs_tpu/cache/`); this module keeps the public `ChunkCache`
+name for its importers.
 """
 
 from __future__ import annotations
 
-import threading
-from collections import OrderedDict
-
-
-class ChunkCache:
-    def __init__(self, capacity_bytes: int = 64 << 20):
-        self.capacity = capacity_bytes
-        self._data: OrderedDict[str, bytes] = OrderedDict()
-        self._bytes = 0
-        self._lock = threading.Lock()
-        self.hits = 0
-        self.misses = 0
-
-    def get(self, fid: str) -> bytes | None:
-        with self._lock:
-            data = self._data.get(fid)
-            if data is None:
-                self.misses += 1
-                return None
-            self._data.move_to_end(fid)
-            self.hits += 1
-            return data
-
-    def put(self, fid: str, data: bytes):
-        if len(data) > self.capacity:
-            return  # oversized: never cache (chunk_cache size gate)
-        with self._lock:
-            old = self._data.pop(fid, None)
-            if old is not None:
-                self._bytes -= len(old)
-            self._data[fid] = data
-            self._bytes += len(data)
-            while self._bytes > self.capacity:
-                _, evicted = self._data.popitem(last=False)
-                self._bytes -= len(evicted)
-
-    def __len__(self) -> int:
-        return len(self._data)
-
-    @property
-    def size_bytes(self) -> int:
-        return self._bytes
-
-    def close(self):
-        """No resources to release; shares the tiered cache's interface."""
+from ..cache.read_cache import ChunkCache  # noqa: F401

@@ -39,7 +39,7 @@ from .filer import Filer
 from .filer_conf import FilerConf
 from .filer_store import FilerStore, NotFoundError
 from .meta_aggregator import MetaAggregator
-from .reader_cache import ChunkCache
+from ..cache import TieredReadCache
 
 DEFAULT_CHUNK_SIZE = 4 * 1024 * 1024  # filer -maxMB default (4MB)
 INLINE_LIMIT = 2048  # small-content inlining threshold
@@ -65,7 +65,7 @@ class FilerServer:
                  guard: Optional[Guard] = None,
                  peers: Optional[list[str]] = None,
                  persist_meta_log: bool = False,
-                 chunk_cache_bytes: int = 64 << 20,
+                 chunk_cache_bytes: Optional[int] = None,
                  manifest_batch: int = MANIFEST_BATCH,
                  cipher: bool = False,
                  cache_dir: str = "",
@@ -97,16 +97,12 @@ class FilerServer:
         self.filer.on_delete_chunks = self._delete_chunks
         if persist_meta_log:
             self.filer.enable_meta_log()
-        if cache_dir:
-            # tiered cache: RAM LRU + size-classed on-disk FIFO layers
-            # (util/chunk_cache, -cacheDir)
-            from ..util.chunk_cache import TieredChunkCache
-
-            self.chunk_cache = TieredChunkCache(
-                cache_dir, mem_bytes=chunk_cache_bytes,
-                disk_bytes=cache_disk_bytes)
-        else:
-            self.chunk_cache = ChunkCache(chunk_cache_bytes)
+        # unified tiered read-through cache (cache/): host RAM LRU,
+        # optional HBM pinning (WEED_READ_CACHE_HBM_MB), and with a
+        # -cacheDir the size-classed on-disk FIFO layers
+        self.chunk_cache = TieredReadCache(
+            mem_bytes=chunk_cache_bytes, directory=cache_dir,
+            disk_bytes=cache_disk_bytes)
         self.manifest_batch = manifest_batch
         self.meta_aggregator: Optional[MetaAggregator] = None
         if peers:
@@ -264,6 +260,9 @@ class FilerServer:
         if exclude_fids:
             chunks = [c for c in chunks if c.fid not in exclude_fids]
         for chunk in chunks:
+            # a deleted fid must never serve stale bytes out of the
+            # read cache, even if a later write reuses the fid
+            self.chunk_cache.invalidate(chunk.fid, reason="delete")
             headers = {}
             if self.guard.signing:
                 # filer shares security.toml; sign its own delete token
@@ -736,21 +735,34 @@ class FilerServer:
         with tracing.span("filer.read",
                           tags={"bytes": length if length is not None
                                 else entry.size() - start}):
-            return self._read_bytes(entry, start, length)
+            return b"".join(self._read_parts(entry, start, length))
 
-    def _read_bytes(self, entry: Entry, start: int = 0,
-                    length: Optional[int] = None) -> bytes:
+    def read_view(self, entry: Entry, start: int = 0,
+                  length: Optional[int] = None):
+        """Zero-copy buffered read: ``(parts, n)`` where `parts` is a
+        list of buffers (`memoryview` slices over cached chunk bytes)
+        covering [start, start+n) — written straight into the socket
+        send with no intermediate `bytes` concatenation."""
+        with tracing.span("filer.read",
+                          tags={"bytes": length if length is not None
+                                else entry.size() - start}):
+            parts = self._read_parts(entry, start, length)
+        return parts, sum(len(p) for p in parts)
+
+    def _read_parts(self, entry: Entry, start: int = 0,
+                    length: Optional[int] = None) -> list:
         size = entry.size()
         if length is None:
             length = size - start
         if entry.content:
-            return entry.content[start:start + length]
+            return [memoryview(entry.content)[start:start + length]]
         if entry.remote_entry and not entry.chunks:
             # metadata-only remote mount entry: read through to the
             # remote object (read_remote.go; remote.cache materialises)
             from .remote_storage import read_through
 
-            return read_through(self.filer, entry)[start:start + length]
+            return [memoryview(read_through(self.filer, entry))
+                    [start:start + length]]
         chunks = entry.chunks
         if has_chunk_manifest(chunks):
             chunks = resolve_chunk_manifest(self._fetch_chunk, chunks)
@@ -786,11 +798,14 @@ class FilerServer:
             blobs = {fid: fetch(fid) for fid in fids}
         else:
             blobs = dict(zip(fids, self._io_pool.map(fetch, fids)))
-        parts = [blobs[v.fid][v.offset_in_chunk:
-                              v.offset_in_chunk + v.size]
+        # memoryview slices over the (immutable) fetched chunk bytes:
+        # the socket writes them directly, so a GET never copies the
+        # payload after the fetch/decrypt step
+        parts = [memoryview(blobs[v.fid])[v.offset_in_chunk:
+                                          v.offset_in_chunk + v.size]
                  for v in views]
         self._maybe_prefetch(chunks, start + length)
-        return b"".join(parts)
+        return parts
 
     def _maybe_prefetch(self, chunks, next_offset: int):
         """Sequential read-ahead (reader_cache.go MaybeCache +
@@ -985,9 +1000,13 @@ class FilerServer:
             headers["Content-Length"] = str(n)
             stats.FilerStreamedReadCounter.labels("streamed").inc()
             return Response(body_iter, status, content_type, headers)
-        stats.FilerStreamedReadCounter.labels("buffered").inc()
-        return Response(self.read_bytes(entry, start, length), status,
-                        content_type, headers)
+        # buffered path: memoryview parts over cached chunk bytes go
+        # straight into the socket send — no b"".join copy
+        parts, n = self.read_view(entry, start, length)
+        headers["Content-Length"] = str(n)
+        stats.FilerStreamedReadCounter.labels("zero_copy").inc()
+        body = parts[0] if len(parts) == 1 else iter(parts)
+        return Response(body, status, content_type, headers)
 
     def _list_directory(self, entry: Entry, req: Request):
         limit = int(req.param("limit", "100"))

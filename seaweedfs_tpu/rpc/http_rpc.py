@@ -405,7 +405,10 @@ class RpcServer:
                 body = resp.body
                 if isinstance(body, str):
                     body = body.encode()
-                if not isinstance(body, (bytes, bytearray)):
+                if not isinstance(body, (bytes, bytearray, memoryview)):
+                    # iterators stream; memoryview bodies (zero-copy
+                    # cache hits) take the buffered single-write path —
+                    # len() and wfile.write() both accept them directly
                     self._reply_stream(resp, body)
                     return
                 # one formatted write into the buffered wfile instead

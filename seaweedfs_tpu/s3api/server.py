@@ -756,7 +756,11 @@ class S3ApiServer:
             body_iter, n = streamed
             headers["Content-Length"] = str(n)
             return Response(body_iter, status, content_type, headers)
-        body = self.filer_server.read_bytes(entry, start, length)
+        # buffered path: zero-copy memoryview parts over cached chunk
+        # bytes, written straight into the socket send
+        parts, n = self.filer_server.read_view(entry, start, length)
+        headers["Content-Length"] = str(n)
+        body = parts[0] if len(parts) == 1 else iter(parts)
         return Response(body, status, content_type, headers)
 
     def _delete_object(self, bucket: str, key: str):

@@ -350,6 +350,27 @@ DevicePoolDeviceBytesGauge = REGISTRY.gauge(
 FilerChunkCacheCounter = REGISTRY.counter(
     "SeaweedFS_filer_chunk_cache_total",
     "filer chunk cache lookups", ("result",))
+# unified HBM -> host RAM -> disk read-through cache (cache/ package)
+ReadCacheRequestsCounter = REGISTRY.counter(
+    "SeaweedFS_read_cache_requests_total",
+    "unified read cache lookups by serving tier "
+    "(hbm / ram / disk / miss)", ("tier",))
+ReadCacheFillCounter = REGISTRY.counter(
+    "SeaweedFS_read_cache_fill_total",
+    "read cache fill admissions (admitted / qos_bypass — background "
+    "traffic bypasses the fill path unless WEED_READ_CACHE_BG_FILL=1)",
+    ("outcome",))
+ReadCacheResidentBytesGauge = REGISTRY.gauge(
+    "SeaweedFS_read_cache_resident_bytes",
+    "bytes resident in the unified read cache, by tier", ("tier",))
+ReadCacheInvalidationsCounter = REGISTRY.counter(
+    "SeaweedFS_read_cache_invalidations_total",
+    "read cache entries dropped by cause "
+    "(delete / overwrite / vacuum / rebuild / stale)", ("reason",))
+ChunkCacheOversizeDropsCounter = REGISTRY.counter(
+    "SeaweedFS_chunk_cache_oversize_drops_total",
+    "chunks too large for every segment of a disk cache layer, "
+    "dropped at admission (historically a silent drop)")
 # gateway fast-path vectors: fid leasing on the write path, streamed
 # chunk prefetch on the read path, and the signature caches that keep
 # per-request crypto off the hot path

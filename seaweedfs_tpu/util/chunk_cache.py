@@ -1,154 +1,23 @@
 """Tiered chunk cache: RAM LRU plus size-classed on-disk FIFO layers.
 
-Parity with weed/util/chunk_cache (chunk_cache.go TieredChunkCache,
-on_disk_cache_layer.go, chunk_cache_on_disk.go): small chunks live in an
-in-memory LRU AND the small disk layer; medium and large chunks go to
-their own disk layers.  Each disk layer is a ring of append-only cache
-volumes — a flat data file plus an in-RAM fid index — and when the front
-volume fills, the oldest volume is reset and rotated to the front, giving
-FIFO eviction in volume-sized steps with no per-entry bookkeeping on
-disk.  Restarts rebuild nothing: cache volumes restart empty (the index
-is RAM-only), which is correct for a cache and avoids the reference's
-leveldb sidecar.
+The implementation moved into the unified read-through cache package
+(`seaweedfs_tpu/cache/` — HBM -> host RAM -> disk, shared by the volume
+server, filer and s3api GET paths).  This module keeps the historical
+import surface: `CacheVolume` and `OnDiskCacheLayer` re-export the disk
+tier, and `TieredChunkCache` preserves the old positional-`directory`
+constructor over `cache.TieredReadCache`.
 """
 
 from __future__ import annotations
 
-import os
-import threading
-from typing import Optional
-
-from ..filer.reader_cache import ChunkCache as MemoryChunkCache
+from ..cache.disk import CacheVolume, OnDiskCacheLayer  # noqa: F401
+from ..cache.read_cache import TieredReadCache
 
 
-class CacheVolume:
-    """One append-only cache segment: flat file + RAM index."""
-
-    def __init__(self, file_name: str, size_limit: int):
-        self.file_name = file_name
-        self.size_limit = size_limit
-        self._index: dict[str, tuple[int, int]] = {}  # fid -> (off, len)
-        # unbuffered: reads go through os.pread, which sees only what has
-        # actually reached the fd
-        self._file = open(file_name, "wb+", buffering=0)
-        self.file_size = 0
-
-    def get(self, fid: str) -> Optional[bytes]:
-        loc = self._index.get(fid)
-        if loc is None:
-            return None
-        return os.pread(self._file.fileno(), loc[1], loc[0])
-
-    def has_room(self, n: int) -> bool:
-        return self.file_size + n <= self.size_limit
-
-    def put(self, fid: str, data: bytes):
-        off = self.file_size
-        self._file.seek(off)
-        self._file.write(data)
-        self.file_size = off + len(data)
-        self._index[fid] = (off, len(data))
-
-    def reset(self):
-        self._file.truncate(0)
-        self._index.clear()
-        self.file_size = 0
-
-    def close(self):
-        try:
-            self._file.close()
-            os.unlink(self.file_name)
-        except OSError:
-            pass
-
-
-class OnDiskCacheLayer:
-    """Ring of cache volumes with rotate-on-full FIFO eviction
-    (on_disk_cache_layer.go setChunk)."""
-
-    def __init__(self, directory: str, prefix: str, total_bytes: int,
-                 segments: int):
-        self.seg_size = max(1, total_bytes // segments)
-        self.volumes = [
-            CacheVolume(os.path.join(directory, f"{prefix}_{i}.dat"),
-                        self.seg_size)
-            for i in range(segments)]
-        self._lock = threading.Lock()  # per-layer, not cache-global
-
-    def get(self, fid: str) -> Optional[bytes]:
-        with self._lock:
-            for v in self.volumes:
-                data = v.get(fid)
-                if data is not None:
-                    return data
-            return None
-
-    def put(self, fid: str, data: bytes):
-        if len(data) > self.seg_size:
-            return  # can never fit; don't wipe a segment discovering that
-        with self._lock:
-            if not self.volumes[0].has_room(len(data)):
-                oldest = self.volumes.pop()
-                oldest.reset()
-                self.volumes.insert(0, oldest)
-            self.volumes[0].put(fid, data)
-
-    def close(self):
-        with self._lock:
-            for v in self.volumes:
-                v.close()
-
-
-class TieredChunkCache:
+class TieredChunkCache(TieredReadCache):
     """RAM LRU + three size-classed disk layers (chunk_cache.go)."""
 
     def __init__(self, directory: str, mem_bytes: int = 64 << 20,
                  disk_bytes: int = 1 << 30, unit_size: int = 1 << 20):
-        os.makedirs(directory, exist_ok=True)
-        self.limit0 = unit_size          # small
-        self.limit1 = 4 * unit_size      # medium
-        self.mem = MemoryChunkCache(mem_bytes)
-        # same 1/8 : 3/8 : 1/2 split and segment counts as the reference
-        self.layers = [
-            OnDiskCacheLayer(directory, "c0_2", disk_bytes // 8, 2),
-            OnDiskCacheLayer(directory, "c1_3", disk_bytes * 3 // 8, 3),
-            OnDiskCacheLayer(directory, "c2_2", disk_bytes // 2, 2),
-        ]
-        # layers lock themselves; this guards only the counters
-        self._stat_lock = threading.Lock()
-        self.hits = 0
-        self.misses = 0
-
-    def _count(self, hit: bool):
-        with self._stat_lock:
-            if hit:
-                self.hits += 1
-            else:
-                self.misses += 1
-
-    def get(self, fid: str) -> Optional[bytes]:
-        data = self.mem.get(fid)
-        if data is not None:
-            self._count(True)
-            return data
-        for layer in self.layers:
-            data = layer.get(fid)
-            if data is not None:
-                self._count(True)
-                return data
-        self._count(False)
-        return None
-
-    def put(self, fid: str, data: bytes):
-        if len(data) <= self.limit0:
-            self.mem.put(fid, data)
-            layer = self.layers[0]
-        elif len(data) <= self.limit1:
-            layer = self.layers[1]
-        else:
-            layer = self.layers[2]
-        layer.put(fid, data)
-
-    def close(self):
-        for layer in self.layers:
-            layer.close()
+        super().__init__(mem_bytes=mem_bytes, directory=directory,
+                         disk_bytes=disk_bytes, unit_size=unit_size)

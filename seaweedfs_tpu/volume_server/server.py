@@ -246,6 +246,13 @@ class VolumeServer:
         # next pulse: assigns in the gap would keep landing on the
         # demoted volume (the heartbeat reports read_only per volume)
         self.store.on_demote = lambda vid: self._try_heartbeat()
+        # unified read cache over the needle-read path: parsed needles
+        # keyed by fid, validated against the live needle map on every
+        # hit (RAM + optional HBM tier; no disk tier — the needles are
+        # already on local disk)
+        from ..cache import TieredReadCache
+
+        self.read_cache = TieredReadCache()
         self._stop = threading.Event()
         # per-volume-id copy locks: concurrent copies of the SAME vid must
         # not race each other's temp files / exists-checks, but a slow copy
@@ -308,6 +315,7 @@ class VolumeServer:
             except OSError:
                 pass
         self.server.stop()
+        self.read_cache.close()
         self.store.close()
 
     # -- native fast-path serving registry ------------------------------------
@@ -893,19 +901,23 @@ class VolumeServer:
 
     def _read_object(self, vid: int, nid: int, cookie: int, method: str,
                      req: Request, fid: str):
-        if (self.store.find_volume(vid) is None
-                and self.store.find_ec_volume(vid) is None):
+        v = self.store.find_volume(vid)
+        if v is None and self.store.find_ec_volume(vid) is None:
             # volume not local: readMode local|proxy|redirect
             # (volume_server_handlers_read.go:30-70)
             return self._read_nonlocal(vid, method, req, fid)
-        try:
-            n = self.store.read_needle(vid, nid, cookie=cookie)
-        except (NotFoundError, EcNotFoundError):
-            raise RpcError("not found", 404)
-        except (DeletedError, EcDeletedError):
-            raise RpcError("already deleted", 404)
-        except (CookieMismatchError,) as e:
-            raise RpcError(str(e), 404)
+        n = self._cached_needle(v, vid, nid, cookie)
+        if n is None:
+            nv_before = v.nm.get(nid) if v is not None else None
+            try:
+                n = self.store.read_needle(vid, nid, cookie=cookie)
+            except (NotFoundError, EcNotFoundError):
+                raise RpcError("not found", 404)
+            except (DeletedError, EcDeletedError):
+                raise RpcError("already deleted", 404)
+            except (CookieMismatchError,) as e:
+                raise RpcError(str(e), 404)
+            self._fill_needle_cache(v, vid, nid, n, nv_before)
         if not self.download_gate.acquire(len(n.data)):
             stats.VolumeServerThrottleRejects.labels("download").inc()
             raise RpcError("too many requests: download limit", 429)
@@ -913,6 +925,42 @@ class VolumeServer:
             return self._build_read_response(n, method, req)
         finally:
             self.download_gate.release(len(n.data))
+
+    def _cached_needle(self, v, vid: int, nid: int, cookie: int):
+        """Serve a needle read out of the unified read cache when the
+        live needle map still agrees with the cached (offset, size) —
+        overwrites, deletes and vacuum offset shifts all change the
+        map, so a stale entry self-invalidates even for writes that
+        arrive on the native TCP path (defense in depth on top of the
+        explicit invalidation hooks)."""
+        if v is None or v.ttl:  # EC reads and TTL expiry go to the store
+            return None
+        key = f"{vid},{nid:x}"
+        cached = self.read_cache.get(key)
+        if cached is None:
+            return None
+        n, off, size = cached
+        nv = v.nm.get(nid)
+        if nv is None or nv.offset != off or nv.size != size:
+            self.read_cache.invalidate(key, reason="stale")
+            return None
+        if cookie is not None and n.cookie != cookie:
+            raise RpcError(f"cookie mismatch for needle {nid:x}", 404)
+        return n
+
+    def _fill_needle_cache(self, v, vid: int, nid: int, n: Needle,
+                           nv_before):
+        """Admit a freshly-read needle, pinned to the (offset, size) it
+        was read at; a concurrent overwrite between the read and this
+        fill shows up as a map probe mismatch and skips the fill."""
+        if v is None or v.ttl:
+            return
+        nv = v.nm.get(nid)
+        if nv is None or nv_before is None or \
+                nv.offset != nv_before.offset or nv.size != nv_before.size:
+            return
+        self.read_cache.put(f"{vid},{nid:x}", (n, nv.offset, nv.size),
+                            nbytes=len(n.data))
 
     def _build_read_response(self, n: Needle, method: str, req: Request):
         headers = {"Etag": f'"{n.etag()}"', "Accept-Ranges": "bytes"}
@@ -947,7 +995,9 @@ class VolumeServer:
                 start, end = sliced
                 headers["Content-Range"] = (
                     f"bytes {start}-{end - 1}/{len(data)}")
-                data = data[start:end]
+                # zero-copy slice: the socket writes the view straight
+                # out of the (possibly cached) needle bytes
+                data = memoryview(data)[start:end]
                 status = 206
         if method == "HEAD":
             # entity size, not body size (the handler sends no body)
@@ -1043,6 +1093,7 @@ class VolumeServer:
             raise RpcError(str(e), 403)
         except VolumeError as e:
             raise RpcError(str(e), 500)
+        self.read_cache.invalidate(f"{vid},{nid:x}", reason="overwrite")
         if not is_replicate:
             self._replicate(vid, f"{vid},{nid:x}{cookie:08x}", "POST",
                             req.body, dict(req.headers.items()))
@@ -1056,6 +1107,7 @@ class VolumeServer:
             size = self.store.delete_needle(vid, n)
         except NotFoundError:
             raise RpcError(f"volume {vid} not found", 404)
+        self.read_cache.invalidate(f"{vid},{nid:x}", reason="delete")
         if not is_replicate:
             self._replicate(vid, f"{vid},{nid:x}{cookie:08x}", "DELETE",
                             None, {})
@@ -1140,7 +1192,11 @@ class VolumeServer:
         return {}
 
     def _h_vacuum_commit(self, req: Request):
-        self._volume_or_404(int(req.json()["volume"])).commit_compact()
+        vid = int(req.json()["volume"])
+        self._volume_or_404(vid).commit_compact()
+        # compaction shifts needle offsets: cached (offset, size) pins
+        # are stale en masse, drop the whole volume's entries
+        self.read_cache.invalidate_volume(vid, reason="vacuum")
         return {}
 
     # -- volume copy/tail/backup (volume_grpc_copy.go, _tail.go, backup) -----
@@ -1323,6 +1379,8 @@ class VolumeServer:
             try:
                 size = self.store.delete_needle(
                     vid, Needle(id=nid, cookie=cookie))
+                self.read_cache.invalidate(f"{vid},{nid:x}",
+                                           reason="delete")
                 results.append({"fid": fid, "status": 200, "size": size})
             except NotFoundError:
                 results.append({"fid": fid, "status": 404,
@@ -1340,8 +1398,9 @@ class VolumeServer:
 
     def _h_ec_rebuild(self, req: Request):
         p = req.json()
-        rebuilt = self.store.ec_rebuild(int(p["volume"]),
-                                        p.get("collection", ""))
+        vid = int(p["volume"])
+        rebuilt = self.store.ec_rebuild(vid, p.get("collection", ""))
+        self.read_cache.invalidate_volume(vid, reason="rebuild")
         return {"rebuilt_shard_ids": rebuilt}
 
     def _h_ec_mount(self, req: Request):
@@ -1666,6 +1725,7 @@ class VolumeServer:
         read_bytes = width * len(plan.helpers)
         rebuilt_bytes = width * fam.sub_shards
         ec_codes.note_rebuild(fam.name, read_bytes, rebuilt_bytes)
+        self.read_cache.invalidate_volume(vid, reason="rebuild")
         return {"rebuilt_shard_ids": [lost], "read_bytes": read_bytes,
                 "rebuilt_bytes": rebuilt_bytes,
                 "read_amp": round(read_bytes / rebuilt_bytes, 4),

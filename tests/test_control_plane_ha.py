@@ -211,15 +211,24 @@ class TestQueueThroughRaft:
     def test_follower_rejects_with_leader_hint(self, tmp_path):
         masters = start_trio(tmp_path)
         try:
-            assert wait_for(lambda: len(leaders(masters)) == 1)
-            leader = leaders(masters)[0]
-            follower = next(m for m in masters if not m.raft.is_leader)
-            with pytest.raises(RpcError) as ei:
-                follower.raft.propose(
-                    {"type": "topology.epoch", "now": 1.0})
-            assert ei.value.status == 409
-            assert (ei.value.headers or {}).get("X-Raft-Leader") == \
-                leader.address
+            # A loaded box can trigger a re-election between sampling
+            # the leader and proposing, leaving the follower's hint
+            # momentarily unset — retry until a stable round is seen.
+            hint = None
+            for _ in range(10):
+                assert wait_for(lambda: len(leaders(masters)) == 1)
+                leader = leaders(masters)[0]
+                follower = next(m for m in masters
+                                if not m.raft.is_leader)
+                with pytest.raises(RpcError) as ei:
+                    follower.raft.propose(
+                        {"type": "topology.epoch", "now": 1.0})
+                assert ei.value.status == 409
+                hint = (ei.value.headers or {}).get("X-Raft-Leader")
+                if hint == leader.address and leader.raft.is_leader:
+                    break
+                time.sleep(0.3)
+            assert hint == leader.address
         finally:
             for m in masters:
                 m.stop()

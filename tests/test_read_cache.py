@@ -1,0 +1,295 @@
+"""Unified tiered read cache (cache/ package): tier routing, QoS-aware
+admission, HBM promotion, and — end to end against a live volume
+server — invalidation on every mutation path (overwrite, delete,
+vacuum, EC rebuild).
+
+The integration tests use cache *poisoning* to prove invalidation
+actually fires: a deliberately wrong payload is planted under the live
+cache key, so a byte-identical re-read after the mutation is only
+possible if the handler dropped the entry.  "Bytes match" alone would
+also pass if the cache were silently off the read path."""
+
+import os
+import threading
+
+import pytest
+
+from seaweedfs_tpu.cache import (OnDiskCacheLayer, RamCache,
+                                 TieredReadCache)
+from seaweedfs_tpu.stats import metrics as stats
+
+
+class TestRamCache:
+    def test_lru_eviction_by_bytes(self):
+        c = RamCache(capacity_bytes=300)
+        c.put("a", b"x" * 100)
+        c.put("b", b"y" * 100)
+        c.put("c", b"z" * 100)
+        assert c.get("a") == b"x" * 100  # touch: a becomes MRU
+        c.put("d", b"w" * 100)  # evicts b, the LRU
+        assert c.get("b") is None
+        assert c.get("a") and c.get("c") and c.get("d")
+        assert c.size_bytes <= 300
+
+    def test_oversize_never_cached(self):
+        c = RamCache(capacity_bytes=64)
+        c.put("big", b"x" * 65)
+        assert c.get("big") is None and len(c) == 0
+
+    def test_drop_prefix(self):
+        c = RamCache()
+        c.put("3,aa", b"1")
+        c.put("3,bb", b"2")
+        c.put("4,aa", b"3")
+        assert c.drop_prefix("3,") == 2
+        assert c.get("3,aa") is None and c.get("4,aa") == b"3"
+
+
+class TestTierRouting:
+    def test_small_medium_large_land_in_size_classed_layers(self,
+                                                            tmp_path):
+        c = TieredReadCache(mem_bytes=1 << 20, directory=str(tmp_path),
+                            disk_bytes=1 << 20, unit_size=1024)
+        small, medium, large = b"s" * 512, b"m" * 2048, b"l" * 8192
+        c.put("1,s", small)
+        c.put("1,m", medium)
+        c.put("1,l", large)
+        # small rides RAM and layer 0; medium/large are disk-only
+        assert c.layers[0].get("1,s") == small
+        assert c.layers[1].get("1,m") == medium
+        assert c.layers[2].get("1,l") == large
+        assert c.get("1,s") == small
+        assert c.tier_hits["ram"] == 1
+        # drop RAM: every class must still be servable from disk
+        c.mem.clear()
+        assert c.get("1,s") == small
+        assert c.get("1,m") == medium
+        assert c.get("1,l") == large
+        assert c.tier_hits["disk"] == 3
+        snap = c.stats_snapshot()
+        assert snap["hits"] == 4 and snap["misses"] == 0
+        assert snap["resident_bytes"]["disk"] > 0
+        c.close()
+
+    def test_disk_oversize_drop_counted(self, tmp_path):
+        before = stats.ChunkCacheOversizeDropsCounter._values.get((), 0.0)
+        layer = OnDiskCacheLayer(str(tmp_path), "c9", total_bytes=4096,
+                                 segments=2)
+        try:
+            layer.put("1,big", b"x" * 4096)  # > one 2048-byte segment
+            assert layer.oversize_drops == 1
+            assert layer.get("1,big") is None
+            after = stats.ChunkCacheOversizeDropsCounter._values.get(
+                (), 0.0)
+            assert after == before + 1
+        finally:
+            layer.close()
+
+    def test_miss_and_invalidate_accounting(self, tmp_path):
+        c = TieredReadCache(mem_bytes=1 << 20, directory=str(tmp_path),
+                            disk_bytes=1 << 20, unit_size=1024)
+        assert c.get("7,nope") is None
+        assert c.misses == 1
+        c.put("7,a", b"a" * 100)
+        c.put("7,b", b"b" * 4000)
+        c.put("8,c", b"c" * 100)
+        c.invalidate("7,a", reason="delete")
+        assert c.get("7,a") is None
+        assert c.invalidate_volume(7, reason="vacuum") >= 1
+        assert c.get("7,b") is None
+        assert c.get("8,c") == b"c" * 100
+        c.close()
+
+
+class TestQosAdmission:
+    def test_background_reads_do_not_fill(self):
+        from seaweedfs_tpu import qos
+
+        c = TieredReadCache(mem_bytes=1 << 20)
+        with qos.qos_scope(qos.BACKGROUND):
+            c.put("1,bg", b"scrub-traffic")
+        assert c.get("1,bg") is None
+        assert c.fills == {"admitted": 0, "qos_bypass": 1}
+        # foreground classes fill normally
+        with qos.qos_scope(qos.INTERACTIVE):
+            c.put("1,fg", b"user-traffic")
+        assert c.get("1,fg") == b"user-traffic"
+        assert c.fills["admitted"] == 1
+        c.close()
+
+    def test_bg_fill_knob_overrides_bypass(self, monkeypatch):
+        from seaweedfs_tpu import qos
+
+        monkeypatch.setenv("WEED_READ_CACHE_BG_FILL", "1")
+        c = TieredReadCache(mem_bytes=1 << 20)
+        with qos.qos_scope(qos.BACKGROUND):
+            c.put("1,bg", b"warm-me-anyway")
+        assert c.get("1,bg") == b"warm-me-anyway"
+        assert c.fills == {"admitted": 1, "qos_bypass": 0}
+        c.close()
+
+
+class TestHbmTier:
+    def test_promotion_after_repeat_hits_byte_identical(self):
+        pytest.importorskip("jax")
+        c = TieredReadCache(mem_bytes=1 << 20, hbm_bytes=1 << 20)
+        if c.hbm is None:
+            pytest.skip("device pool unavailable")
+        payload = bytes(range(256)) * 16
+        c.put("5,hot", payload)
+        assert c.get("5,hot") == payload  # heat 1
+        assert c.get("5,hot") == payload  # heat 2 -> promoted
+        assert len(c.hbm._keys) == 1
+        # drop the RAM copy: the next hit must come back from HBM,
+        # byte-identical after the device round trip
+        c.mem.clear()
+        assert c.get("5,hot") == payload
+        assert c.tier_hits["hbm"] == 1
+        snap = c.stats_snapshot()
+        assert snap["resident_bytes"]["hbm"] == len(payload)
+        c.invalidate("5,hot")
+        assert len(c.hbm._keys) == 0
+        c.close()
+
+
+class TestEvictionRace:
+    def test_concurrent_readers_during_eviction(self):
+        """Readers racing a writer that continuously forces LRU
+        eviction must only ever observe byte-identical payloads or
+        clean misses — never tearing, KeyErrors, or deadlock."""
+        c = TieredReadCache(mem_bytes=64 * 100)  # ~64 live entries
+        payload_of = lambda i: (b"%06d" % i) * 16  # noqa: E731
+        nkeys = 512
+        stop = threading.Event()
+        errors = []
+
+        def reader(seed):
+            i = seed
+            while not stop.is_set():
+                i = (i * 1103515245 + 12345) % nkeys
+                got = c.get(f"1,{i:x}")
+                if got is not None and got != payload_of(i):
+                    errors.append((i, got))
+                    return
+
+        readers = [threading.Thread(target=reader, args=(s,))
+                   for s in range(4)]
+        for t in readers:
+            t.start()
+        try:
+            for round_ in range(4):
+                for i in range(nkeys):
+                    c.put(f"1,{i:x}", payload_of(i))
+        finally:
+            stop.set()
+            for t in readers:
+                t.join(10.0)
+        assert not errors, errors[:3]
+        assert all(not t.is_alive() for t in readers)
+        assert c.mem.size_bytes <= 64 * 100
+        c.close()
+
+
+@pytest.fixture
+def vstack(tmp_path):
+    from seaweedfs_tpu.master.server import MasterServer
+    from seaweedfs_tpu.volume_server.server import VolumeServer
+
+    master = MasterServer(port=0, pulse_seconds=0.2)
+    master.start()
+    d = tmp_path / "vs0"
+    d.mkdir()
+    vs = VolumeServer([str(d)], master.address, port=0,
+                      pulse_seconds=0.2)
+    vs.start()
+    vs.heartbeat_once()
+    yield master, vs
+    vs.stop()
+    master.stop()
+
+
+def _write_and_warm(master, vs, payload):
+    """Store one object, read it twice (fill + hit), and return
+    (fid, cache_key) with the entry resident in the needle cache."""
+    from seaweedfs_tpu.rpc.http_rpc import call
+
+    a = call(master.address, "/dir/assign")
+    fid = a["fid"]
+    call(vs.address, f"/{fid}", raw=payload, method="POST")
+    assert call(vs.address, f"/{fid}") == payload  # miss + fill
+    assert call(vs.address, f"/{fid}") == payload  # cache hit
+    keys = [k for k in vs.read_cache.mem._data]
+    assert len(keys) >= 1
+    key = [k for k in keys
+           if k.startswith(f"{fid.split(',')[0]},")][-1]
+    return fid, key
+
+
+def _poison(vs, key, fake_body):
+    """Replace the cached needle's body in place.  Offset/size stay
+    valid, so the hit-time needle-map probe cannot catch it — only an
+    explicit invalidation can."""
+    import copy
+
+    tup = vs.read_cache.mem.get(key)
+    assert tup is not None, "entry not resident"
+    n, off, size = tup
+    n2 = copy.copy(n)
+    n2.data = fake_body
+    vs.read_cache.mem.put(key, (n2, off, size), nbytes=len(fake_body))
+
+
+class TestVolumeServerInvalidation:
+    def test_overwrite_drops_stale_entry(self, vstack):
+        from seaweedfs_tpu.rpc.http_rpc import call
+
+        master, vs = vstack
+        fid, key = _write_and_warm(master, vs, b"version-one")
+        _poison(vs, key, b"poisoned-v1")
+        assert call(vs.address, f"/{fid}") == b"poisoned-v1"
+        call(vs.address, f"/{fid}", raw=b"version-two!", method="POST")
+        assert call(vs.address, f"/{fid}") == b"version-two!"
+
+    def test_delete_drops_entry_then_404(self, vstack):
+        from seaweedfs_tpu.rpc.http_rpc import RpcError, call
+
+        master, vs = vstack
+        fid, key = _write_and_warm(master, vs, b"to-be-deleted")
+        call(vs.address, f"/{fid}", method="DELETE")
+        assert vs.read_cache.mem.get(key) is None
+        with pytest.raises(RpcError) as ei:
+            call(vs.address, f"/{fid}")
+        assert ei.value.status == 404
+
+    def test_vacuum_commit_drops_whole_volume(self, vstack):
+        from seaweedfs_tpu.rpc.http_rpc import call
+
+        master, vs = vstack
+        # garbage so the compaction actually rewrites offsets
+        g = call(master.address, "/dir/assign")
+        call(vs.address, f"/{g['fid']}", raw=b"garbage" * 64,
+             method="POST")
+        call(vs.address, f"/{g['fid']}", method="DELETE")
+        payload = b"survivor-bytes" * 32
+        fid, key = _write_and_warm(master, vs, payload)
+        _poison(vs, key, b"X" * len(payload))
+        vid = int(fid.split(",")[0])
+        call(vs.address, "/admin/vacuum/compact", {"volume": vid})
+        call(vs.address, "/admin/vacuum/commit", {"volume": vid})
+        assert vs.read_cache.mem.get(key) is None
+        assert call(vs.address, f"/{fid}") == payload
+
+    def test_ec_rebuild_drops_whole_volume(self, vstack):
+        from seaweedfs_tpu.rpc.http_rpc import call
+
+        master, vs = vstack
+        payload = os.urandom(2048)
+        fid, key = _write_and_warm(master, vs, payload)
+        _poison(vs, key, b"Y" * len(payload))
+        vid = int(fid.split(",")[0])
+        call(vs.address, "/admin/ec/generate",
+             {"volume": vid, "collection": ""}, timeout=300)
+        call(vs.address, "/admin/ec/rebuild",
+             {"volume": vid, "collection": ""}, timeout=300)
+        assert vs.read_cache.mem.get(key) is None
+        assert call(vs.address, f"/{fid}") == payload
