@@ -1545,6 +1545,252 @@ def bench_small_file_secured(num_files: int) -> tuple[float, float]:
         shutil.rmtree(workdir, ignore_errors=True)
 
 
+def _cleanup_scale_workdirs():
+    """Sweep leftover weed-scale-* workdirs: scale.up subprocess spawns
+    make one per job, and a killed bench must not leak them."""
+    import glob
+    import tempfile
+
+    base = os.environ.get("WEED_SCALE_DIR") or tempfile.gettempdir()
+    for d in glob.glob(os.path.join(base, "weed-scale-*")):
+        shutil.rmtree(d, ignore_errors=True)
+
+
+def bench_cluster_scale(counts: tuple = (1, 4, 16),
+                        num_objects: int = 300,
+                        rate_rps: float = 400.0,
+                        duration_s: float = 3.0) -> dict:
+    """Throughput/latency scale curve over volume-server count: the
+    same seeded zipfian replay (loadgen) runs closed-loop against a
+    mini-cluster at each VS count, reporting rps and p99 per point.
+    On the 1-core CI harness the absolute multipliers are meaningless
+    (all servers share one core), so `gated` marks whether the host
+    had >= 2 cores — the acceptance gate only applies when it did."""
+    import tempfile
+
+    from seaweedfs_tpu import loadgen
+    from seaweedfs_tpu.master.server import MasterServer
+    from seaweedfs_tpu.rpc import policy as _policy
+    from seaweedfs_tpu.rpc.http_rpc import RpcError, call
+    from seaweedfs_tpu.volume_server.server import VolumeServer
+
+    cores = len(os.sched_getaffinity(0))
+    schedule = loadgen.build_schedule(
+        duration_s=duration_s, rate_rps=rate_rps,
+        n_objects=num_objects, write_ratio=0.0)
+    payload = b"s" * 2048
+    curve: dict = {}
+    for n_servers in counts:
+        _policy.reset_state()
+        workdir = tempfile.mkdtemp(prefix="swbench_scale_")
+        master = MasterServer(port=0, pulse_seconds=1.0,
+                              volume_size_limit_mb=1024,
+                              maintenance_interval=3600.0)
+        master.start()
+        servers = []
+        try:
+            for i in range(n_servers):
+                d = os.path.join(workdir, f"vs{i}")
+                os.makedirs(d)
+                vs = VolumeServer([d], master.address, port=0,
+                                  pulse_seconds=1.0,
+                                  max_volume_counts=[16])
+                vs.start()
+                vs.heartbeat_once()
+                servers.append(vs)
+            urls: list = [None] * num_objects
+            for i in range(num_objects):
+                a = call(master.address, "/dir/assign", timeout=30)
+                call(a["url"], f"/{a['fid']}", raw=payload,
+                     method="POST", timeout=30)
+                urls[i] = (a["url"], a["fid"])
+            for vs in servers:
+                vs.heartbeat_once()
+
+            def send(req):
+                url, fid = urls[req.obj % num_objects]
+                try:
+                    call(url, f"/{fid}", timeout=30)
+                except RpcError as e:
+                    if e.status != 503:
+                        raise
+                    time.sleep(0.05)
+                    call(url, f"/{fid}", timeout=30)
+                return True
+
+            out = loadgen.replay(schedule, send, workers=8,
+                                 open_loop=False)
+            curve[str(n_servers)] = {
+                "rps": out["rps"], "p99_ms": out["p99_ms"],
+                "p50_ms": out["p50_ms"],
+                "failures": out["failures"]}
+        finally:
+            for vs in servers:
+                vs.stop()
+            master.stop()
+            shutil.rmtree(workdir, ignore_errors=True)
+    base = curve.get(str(counts[0]), {}).get("rps", 0.0)
+    speedups = {f"speedup_{n}x": (round(curve[str(n)]["rps"] / base, 2)
+                                  if base and str(n) in curve else 0.0)
+                for n in counts[1:]}
+    _cleanup_scale_workdirs()
+    return {"counts": curve, **speedups,
+            "requests": len(schedule),
+            "seed": loadgen.load_seed(),
+            "gated": cores >= 2, "host_cores": cores}
+
+
+def bench_elasticity(num_objects: int = 150,
+                     steady_reqs: int = 400,
+                     recover_timeout: float = 45.0) -> dict:
+    """Time-to-recover-p99 after a load spike: a 1-VS cluster serves a
+    steady replay (baseline p99), then a storm drives admission-gate
+    occupancy past WEED_SCALE_UP_OCC; the curator's autoscale detector
+    enqueues scale.up, the worker spawns a second server through the
+    in-process seam, the follow-up balance job re-shards volumes onto
+    it, and the probe loop reports how long until windowed p99 drops
+    back under 2x the steady baseline."""
+    import tempfile
+    import threading
+
+    from seaweedfs_tpu import loadgen
+    from seaweedfs_tpu.master.server import MasterServer
+    from seaweedfs_tpu.rpc.http_rpc import RpcError, call
+    from seaweedfs_tpu.volume_server.server import VolumeServer
+
+    overrides = {"WEED_SCALE": "1", "WEED_SCALE_UP_OCC": "0.3",
+                 "WEED_SCALE_UP_RPS": "500",
+                 "WEED_QOS_VS_LIMIT": "8"}
+    saved = {k: os.environ.get(k) for k in overrides}
+    os.environ.update(overrides)
+    workdir = tempfile.mkdtemp(prefix="swbench_elastic_")
+    master = MasterServer(port=0, pulse_seconds=0.5,
+                          volume_size_limit_mb=1024,
+                          maintenance_interval=3600.0)
+    master.start()
+    vs = VolumeServer([os.path.join(workdir, "vs0")], master.address,
+                      port=0, pulse_seconds=0.5, max_volume_counts=[16])
+    os.makedirs(os.path.join(workdir, "vs0"), exist_ok=True)
+    spawned: list = []
+
+    def spawn(job):
+        d = os.path.join(workdir, f"spawn{len(spawned)}")
+        os.makedirs(d, exist_ok=True)
+        nv = VolumeServer([d], master.address, port=0,
+                          pulse_seconds=0.5, max_volume_counts=[16])
+        nv.start()
+        nv.heartbeat_once()
+        spawned.append(nv)
+        return nv.store.url
+
+    vs.spawn_volume_server = spawn
+    payload = b"e" * 2048
+    try:
+        vs.start()
+        vs.heartbeat_once()
+        fids = []
+        for _ in range(num_objects):
+            a = call(master.address, "/dir/assign", timeout=30)
+            call(a["url"], f"/{a['fid']}", raw=payload,
+                 method="POST", timeout=30)
+            fids.append(a["fid"])
+        vs.heartbeat_once()
+        locations: dict = {}
+        loc_lock = threading.Lock()
+
+        def lookup(fid: str, fresh: bool = False) -> str:
+            vid = fid.split(",")[0]
+            with loc_lock:
+                if not fresh and vid in locations:
+                    return locations[vid]
+            looked = call(master.address,
+                          f"/dir/lookup?volumeId={vid}", timeout=10)
+            locs = looked.get("locations") or []
+            url = locs[hash(fid) % len(locs)]["url"] if locs else ""
+            with loc_lock:
+                locations[vid] = url
+            return url
+
+        def get(fid: str):
+            try:
+                call(lookup(fid), f"/{fid}", timeout=30)
+            except RpcError:
+                call(lookup(fid, fresh=True), f"/{fid}", timeout=30)
+
+        def probe(reqs: int, workers: int = 4) -> float:
+            """Closed-loop GET storm; returns p99 seconds."""
+            sched = [loadgen.Request(
+                t=0.0, op="GET", obj=i, size=len(payload),
+                tenant="bench", qos_class="interactive")
+                for i in range(reqs)]
+            out = loadgen.replay(
+                sched, lambda r: (get(fids[r.obj % len(fids)]), True)[1],
+                workers=workers, open_loop=False)
+            return out["p99_ms"] / 1e3
+
+        steady_p99 = probe(steady_reqs)
+        bound = max(2.0 * steady_p99, steady_p99 + 0.25)
+
+        storm_stop = threading.Event()
+
+        def storm_loop():
+            i = 0
+            while not storm_stop.is_set():
+                try:
+                    get(fids[i % len(fids)])
+                except Exception:
+                    pass
+                i += 1
+
+        storm = [threading.Thread(target=storm_loop, daemon=True)
+                 for _ in range(16)]
+        t_spike = time.monotonic()
+        for t in storm:
+            t.start()
+        spike_p99 = 0.0
+        recover_seconds = -1.0
+        scale_ticks = 0
+        try:
+            spike_p99 = probe(100, workers=2)
+            deadline = time.monotonic() + recover_timeout
+            while time.monotonic() < deadline:
+                vs.heartbeat_once()
+                for nv in spawned:
+                    nv.heartbeat_once()
+                master.curator.tick()
+                vs.maintenance_worker.poll_once()
+                scale_ticks += 1
+                with loc_lock:
+                    locations.clear()  # re-shard moves volumes
+                p99 = probe(100, workers=2)
+                if spawned and p99 <= bound:
+                    recover_seconds = time.monotonic() - t_spike
+                    break
+        finally:
+            storm_stop.set()
+            for t in storm:
+                t.join(timeout=5)
+        return {"steady_p99_ms": round(steady_p99 * 1e3, 3),
+                "spike_p99_ms": round(spike_p99 * 1e3, 3),
+                "bound_ms": round(bound * 1e3, 3),
+                "recover_seconds": round(recover_seconds, 2),
+                "recovered": recover_seconds >= 0,
+                "scaled_to": 1 + len(spawned),
+                "control_ticks": scale_ticks}
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        for nv in spawned:
+            nv.stop()
+        vs.stop()
+        master.stop()
+        shutil.rmtree(workdir, ignore_errors=True)
+        _cleanup_scale_workdirs()
+
+
 def main():
     # never hang on a wedged TPU transport: probe device init in a
     # subprocess first; on timeout pin the CPU backend (env alone is not
@@ -1777,6 +2023,20 @@ def main():
     except Exception as e:
         print(f"note: read cache bench failed: {e}", file=sys.stderr)
 
+    # -- elasticity: rps/p99 scale curve + spike-recovery time ---------------
+    cluster_scale_stats: dict = {}
+    try:
+        _policy.reset_state()
+        cluster_scale_stats = bench_cluster_scale()
+    except Exception as e:
+        print(f"note: cluster scale bench failed: {e}", file=sys.stderr)
+    elasticity_stats: dict = {}
+    try:
+        _policy.reset_state()
+        elasticity_stats = bench_elasticity()
+    except Exception as e:
+        print(f"note: elasticity bench failed: {e}", file=sys.stderr)
+
     vs_baseline = hbm_fused / cpu_kernel if cpu_kernel > 0 else 0.0
     from seaweedfs_tpu.util.platform import available_cpu_count
 
@@ -1852,6 +2112,8 @@ def main():
             if s3_stats.get("filer_get_rps") else 0.0),
         "gateway_stages": s3_stats.get("gateway_stages", {}),
         "read_cache": read_cache_stats,
+        "cluster_scale": cluster_scale_stats,
+        "elasticity": elasticity_stats,
         "smallfile_secured_vs_plain_write": (
             round(sec_write_rps / sf_write_rps, 2) if sf_write_rps
             else 0.0),
@@ -1871,8 +2133,13 @@ if __name__ == "__main__":
     # prints its JSON alone — the full suite stays the no-argument default
     _phases = {"ec_rebuild": bench_ec_rebuild,
                "master_failover": bench_master_failover,
-               "read_cache": bench_read_cache}
+               "read_cache": bench_read_cache,
+               "cluster_scale": bench_cluster_scale,
+               "elasticity": bench_elasticity}
     if len(sys.argv) > 1:
+        if sys.argv[1] in ("--list", "-l"):
+            print("\n".join(sorted(_phases)))
+            sys.exit(0)
         if sys.argv[1] not in _phases:
             sys.exit(f"unknown bench phase {sys.argv[1]!r}; "
                      f"one of: {', '.join(sorted(_phases))}")

@@ -20,7 +20,8 @@ from typing import Optional
 
 from ..util import glog
 from . import detectors
-from .jobs import JOB_TYPES, LEASED, TYPE_DEEP_SCRUB, TYPE_EC_REBUILD, Job
+from .jobs import (JOB_TYPES, LEASED, TYPE_BALANCE, TYPE_DEEP_SCRUB,
+                   TYPE_EC_REBUILD, TYPE_SCALE_DRAIN, TYPE_SCALE_UP, Job)
 from .queue import JobQueue
 
 
@@ -215,6 +216,12 @@ class Curator:
             if jid is not None:
                 ids.append(jid)
                 self.enqueued += 1
+                if spec["type"] in (TYPE_SCALE_UP, TYPE_SCALE_DRAIN):
+                    from ..stats import metrics as stats
+
+                    action = ("up" if spec["type"] == TYPE_SCALE_UP
+                              else "drain")
+                    stats.ScaleEventsCounter.labels(action).inc()
         return ids
 
     # -- completion hook -----------------------------------------------------
@@ -232,6 +239,14 @@ class Curator:
                     {"from": "deep.scrub",
                      "corrupt": report.get("corrupt", []),
                      "missing": report.get("missing", [])})
+        if job.type == TYPE_SCALE_UP:
+            # the newcomer joins empty: immediately re-shard hot
+            # collections onto it under live traffic (the balance
+            # worker runs as background QoS, so interactive isolation
+            # bounds hold during the move)
+            self.queue.enqueue(
+                TYPE_BALANCE, 0, "",
+                {"from": "scale.up", "kinds": ["ec", "volume"]})
 
     # -- admin surface -------------------------------------------------------
     def status(self) -> dict:
@@ -239,6 +254,14 @@ class Curator:
                 "leader": bool(self.master.raft.is_leader),
                 "interval": self.interval,
                 "scans": self.scans, "enqueued": self.enqueued,
+                "autoscale": {
+                    "enabled": os.environ.get("WEED_SCALE", "0")
+                    not in ("0", "", "false", "no"),
+                    "up_occupancy": _env_float("WEED_SCALE_UP_OCC", 0.75),
+                    "drain_occupancy": _env_float(
+                        "WEED_SCALE_DRAIN_OCC", 0.15),
+                    "min_nodes": int(_env_float(
+                        "WEED_SCALE_MIN_NODES", 1))},
                 "queue": self.queue.stats(),
                 "last_scrub": {str(k): round(v, 3)
                                for k, v in self.last_scrub.items()}}

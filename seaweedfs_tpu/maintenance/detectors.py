@@ -15,7 +15,8 @@ from typing import Optional
 
 from ..storage.erasure_coding import TOTAL_SHARDS_COUNT
 from .jobs import (TYPE_BALANCE, TYPE_DEEP_SCRUB, TYPE_EC_REBUILD,
-                   TYPE_FIX_REPLICATION, TYPE_VACUUM)
+                   TYPE_FIX_REPLICATION, TYPE_SCALE_DRAIN,
+                   TYPE_SCALE_UP, TYPE_VACUUM)
 
 
 def _env_float(name: str, default: float) -> float:
@@ -29,12 +30,27 @@ def snapshot(topo) -> dict:
     """Flatten a master Topology into the dict `scan()` consumes."""
     volumes: dict[int, dict] = {}
     node_ec: dict[str, int] = {}
+    node_volumes: dict[str, int] = {}
+    nodes: list[dict] = []
     with topo.lock:
         for dc in topo.dcs.values():
             for rack in dc.racks.values():
                 for node in rack.nodes.values():
                     node_ec[node.url] = sum(
                         b.count() for b in node.ec_shards.values())
+                    node_volumes[node.url] = len(node.volumes)
+                    tele = getattr(node, "telemetry", None) or {}
+                    nodes.append({
+                        "url": node.url,
+                        "volumes": len(node.volumes),
+                        "ec_shards": node_ec[node.url],
+                        "occupancy": float(tele.get("occupancy", 0.0)),
+                        "rps": float(tele.get("rps", 0.0)),
+                        "mbps": float(tele.get("mbps", 0.0)),
+                        "draining": bool(tele.get("draining", False)),
+                        "free": max(0, node.max_volume_count
+                                    - len(node.volumes)),
+                    })
                     for v in node.volumes.values():
                         agg = volumes.setdefault(v.id, {
                             "id": v.id, "collection": v.collection,
@@ -54,14 +70,20 @@ def snapshot(topo) -> dict:
               for vid, shard_map in topo.ec_shard_map.items()]
     return {"volumes": sorted(volumes.values(), key=lambda v: v["id"]),
             "ec": sorted(ec, key=lambda e: e["id"]),
-            "node_ec_shards": node_ec}
+            "node_ec_shards": node_ec,
+            "node_volumes": node_volumes,
+            "nodes": sorted(nodes, key=lambda n: n["url"])}
 
 
 def scan(snap: dict, now: float, last_scrub: dict,
          garbage_threshold: float = 0.3,
          scrub_interval: Optional[float] = None,
          balance_skew: Optional[int] = None,
-         vacuum_enabled: bool = True) -> list[dict]:
+         vacuum_enabled: bool = True,
+         scale_enabled: Optional[bool] = None,
+         scale_up_occ: Optional[float] = None,
+         scale_drain_occ: Optional[float] = None,
+         scale_min_nodes: Optional[int] = None) -> list[dict]:
     """All detectors over one snapshot -> job specs
     ({type, volume, collection, params}), urgent first."""
     if scrub_interval is None:
@@ -117,10 +139,88 @@ def scan(snap: dict, now: float, last_scrub: dict,
             specs.append({"type": TYPE_DEEP_SCRUB, "volume": e["id"],
                           "collection": e["collection"], "params": {}})
 
-    # EC placement skew -> balance
-    counts = list(snap.get("node_ec_shards", {}).values())
-    if len(counts) >= 2 and max(counts) - min(counts) > balance_skew:
+    # placement skew -> balance.  Both populations count: EC
+    # shard-count spread AND plain-volume count spread (the original
+    # detector only watched EC shards, so a cluster whose plain
+    # volumes all landed on one server never rebalanced).
+    kinds = []
+    skew = 0
+    ec_counts = list(snap.get("node_ec_shards", {}).values())
+    if len(ec_counts) >= 2:
+        ec_skew = max(ec_counts) - min(ec_counts)
+        if ec_skew > balance_skew:
+            kinds.append("ec")
+            skew = max(skew, ec_skew)
+    vol_counts = list(snap.get("node_volumes", {}).values())
+    if len(vol_counts) >= 2:
+        vol_skew = max(vol_counts) - min(vol_counts)
+        if vol_skew > balance_skew:
+            kinds.append("volume")
+            skew = max(skew, vol_skew)
+    if kinds:
         specs.append({"type": TYPE_BALANCE, "volume": 0,
                       "collection": "",
-                      "params": {"skew": max(counts) - min(counts)}})
+                      "params": {"skew": skew,
+                                 "kinds": sorted(kinds)}})
+
+    specs.extend(scan_scale(snap, scale_enabled=scale_enabled,
+                            scale_up_occ=scale_up_occ,
+                            scale_drain_occ=scale_drain_occ,
+                            scale_min_nodes=scale_min_nodes))
     return specs
+
+
+def scan_scale(snap: dict, scale_enabled: Optional[bool] = None,
+               scale_up_occ: Optional[float] = None,
+               scale_drain_occ: Optional[float] = None,
+               scale_min_nodes: Optional[int] = None,
+               scale_up_rps: Optional[float] = None,
+               scale_drain_rps: Optional[float] = None) -> list[dict]:
+    """Autoscaler detectors over per-node telemetry.
+
+    Opt-in via WEED_SCALE=1 (capacity changes must never surprise a
+    cluster that didn't ask for them).  Scale UP when either pressure
+    signal trips fleet-wide: peak admission-gate occupancy above
+    WEED_SCALE_UP_OCC (clients queueing), or mean per-node rps above
+    WEED_SCALE_UP_RPS (0 disables the rps trigger).  Scale DOWN when
+    every node idles below WEED_SCALE_DRAIN_OCC *and* mean rps is
+    under WEED_SCALE_DRAIN_RPS, with spare nodes beyond
+    WEED_SCALE_MIN_NODES -> drain the emptiest server (fewest
+    volumes + shards, so the evacuation moves the least data)."""
+    if scale_enabled is None:
+        scale_enabled = os.environ.get("WEED_SCALE", "0") not in (
+            "0", "", "false", "no")
+    if not scale_enabled:
+        return []
+    if scale_up_occ is None:
+        scale_up_occ = _env_float("WEED_SCALE_UP_OCC", 0.75)
+    if scale_drain_occ is None:
+        scale_drain_occ = _env_float("WEED_SCALE_DRAIN_OCC", 0.15)
+    if scale_min_nodes is None:
+        scale_min_nodes = int(_env_float("WEED_SCALE_MIN_NODES", 1))
+    if scale_up_rps is None:
+        scale_up_rps = _env_float("WEED_SCALE_UP_RPS", 0.0)
+    if scale_drain_rps is None:
+        scale_drain_rps = _env_float("WEED_SCALE_DRAIN_RPS", 1.0)
+    nodes = [n for n in snap.get("nodes", []) if not n["draining"]]
+    if not nodes:
+        return []
+    occs = [n["occupancy"] for n in nodes]
+    mean_occ = sum(occs) / len(occs)
+    mean_rps = sum(n["rps"] for n in nodes) / len(nodes)
+    if mean_occ > scale_up_occ \
+            or (scale_up_rps > 0 and mean_rps > scale_up_rps):
+        return [{"type": TYPE_SCALE_UP, "volume": 0, "collection": "",
+                 "params": {"occupancy": round(mean_occ, 4),
+                            "rps": round(mean_rps, 1),
+                            "nodes": len(nodes)}}]
+    if len(nodes) > scale_min_nodes and max(occs) < scale_drain_occ \
+            and mean_rps < scale_drain_rps:
+        victim = min(nodes, key=lambda n: (n["volumes"] + n["ec_shards"],
+                                           n["url"]))
+        return [{"type": TYPE_SCALE_DRAIN, "volume": 0,
+                 "collection": "",
+                 "params": {"server": victim["url"],
+                            "occupancy": round(max(occs), 4),
+                            "rps": round(mean_rps, 1)}}]
+    return []

@@ -14,12 +14,16 @@ from dataclasses import dataclass, field
 # Job types in repair-urgency order: a lost/corrupt EC shard burns
 # durability margin, so it outranks replica fixes, which outrank
 # space reclaim, which outranks the background integrity sweep and
-# cosmetic placement moves.
+# cosmetic placement moves.  Elasticity jobs (the autoscaler's
+# scale.up / scale.drain) come last: capacity changes are never more
+# urgent than durability repairs.
 TYPE_EC_REBUILD = "ec.rebuild"
 TYPE_FIX_REPLICATION = "fix.replication"
 TYPE_VACUUM = "vacuum"
 TYPE_DEEP_SCRUB = "deep.scrub"
 TYPE_BALANCE = "balance"
+TYPE_SCALE_UP = "scale.up"
+TYPE_SCALE_DRAIN = "scale.drain"
 
 PRIORITIES = {
     TYPE_EC_REBUILD: 0,
@@ -27,6 +31,8 @@ PRIORITIES = {
     TYPE_VACUUM: 2,
     TYPE_DEEP_SCRUB: 3,
     TYPE_BALANCE: 4,
+    TYPE_SCALE_UP: 5,
+    TYPE_SCALE_DRAIN: 6,
 }
 JOB_TYPES = tuple(PRIORITIES)
 

@@ -21,7 +21,8 @@ from ..stats import metrics
 from ..storage.erasure_coding import TOTAL_SHARDS_COUNT
 from ..util import glog
 from .jobs import (TYPE_BALANCE, TYPE_DEEP_SCRUB, TYPE_EC_REBUILD,
-                   TYPE_FIX_REPLICATION, TYPE_VACUUM)
+                   TYPE_FIX_REPLICATION, TYPE_SCALE_DRAIN,
+                   TYPE_SCALE_UP, TYPE_VACUUM)
 from .pacer import BytePacer
 
 
@@ -169,7 +170,9 @@ class MaintenanceWorker:
               TYPE_FIX_REPLICATION: self._exec_fix_replication,
               TYPE_VACUUM: self._exec_vacuum,
               TYPE_DEEP_SCRUB: self._exec_deep_scrub,
-              TYPE_BALANCE: self._exec_balance}.get(job["type"])
+              TYPE_BALANCE: self._exec_balance,
+              TYPE_SCALE_UP: self._exec_scale_up,
+              TYPE_SCALE_DRAIN: self._exec_scale_drain}.get(job["type"])
         if fn is None:
             raise ValueError(f"unknown job type {job['type']!r}")
         return fn(job)
@@ -269,7 +272,81 @@ class MaintenanceWorker:
         return report
 
     def _exec_balance(self, job: dict) -> dict:
+        """Rebalance whichever populations the detector flagged
+        (params["kinds"]): EC shards, plain volumes, or both."""
         from ..shell import commands as sh
+        from ..shell import commands_volume as vol
 
-        moves = sh.ec_balance(self._shell_env())
-        return {"moves": moves}
+        kinds = job.get("params", {}).get("kinds") or ["ec"]
+        report: dict = {}
+        if "ec" in kinds:
+            report["ec_moves"] = sh.ec_balance(self._shell_env())
+        if "volume" in kinds:
+            report["volume_moves"] = vol.volume_balance(self._shell_env())
+        return report
+
+    # -- elasticity executors ------------------------------------------------
+    def _exec_scale_up(self, job: dict) -> dict:
+        """Grow the cluster by one volume server.  In-process when the
+        host installed a spawn seam (tests / bench on the 1-core
+        harness); otherwise fork a `weed.py volume` subprocess and wait
+        until the master's topology shows the newcomer."""
+        spawn = getattr(self.server, "spawn_volume_server", None)
+        if callable(spawn):
+            url = spawn(job)
+            return {"spawned": url, "mode": "in-process"}
+        import subprocess
+        import sys
+        import tempfile
+
+        base = os.environ.get("WEED_SCALE_DIR") or tempfile.gettempdir()
+        workdir = tempfile.mkdtemp(prefix="weed-scale-", dir=base)
+        weed = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))), "weed.py")
+        before = self._cluster_node_count()
+        proc = subprocess.Popen(
+            [sys.executable, weed, "volume", "-dir", workdir,
+             "-mserver", self.server.master_address, "-port", "0"],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        self.server.scale_children.append(proc)
+        deadline = time.monotonic() + _env_float(
+            "WEED_SCALE_SPAWN_TIMEOUT", 90.0)
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"spawned volume server exited rc={proc.returncode}")
+            if self._cluster_node_count() > before:
+                return {"spawned": workdir, "mode": "subprocess",
+                        "nodes": before + 1}
+            time.sleep(0.5)
+        proc.terminate()
+        raise RuntimeError("spawned volume server never registered")
+
+    def _cluster_node_count(self) -> int:
+        try:
+            status = call(self.server.master_address, "/dir/status",
+                          timeout=10)
+        except (RpcError, OSError):
+            return -1
+        return sum(len(r.get("nodes", []))
+                   for dc in status.get("datacenters", [])
+                   for r in dc.get("racks", []))
+
+    def _exec_scale_drain(self, job: dict) -> dict:
+        """Graceful drain: read-only demotion, curator-paced volume and
+        EC-shard evacuation, then deregistration — all as background
+        QoS traffic, so interactive reads stay inside their isolation
+        bounds while the node empties."""
+        from ..shell import commands as sh
+        from ..shell import commands_volume as vol
+
+        server = job.get("params", {}).get("server")
+        if not server:
+            raise ValueError("scale.drain needs params.server")
+        env = self._shell_env()
+        call(server, "/admin/drain", {"draining": True}, timeout=30)
+        moves = vol.volume_server_evacuate(env, server)
+        shard_moves = sh.ec_evacuate(env, server)
+        call(server, "/admin/leave", {}, timeout=30)
+        return {"server": server, "volume_moves": moves,
+                "ec_shard_moves": shard_moves}

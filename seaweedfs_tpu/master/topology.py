@@ -52,6 +52,9 @@ class DataNode:
         self.volumes: dict[int, VolumeInfo] = {}
         self.ec_shards: dict[int, ShardBits] = {}
         self.last_seen = time.time()
+        # load telemetry from the latest heartbeat (rps / occupancy /
+        # draining), consumed by the curator's autoscale detectors
+        self.telemetry: dict = {}
 
     @property
     def url(self) -> str:
@@ -71,6 +74,10 @@ class DataNode:
             "ecShards": sum(b.count() for b in self.ec_shards.values()),
             "max": self.max_volume_count, "free": self.available_slots(),
             "dc": self.dc.id, "rack": self.rack.id,
+            "occupancy": round(
+                float(self.telemetry.get("occupancy", 0.0)), 4),
+            "rps": round(float(self.telemetry.get("rps", 0.0)), 1),
+            "draining": bool(self.telemetry.get("draining", False)),
             "volume_list": [
                 {"id": v.id, "collection": v.collection, "size": v.size,
                  "file_count": v.file_count,
@@ -200,7 +207,15 @@ class Topology:
             node.last_seen = time.time()
             node.max_volume_count = hb.get("max_volume_count",
                                            node.max_volume_count)
+            node.telemetry = hb.get("telemetry") or {}
             self.sequencer.set_max(hb.get("max_file_key", 0))
+            from ..stats import metrics as stats
+
+            stats.ScaleNodeOccupancyGauge.labels(node_id).set(
+                float(node.telemetry.get("occupancy", 0.0)))
+            stats.ScaleNodeRpsGauge.labels(node_id).set(
+                float(node.telemetry.get("rps", 0.0)))
+            stats.ScaleClusterSizeGauge.set(len(self.nodes))
 
             # full volume list replaces node state (simple full-sync model;
             # the reference also supports incremental deltas)
@@ -277,6 +292,9 @@ class Topology:
             for vid in list(node.ec_shards):
                 self._unregister_ec(vid, node)
             node.rack.nodes.pop(node_id, None)
+            from ..stats import metrics as stats
+
+            stats.ScaleClusterSizeGauge.set(len(self.nodes))
 
     def reap_dead_nodes(self, timeout: Optional[float] = None):
         timeout = timeout or self.pulse_seconds * 3

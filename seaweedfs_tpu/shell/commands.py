@@ -581,6 +581,51 @@ def ec_balance(env: CommandEnv, plan_only: bool = False) -> list[dict]:
     return moves
 
 
+def ec_evacuate(env: CommandEnv, server: str,
+                plan_only: bool = False) -> list[dict]:
+    """Move every EC shard off `server` (the shard half of a graceful
+    drain; command_volume_server_evacuate.go's EC branch).  Targets are
+    picked emptiest-first under the same never-duplicate-a-shard-id /
+    slot-budget constraints as ec.balance."""
+    nodes = collect_ec_nodes(env)
+    source = next((n for n in nodes if n.url == server), None)
+    if source is None or not source.shards:
+        return []
+    peers = [n for n in nodes if n.url != server]
+    if not peers:
+        raise RpcError(f"no peers to evacuate {server} onto", 409)
+    budget = _shard_slot_budget(peers)
+    moves: list[dict] = []
+    for vid in sorted(source.shards):
+        for sid in sorted(source.shards[vid]):
+            candidates = [n for n in peers
+                          if budget[n.url] > 0
+                          and sid not in n.shards.get(vid, [])]
+            if not candidates:
+                raise RpcError(
+                    f"no capacity to evacuate shard {vid}.{sid} "
+                    f"off {server}", 507)
+            target = min(candidates,
+                         key=lambda n: (n.shard_count(), -budget[n.url],
+                                        n.url))
+            _move_shard(moves, source, target, vid, sid)
+            budget[target.url] -= 1
+    if plan_only:
+        return moves
+    for move in moves:
+        call(move["to"], "/admin/ec/copy",
+             {"volume": move["volume"], "collection": move["collection"],
+              "shard_ids": [move["shard"]],
+              "source": move["from"], "copy_ecx_file": True}, timeout=3600)
+        call(move["to"], "/admin/ec/mount",
+             {"volume": move["volume"], "collection": move["collection"],
+              "shard_ids": [move["shard"]]})
+        call(move["from"], "/admin/ec/delete_shards",
+             {"volume": move["volume"], "collection": move["collection"],
+              "shard_ids": [move["shard"]]})
+    return moves
+
+
 # -- ec.scrub ----------------------------------------------------------------
 
 

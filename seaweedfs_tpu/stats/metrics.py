@@ -582,6 +582,29 @@ QosLaneWaitSecondsCounter = REGISTRY.counter(
     "cumulative seconds background batches waited on the foreground lane")
 
 
+# -- cluster elasticity: per-node load telemetry the autoscale
+# detectors consume, and the scale events they emit -------------------------
+ScaleNodeOccupancyGauge = REGISTRY.gauge(
+    "SeaweedFS_master_scale_node_occupancy",
+    "admission-gate occupancy ((inflight+queued)/limit) last "
+    "heartbeated by each volume server", ("node",))
+ScaleNodeRpsGauge = REGISTRY.gauge(
+    "SeaweedFS_master_scale_node_rps",
+    "object requests per second last heartbeated by each volume server",
+    ("node",))
+ScaleClusterSizeGauge = REGISTRY.gauge(
+    "SeaweedFS_master_scale_cluster_volume_servers",
+    "volume servers currently registered in the topology")
+ScaleEventsCounter = REGISTRY.counter(
+    "SeaweedFS_master_scale_events_total",
+    "autoscale jobs enqueued by the curator, by action (up|drain)",
+    ("action",))
+VolumeServerDrainingGauge = REGISTRY.gauge(
+    "SeaweedFS_volumeServer_draining",
+    "1 while this volume server is draining (read-only, being "
+    "evacuated before deregistration)")
+
+
 # -- process self-metrics (the reference's Go runtime collectors:
 # prometheus.NewGoCollector/NewProcessCollector) -----------------------------
 _PROCESS_START = time.time()

@@ -254,6 +254,20 @@ class VolumeServer:
 
         self.read_cache = TieredReadCache()
         self._stop = threading.Event()
+        # elasticity state: `draining` marks this server read-only while
+        # the curator evacuates it; the request counters feed the rps /
+        # byte-rate telemetry piggybacked on every heartbeat; children
+        # spawned by scale.up jobs are reaped in stop()
+        self.draining = False
+        self._tele_lock = threading.Lock()
+        self._req_counts = {"read": 0, "write": 0, "bytes": 0}
+        self._tele_prev = (time.monotonic(), 0, 0, 0)
+        self._occ_peak = 0.0
+        self.scale_children: list = []
+        # in-process spawn seam: tests and bench phases install a
+        # callable(job) -> url here so scale.up never forks on the
+        # 1-core CI harness; None means subprocess `weed.py volume`
+        self.spawn_volume_server = None
         # per-volume-id copy locks: concurrent copies of the SAME vid must
         # not race each other's temp files / exists-checks, but a slow copy
         # of one volume must not serialize copies of unrelated volumes
@@ -290,6 +304,13 @@ class VolumeServer:
     def stop(self):
         self._stop.set()
         self.maintenance_worker.stop()
+        for child in self.scale_children:
+            try:  # subprocess volume servers spawned by scale.up jobs
+                child.terminate()
+                child.wait(timeout=10)
+            except Exception:
+                pass
+        self.scale_children = []
         if getattr(self, "_native_owner", False) or \
                 getattr(self, "_native_jwt_owner", False) or \
                 getattr(self, "_native_listener_owner", False):
@@ -570,6 +591,7 @@ class VolumeServer:
         # vacuum commits and volume add/delete)
         self._sync_native_serving()
         hb = self.store.collect_heartbeat()
+        hb["telemetry"] = self._telemetry()
         targets = [self.master_address] + [
             m for m in self._seed_masters if m != self.master_address]
         # shared failover policy: per-master breakers skip a dead seed,
@@ -653,6 +675,7 @@ class VolumeServer:
               g(self._h_tier_download))
         s.add("POST", "/admin/remote/fetch_write",
               g(self._h_remote_fetch_write))
+        s.add("POST", "/admin/drain", g(self._h_drain))
         s.add("POST", "/admin/leave", g(self._h_leave))
         s.add("POST", "/query", self._h_query)
         s.add("GET", "/metrics", self._h_metrics)
@@ -772,6 +795,28 @@ class VolumeServer:
         self._try_heartbeat()
         return {"volume": v.id, "size": size}
 
+    def _h_drain(self, req: Request):
+        """Graceful-drain step 1 (scale.drain): demote every local
+        volume to read-only and flag the node as draining so assigns
+        stop landing here while the curator paces the evacuation.
+        ``{"draining": false}`` undoes an aborted drain."""
+        p = req.json()
+        draining = bool(p.get("draining", True))
+        self.draining = draining
+        demoted = []
+        for loc in self.store.locations:
+            with loc.lock:
+                vids = list(loc.volumes)
+            for vid in vids:
+                try:
+                    self.store.mark_volume_readonly(vid, draining)
+                    demoted.append(vid)
+                except NotFoundError:
+                    pass  # deleted between listing and demotion
+        stats.VolumeServerDrainingGauge.set(1.0 if draining else 0.0)
+        self._try_heartbeat()  # master must see read_only NOW
+        return {"draining": draining, "volumes": sorted(demoted)}
+
     def _h_leave(self, req: Request):
         """VolumeServerLeave (volume_grpc_admin.go): stop heartbeating and
         unregister from the master so assigns stop landing here; the
@@ -834,7 +879,7 @@ class VolumeServer:
                 stats.VolumeServerThrottleRejects.labels("inflight").inc()
                 raise
             try:
-                return self._handle_object_inner(method, req)
+                return self._handle_object_accounted(method, req)
             finally:
                 release()
         if not self.request_shedder.try_acquire():
@@ -843,9 +888,50 @@ class VolumeServer:
                 "too many requests: inflight limit", 503,
                 headers={"Retry-After": qos.retry_after(1, 3)})
         try:
-            return self._handle_object_inner(method, req)
+            return self._handle_object_accounted(method, req)
         finally:
             self.request_shedder.release()
+
+    def _handle_object_accounted(self, method: str, req: Request):
+        out = self._handle_object_inner(method, req)
+        body = getattr(out, "body", out)
+        n = len(body) if isinstance(body, (bytes, bytearray)) else 0
+        if method in ("POST", "PUT"):
+            n += len(req.body or b"")
+        with self._tele_lock:
+            key = "write" if method in ("POST", "PUT") else "read"
+            self._req_counts[key] += 1
+            self._req_counts["bytes"] += n
+            sample = (self._req_counts["read"]
+                      + self._req_counts["write"]) % 8 == 0
+        if sample:
+            # a heartbeat-instant occupancy read misses bursts entirely
+            # (the gate is usually idle at the sampling moment); peak
+            # occupancy observed from INSIDE requests — while this one
+            # still holds its admission — is the congestion signal
+            occ = self.qos_gate.occupancy()
+            if occ > self._occ_peak:
+                self._occ_peak = occ
+        return out
+
+    def _telemetry(self) -> dict:
+        """Per-heartbeat load sample for the curator's autoscale
+        detectors: admission-gate occupancy plus rps / byte-rate over
+        the window since the previous heartbeat."""
+        now = time.monotonic()
+        with self._tele_lock:
+            reads = self._req_counts["read"]
+            writes = self._req_counts["write"]
+            nbytes = self._req_counts["bytes"]
+            t0, rw0, _, b0 = self._tele_prev
+            self._tele_prev = (now, reads + writes, 0, nbytes)
+            peak, self._occ_peak = self._occ_peak, 0.0
+        dt = max(1e-6, now - t0)
+        return {"occupancy": round(
+                    max(peak, self.qos_gate.occupancy()), 4),
+                "rps": round((reads + writes - rw0) / dt, 2),
+                "mbps": round((nbytes - b0) / dt / float(1 << 20), 3),
+                "draining": self.draining}
 
     def _handle_object_inner(self, method: str, req: Request):
         fid = req.path.lstrip("/").replace("/", ",", 1)

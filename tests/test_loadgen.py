@@ -1,0 +1,134 @@
+"""Workload-replay engine: seeded determinism, distribution shapes,
+and the replay pool's accounting."""
+
+import threading
+
+from seaweedfs_tpu import loadgen
+from seaweedfs_tpu.loadgen.generators import _unit
+
+
+class TestDeterminism:
+    def test_same_seed_byte_identical_schedule(self):
+        """The blake2b contract: two builds from one seed produce the
+        same canonical bytes (mirrors util/faults.py replay)."""
+        kw = dict(seed=1234, duration_s=2.0, rate_rps=150.0,
+                  n_objects=500, n_tenants=100)
+        b1 = loadgen.schedule_bytes(loadgen.build_schedule(**kw))
+        b2 = loadgen.schedule_bytes(loadgen.build_schedule(**kw))
+        assert b1 == b2
+        assert b1  # non-empty
+
+    def test_different_seed_different_schedule(self):
+        kw = dict(duration_s=2.0, rate_rps=150.0, n_objects=500,
+                  n_tenants=100)
+        b1 = loadgen.schedule_bytes(loadgen.build_schedule(seed=1, **kw))
+        b2 = loadgen.schedule_bytes(loadgen.build_schedule(seed=2, **kw))
+        assert b1 != b2
+
+    def test_env_seed_default(self, monkeypatch):
+        monkeypatch.setenv("WEED_LOAD_SEED", "777")
+        assert loadgen.load_seed() == 777
+        monkeypatch.delenv("WEED_LOAD_SEED")
+        assert loadgen.load_seed() == 42
+
+    def test_unit_draw_is_pure_function(self):
+        assert _unit(9, "s", 3) == _unit(9, "s", 3)
+        assert _unit(9, "s", 3) != _unit(9, "s", 4)
+        assert 0.0 <= _unit(9, "s", 3) < 1.0
+
+
+class TestDistributions:
+    def test_zipf_head_dominates(self):
+        """s=1.1 zipf: the top 1% of objects must absorb far more than
+        1% of draws (the Haystack hot-set shape)."""
+        z = loadgen.ZipfPopularity(1000, s=1.1, seed=5)
+        draws = [z.sample(i) for i in range(5000)]
+        head = sum(1 for d in draws if d < 10)
+        assert head / len(draws) > 0.15
+        assert all(0 <= d < 1000 for d in draws)
+
+    def test_size_mixture_bounds(self):
+        sm = loadgen.SizeMixture(seed=5)
+        lo = min(l for _, l, _ in loadgen.SizeMixture.DEFAULT)
+        hi = max(h for _, _, h in loadgen.SizeMixture.DEFAULT)
+        for i in range(500):
+            s = sm.sample(i)
+            assert lo <= s <= hi
+
+    def test_poisson_arrival_count_near_rate(self):
+        arr = loadgen.poisson_arrivals(200.0, 10.0, seed=3)
+        assert 1600 < len(arr) < 2400  # ~2000 +- 4 sigma
+        assert arr == sorted(arr)
+        assert all(0 <= t < 10.0 for t in arr)
+
+    def test_tenant_mix_deterministic_and_diurnal(self):
+        m1 = loadgen.DiurnalTenantMix(50, seed=11)
+        m2 = loadgen.DiurnalTenantMix(50, seed=11)
+        picks1 = [m1.sample(t * 100.0, n) for n, t in
+                  enumerate(range(100))]
+        picks2 = [m2.sample(t * 100.0, n) for n, t in
+                  enumerate(range(100))]
+        assert picks1 == picks2
+        # weights actually swing over the diurnal period
+        w0 = m1.weight(0, 0.0)
+        w_later = m1.weight(0, 86400.0 / 2)
+        assert w0 != w_later
+
+    def test_tenant_class_split(self):
+        classes = [loadgen.tenant_class(7, t) for t in range(500)]
+        inter = classes.count("interactive") / 500
+        std = classes.count("standard") / 500
+        bg = classes.count("background") / 500
+        assert 0.08 < inter < 0.25
+        assert 0.6 < std < 0.9
+        assert 0.03 < bg < 0.2
+
+    def test_schedule_carries_qos_tenancy(self):
+        sched = loadgen.build_schedule(seed=4, duration_s=3.0,
+                                       rate_rps=300.0, n_objects=200,
+                                       n_tenants=50)
+        assert len(sched) > 500
+        assert {r.qos_class for r in sched} <= {
+            "interactive", "standard", "background"}
+        assert len({r.tenant for r in sched}) > 10
+        assert any(r.op == "PUT" for r in sched)
+        assert sum(r.op == "GET" for r in sched) > len(sched) * 0.8
+
+
+class TestReplay:
+    def test_replay_counts_and_failures(self):
+        sched = loadgen.build_schedule(seed=6, duration_s=1.0,
+                                       rate_rps=200.0, n_objects=50,
+                                       n_tenants=10)
+        fails = {"n": 0}
+        lock = threading.Lock()
+
+        def send(req):
+            if req.obj % 7 == 0:
+                with lock:
+                    fails["n"] += 1
+                raise RuntimeError("boom")
+            return True
+
+        out = loadgen.replay(sched, send, workers=4, open_loop=False)
+        assert out["requests"] + out["failures"] == len(sched)
+        assert out["failures"] == fails["n"]
+        assert out["rps"] > 0
+        assert set(out["by_class"]) == {
+            "interactive", "standard", "background"}
+
+    def test_percentile(self):
+        vals = sorted(float(i) for i in range(1, 101))
+        assert loadgen.percentile(vals, 0.5) == 50.0
+        assert loadgen.percentile(vals, 0.99) == 99.0
+        assert loadgen.percentile([], 0.99) == 0.0
+
+    def test_replay_stop_event(self):
+        sched = loadgen.build_schedule(seed=8, duration_s=30.0,
+                                       rate_rps=100.0, n_objects=20,
+                                       n_tenants=5)
+        stop = threading.Event()
+        stop.set()  # pre-stopped: open-loop replay returns immediately
+        out = loadgen.replay(sched, lambda r: True, workers=2,
+                             open_loop=True, stop=stop)
+        assert out["requests"] == 0

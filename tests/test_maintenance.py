@@ -246,8 +246,34 @@ class TestDetectors:
                                scrub_interval=86400, balance_skew=4)
         (bal,) = [s for s in specs if s["type"] == TYPE_BALANCE]
         assert bal["params"]["skew"] == 8
+        assert bal["params"]["kinds"] == ["ec"]
         calm = detectors.scan(
             self._snap(node_ec_shards={"a": 5, "b": 4}), now=0,
+            last_scrub={}, scrub_interval=86400, balance_skew=4)
+        assert not [s for s in calm if s["type"] == TYPE_BALANCE]
+
+    def test_plain_volume_skew_triggers_balance(self):
+        """The original detector only watched EC shards: a cluster
+        whose plain volumes all landed on one server never rebalanced.
+        Volume-count spread must now fire on its own."""
+        snap = self._snap(node_volumes={"a": 9, "b": 1})
+        specs = detectors.scan(snap, now=0, last_scrub={},
+                               scrub_interval=86400, balance_skew=4)
+        (bal,) = [s for s in specs if s["type"] == TYPE_BALANCE]
+        assert bal["params"]["skew"] == 8
+        assert bal["params"]["kinds"] == ["volume"]
+        # both populations skewed -> one spec naming both kinds, with
+        # the worst skew of the two
+        both = self._snap(node_ec_shards={"a": 14, "b": 0},
+                          node_volumes={"a": 7, "b": 1})
+        specs = detectors.scan(both, now=0, last_scrub={},
+                               scrub_interval=86400, balance_skew=4)
+        (bal,) = [s for s in specs if s["type"] == TYPE_BALANCE]
+        assert bal["params"]["kinds"] == ["ec", "volume"]
+        assert bal["params"]["skew"] == 14
+        # mild volume spread under the threshold stays quiet
+        calm = detectors.scan(
+            self._snap(node_volumes={"a": 5, "b": 2}), now=0,
             last_scrub={}, scrub_interval=86400, balance_skew=4)
         assert not [s for s in calm if s["type"] == TYPE_BALANCE]
 

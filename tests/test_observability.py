@@ -209,3 +209,11 @@ class TestGrafanaDashboard:
                 "_seconds_bucket",
                 "SeaweedFS_volumeServer_device_pool_hwm_bytes"):
             assert token in joined, f"no Profiling panel queries {token}"
+        # the Elasticity row queries the autoscaler families
+        for token in (
+                "SeaweedFS_master_scale_cluster_volume_servers",
+                "SeaweedFS_master_scale_node_occupancy",
+                "SeaweedFS_master_scale_node_rps",
+                "SeaweedFS_master_scale_events_total",
+                "SeaweedFS_volumeServer_draining"):
+            assert token in joined, f"no Elasticity panel queries {token}"

@@ -254,6 +254,33 @@ def test_device_scale_two_devices_beat_one(tmp_path):
         f"{curve['1']} GiB/s")
 
 
+def test_cluster_scale_curve_smoke(tmp_path):
+    """Mini bench_cluster_scale (2 points, 1 and 2 volume servers):
+    asserts the SHAPE of the elasticity curve — the seeded replay ran
+    to completion at every point with zero failed reads and real
+    latency percentiles — not an absolute speedup.  The 4x/16x
+    multiplier gate only means anything with real parallelism, so skip
+    below 2 cores (matching the bench's own `gated` flag)."""
+    import bench
+
+    try:
+        cores = len(os.sched_getaffinity(0))
+    except AttributeError:
+        cores = os.cpu_count() or 1
+    if cores < 2:
+        pytest.skip(f"scale curve needs >=2 cores, have {cores}")
+    out = bench.bench_cluster_scale(counts=(1, 2), num_objects=60,
+                                    rate_rps=150.0, duration_s=1.5)
+    assert set(out["counts"]) == {"1", "2"}
+    for point in out["counts"].values():
+        assert point["failures"] == 0
+        assert point["rps"] > 0
+        assert point["p99_ms"] >= point["p50_ms"] > 0
+    assert out["gated"] is True
+    assert out["requests"] > 100  # the Poisson schedule actually ran
+    assert out["speedup_2x"] > 0
+
+
 def test_read_cache_warm_storm_beats_cold():
     """Mini bench_read_cache (300 objects, 4 workers): the warm
     smallfile storm on the filer object-GET path — where a chunk-cache

@@ -361,6 +361,7 @@ def _shell_handlers(env):
     from seaweedfs_tpu.shell import commands_maintenance as mnt
     from seaweedfs_tpu.shell import commands_qos as qos_cmds
     from seaweedfs_tpu.shell import commands_remote as rem
+    from seaweedfs_tpu.shell import commands_scale as scale
     from seaweedfs_tpu.shell import commands_volume as vol
 
     def show(value):
@@ -471,6 +472,11 @@ def _shell_handlers(env):
         "collection.list": lambda a: show(vol.collection_list(env)),
         "collection.delete": lambda a: show(vol.collection_delete(
             env, a[0], plan_only=plan(a))),
+        # elasticity — autoscaler status + manual scale.up / scale.drain
+        "cluster.scale": lambda a: show(
+            scale.scale_up(env) if "-up" in a
+            else scale.scale_drain(env, flag(a, "drain", ""))
+            if flag(a, "drain") else scale.scale_status(env)),
         "cluster.ps": lambda a: show(vol.cluster_ps(env)),
         "cluster.check": lambda a: show(vol.cluster_check(env)),
         "cluster.raft.ps": lambda a: show(vol.cluster_raft_ps(env)),
