@@ -26,9 +26,11 @@
 // Built as a plain shared library; Python binds via ctypes (no pybind11 in
 // this image).
 
+#include <cerrno>
 #include <cstdint>
 #include <cstring>
 #include <cstddef>
+#include <unistd.h>
 
 #if defined(__x86_64__)
 #include <immintrin.h>
@@ -464,6 +466,42 @@ int sw_has_avx2() {
 #else
     return 0;
 #endif
+}
+
+// ---------------------------------------------------------------------------
+// 4. sw_inline_scatter — the inline-EC append hot path.  Scatters one
+//    logical byte range over the k data-shard logs in stripe-unit
+//    blocks (block i -> shard i%k at offset (i/k)*unit — the zero-
+//    large-row regime of storage/erasure_coding/locate.py), issuing
+//    every pwrite from C so the Python writer drops the GIL exactly
+//    once per needle instead of once per shard segment.
+//    Returns 0 on success, -errno on the first failed write.
+
+int sw_inline_scatter(const int32_t* fds, int32_t k, uint64_t unit,
+                      uint64_t offset, const uint8_t* blob, uint64_t len) {
+    uint64_t pos = 0;
+    while (pos < len) {
+        uint64_t block = (offset + pos) / unit;
+        uint64_t inner = (offset + pos) % unit;
+        uint64_t sid = block % (uint64_t)k;
+        uint64_t shard_off = (block / (uint64_t)k) * unit + inner;
+        uint64_t take = len - pos;
+        if (take > unit - inner) take = unit - inner;
+        const uint8_t* p = blob + pos;
+        uint64_t left = take;
+        while (left > 0) {
+            ssize_t w = pwrite(fds[sid], p, left, (off_t)shard_off);
+            if (w < 0) {
+                if (errno == EINTR) continue;
+                return -errno;
+            }
+            p += w;
+            shard_off += (uint64_t)w;
+            left -= (uint64_t)w;
+        }
+        pos += take;
+    }
+    return 0;
 }
 
 }  // extern "C"

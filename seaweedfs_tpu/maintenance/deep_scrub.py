@@ -384,6 +384,13 @@ def deep_scrub_host(directory: str, collection: str, vid: int,
 
     base = (os.path.join(directory, f"{collection}_{vid}") if collection
             else os.path.join(directory, str(vid)))
+    if os.path.exists(base + ".scl"):
+        # inline EC volume: shard logs have no whole-file CRC record;
+        # the audit recomputes every committed stripe's parity + CRC
+        # against the commit log and re-reads every live needle
+        from ..storage.erasure_coding.inline import verify_inline_volume
+
+        return verify_inline_volume(directory, collection, vid)
     info = load_volume_info(base) or {}
     stored = info.get("shard_crc32c")
     clean, corrupt, absent = verify_shard_files(base, stored,

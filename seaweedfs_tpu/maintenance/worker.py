@@ -237,6 +237,16 @@ class MaintenanceWorker:
         ev = self.server.store.find_ec_volume(vid)
         if ev is None:
             raise RuntimeError(f"ec volume {vid} not held here")
+        if getattr(ev, "writer", None):
+            # inline EC volume: audit the live writer in place —
+            # recompute every committed stripe's parity + CRC against
+            # the commit log and re-read every live needle
+            from ..storage.erasure_coding.inline import \
+                audit_inline_volume
+
+            report = audit_inline_volume(ev)
+            report["pacer"] = self.pacer.snapshot()
+            return report
         from ..storage.erasure_coding.encoder import load_volume_info
 
         base = ev.base_file_name()

@@ -59,6 +59,13 @@ def lib() -> ctypes.CDLL | None:
         ctypes.c_char_p, ctypes.c_size_t, ctypes.c_int, ctypes.c_char_p,
         ctypes.POINTER(ctypes.c_uint32),
     ]
+    if hasattr(cdll, "sw_inline_scatter"):  # absent in stale prebuilt libs
+        cdll.sw_inline_scatter.restype = ctypes.c_int
+        cdll.sw_inline_scatter.argtypes = [
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int32,
+            ctypes.c_uint64, ctypes.c_uint64, ctypes.c_char_p,
+            ctypes.c_uint64,
+        ]
     return cdll
 
 

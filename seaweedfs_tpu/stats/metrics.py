@@ -321,6 +321,28 @@ EcRecoverSpanCounter = REGISTRY.counter(
 EcRecoverBytesCounter = REGISTRY.counter(
     "SeaweedFS_volumeServer_ec_recover_bytes_total",
     "survivor bytes pushed through degraded-read decodes")
+# inline write-path EC (storage/erasure_coding/inline.py): needles
+# stream straight into striped shard logs, parity commits per stripe
+EcInlineStripesCommitted = REGISTRY.counter(
+    "SeaweedFS_ec_inline_stripes_committed_total",
+    "stripe commit records appended by inline EC writers "
+    "(full = a complete k-block row, tail = a zero-padded partial row)",
+    ("kind",))
+EcInlineTailBytes = REGISTRY.gauge(
+    "SeaweedFS_ec_inline_tail_bytes",
+    "bytes buffered in the partially-filled tail stripe, last writer")
+EcInlineWriteAmp = REGISTRY.gauge(
+    "SeaweedFS_ec_inline_write_amp",
+    "physical bytes written / logical bytes ingested, last inline "
+    "EC commit (the (k+p)/k floor is 1.4 for RS(10,4))")
+EcInlineBytesCounter = REGISTRY.counter(
+    "SeaweedFS_ec_inline_bytes_total",
+    "inline EC writer traffic: logical = needle stream bytes acked, "
+    "physical = extra parity + commit-record bytes", ("kind",))
+EcInlineCommitSeconds = REGISTRY.histogram(
+    "SeaweedFS_ec_inline_stripe_commit_seconds",
+    "stripe commit latency: QoS background-lane wait + parity encode "
+    "+ shard-log and commit-record writes")
 # device pipeline: the HBM slab pool behind the batched EC dispatch
 # path (ops/device_pool.py) and the host<->device transfer volume of
 # the encode/rebuild/recover device paths

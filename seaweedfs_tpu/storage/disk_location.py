@@ -96,6 +96,19 @@ class DiskLocation:
                     continue
                 if vid in self.volumes:
                     continue  # normal volume takes precedence
+                if os.path.exists(base + ".scl"):
+                    # inline EC volume: mounting runs the stripe-commit
+                    # replay, so a crashed server comes back consistent
+                    if vid in self.ec_volumes:
+                        continue
+                    from .erasure_coding.inline import InlineEcVolume
+
+                    try:
+                        self.ec_volumes[vid] = InlineEcVolume(
+                            self.directory, collection, vid)
+                    except Exception:
+                        pass  # damaged volume: skip, don't crash
+                    continue
                 for shard_id in shard_ids:
                     try:
                         self.mount_ec_shard(collection, vid, shard_id)
@@ -122,6 +135,20 @@ class DiskLocation:
                        fsync=self.fsync)
             self.volumes[vid] = v
             return v
+
+    def add_inline_volume(self, vid: int, collection: str = "",
+                          family: str = None):
+        """Create an inline EC volume: shard logs are the primary write
+        path, no .dat ever exists (storage/erasure_coding/inline.py)."""
+        from .erasure_coding.inline import InlineEcVolume
+
+        with self.lock:
+            if vid in self.volumes or vid in self.ec_volumes:
+                raise ValueError(f"volume {vid} already exists")
+            ev = InlineEcVolume(self.directory, collection, vid,
+                                family=family, create=True)
+            self.ec_volumes[vid] = ev
+            return ev
 
     def delete_volume(self, vid: int):
         with self.lock:

@@ -163,6 +163,11 @@ def search_sorted_index(fileno: int, n_entries: int,
 class EcVolume:
     """A mounted EC volume: local shard subset + .ecx/.ecj handles."""
 
+    # inline EC volumes install a hook serving shard-log spans from the
+    # in-memory tail stripe: (shard_id, offset, size) -> bytes | None.
+    # Sealed volumes leave it None and the classic ladder applies.
+    tail_reader: Optional[ShardReader] = None
+
     def __init__(self, directory: str, collection: str, vid: int,
                  version: int = 3, encoder=None,
                  large_block_size: int = LARGE_BLOCK_SIZE,
@@ -279,14 +284,34 @@ class EcVolume:
         return self.read_shard_span(shard_id, inner_offset, iv.size)
 
     def read_shard_span(self, shard_id: int, offset: int, size: int) -> bytes:
-        """Read ladder: local shard -> remote hook -> reconstruct."""
+        """Read ladder: local shard -> in-memory tail stripe (inline
+        volumes) -> remote hook -> reconstruct."""
         shard = self.shards.get(shard_id)
         if shard is not None:
             data = shard.read_at(size, offset)
             if len(data) == size:
                 return data
+            if self.tail_reader is not None:
+                # the span runs past the shard log's durable extent:
+                # the remainder lives in the partially-filled tail
+                # stripe (data still buffered, or parity not yet
+                # committed for the current row)
+                rest = self.tail_reader(shard_id, offset + len(data),
+                                        size - len(data))
+                if rest is None:
+                    # the flusher committed the row between the pread
+                    # and the tail lookup — the bytes are on disk now
+                    data = shard.read_at(size, offset)
+                    if len(data) == size:
+                        return data
+                else:
+                    return data + rest
             raise EcError(
                 f"short read shard {shard_id} at {offset}+{size}")
+        if self.tail_reader is not None:
+            data = self.tail_reader(shard_id, offset, size)
+            if data is not None:
+                return data
         if self.remote_reader is not None:
             try:
                 data = self.remote_reader(shard_id, offset, size)
@@ -413,6 +438,19 @@ class EcVolume:
                 if len(shards) >= k:
                     continue  # reconstruct needs exactly k survivors
                 data = shard.read_at(size, offset)
+                if len(data) != size and self.tail_reader is not None:
+                    # inline volume: the span runs past the shard log's
+                    # durable extent.  The tail stripe serves pending
+                    # rows; past that a DATA shard's content is
+                    # definitionally zero (parity rows are encoded over
+                    # the zero-padded row), while a parity shard without
+                    # tail coverage is simply not a survivor
+                    rest = self.tail_reader(sid, offset + len(data),
+                                            size - len(data))
+                    if rest is None and sid < k:
+                        rest = b"\x00" * (size - len(data))
+                    if rest is not None:
+                        data += rest
                 if len(data) == size:
                     shards[sid] = np.frombuffer(data, dtype=np.uint8)
             elif self.remote_reader is not None:

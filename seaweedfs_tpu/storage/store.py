@@ -83,13 +83,23 @@ class Store:
 
     # -- volume admin (store.go AddVolume path) -------------------------------
     def add_volume(self, vid: int, collection: str = "",
-                   replication: str = "000", ttl: str = "") -> Volume:
+                   replication: str = "000", ttl: str = ""):
+        from .erasure_coding.inline import inline_family_for
+
         with self.lock:
-            if self.find_volume(vid) is not None:
+            if self.find_volume(vid) is not None \
+                    or self.find_ec_volume(vid) is not None:
                 raise VolumeError(f"volume {vid} already exists")
             loc = max(self.locations, key=lambda l: l.free_slots())
             if loc.free_slots() <= 0:
                 raise VolumeError("no free volume slots")
+            # assign-time policy: an EC-policy collection with
+            # WEED_EC_INLINE=1 gets shard logs as its PRIMARY write
+            # path — no .dat, no replica fan-out, no post-hoc encode
+            family = inline_family_for(collection)
+            if family is not None:
+                return loc.add_inline_volume(vid, collection,
+                                             family=family)
             return loc.add_volume(
                 vid, collection,
                 replica_placement=ReplicaPlacement.parse(replication),
@@ -100,6 +110,11 @@ class Store:
             for loc in self.locations:
                 if vid in loc.volumes:
                     loc.delete_volume(vid)
+                    return
+                ev = loc.ec_volumes.get(vid)
+                if ev is not None and getattr(ev, "writer", None):
+                    loc.ec_volumes.pop(vid)
+                    ev.destroy()
                     return
             raise NotFoundError(f"volume {vid} not found")
 
@@ -114,6 +129,13 @@ class Store:
                      check_cookie: bool = True) -> tuple[int, bool]:
         v = self.find_volume(vid)
         if v is None:
+            ev = self.find_ec_volume(vid)
+            if ev is not None and getattr(ev, "writer", None):
+                # inline EC volume: the needle streams straight into
+                # the striped shard logs, parity follows per stripe
+                _, size, unchanged = ev.write_needle(
+                    n, check_cookie=check_cookie)
+                return size, unchanged
             raise NotFoundError(f"volume {vid} not found")
         try:
             _, size, unchanged = v.write_needle(
@@ -359,6 +381,28 @@ class Store:
                         "modified_at_second": int(v.last_modified_ts),
                     })
                 for vid, ev in loc.ec_volumes.items():
+                    if getattr(ev, "writer", None):
+                        # inline EC volume: report as a WRITABLE volume
+                        # so the master keeps assigning fids to it —
+                        # parity is already current, there is nothing
+                        # to seal or encode later
+                        max_file_key = max(max_file_key,
+                                           ev.max_file_key())
+                        volumes.append({
+                            "id": vid,
+                            "collection": ev.collection,
+                            "size": ev.writer.logical_size,
+                            "file_count": ev.file_count(),
+                            "delete_count": ev.deleted_count(),
+                            "deleted_byte_count": ev.deleted_size(),
+                            "read_only": ev.read_only,
+                            "replica_placement": 0,
+                            "ttl": 0,
+                            "compact_revision": 0,
+                            "modified_at_second":
+                                int(ev.last_modified_ts),
+                        })
+                        continue
                     ec_shards.append({
                         "id": vid,
                         "collection": ev.collection,

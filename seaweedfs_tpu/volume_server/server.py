@@ -663,6 +663,7 @@ class VolumeServer:
         s.add("POST", "/admin/ec/scrub", g(self._h_ec_scrub))
         s.add("GET", "/admin/ec/recover_stats", g(self._h_ec_recover_stats))
         s.add("GET", "/admin/ec/codes", g(self._h_ec_codes))
+        s.add("GET", "/admin/ec/inline_status", g(self._h_ec_inline_status))
         s.add("GET", "/admin/ec/shard_file", self._h_ec_shard_file)
         s.add("GET", "/admin/ec/shard_read", self._h_ec_shard_read)
         s.add("GET", "/admin/ec/shard_project", self._h_ec_shard_project)
@@ -1684,6 +1685,25 @@ class VolumeServer:
             "rebuild_read_amp": ec_codes.rebuild_read_amp_snapshot(),
             "volumes": volumes,
         }
+
+    def _h_ec_inline_status(self, req: Request):
+        """Inline-EC write-path introspection: every mounted volume that
+        carries an inline stripe writer reports its commit watermark,
+        tail occupancy and realised write amplification.  ?volume=N
+        narrows to one volume."""
+        want_vid = int(req.param("volume", "0"))
+        volumes = {}
+        for loc in self.store.locations:
+            for vid, ev in loc.ec_volumes.items():
+                writer = getattr(ev, "writer", None)
+                if writer is None:
+                    continue
+                if want_vid and vid != want_vid:
+                    continue
+                st = writer.status()
+                st["collection"] = ev.collection
+                volumes[str(vid)] = st
+        return {"inline_volumes": volumes, "count": len(volumes)}
 
     def _h_ec_shard_project(self, req: Request):
         """Sub-shard read RPC: stream GF(2^8) projection ``vec @ lanes``

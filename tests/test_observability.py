@@ -217,3 +217,13 @@ class TestGrafanaDashboard:
                 "SeaweedFS_master_scale_events_total",
                 "SeaweedFS_volumeServer_draining"):
             assert token in joined, f"no Elasticity panel queries {token}"
+        # the Inline EC row queries the write-path EC families
+        for token in (
+                "SeaweedFS_ec_inline_stripes_committed_total",
+                "SeaweedFS_ec_inline_write_amp",
+                "SeaweedFS_ec_inline_tail_bytes",
+                "SeaweedFS_ec_inline_stripe_commit_seconds_bucket",
+                "SeaweedFS_ec_inline_bytes_total"):
+            assert token in joined, f"no Inline EC panel queries {token}"
+        titles = [p.get("title") for p in dashboard["panels"]]
+        assert "Inline EC" in titles
