@@ -1918,6 +1918,109 @@ def bench_elasticity(num_objects: int = 150,
         _cleanup_scale_workdirs()
 
 
+def bench_gateway_workers(counts: tuple = (1, 2, 4), num_files: int = 300,
+                          read_reqs: int = 1500,
+                          payload_bytes: int = 2048) -> dict:
+    """smallfile_read_rps vs prefork gateway worker count.
+
+    Each point starts a real `weed server` subprocess (prefork needs a
+    fork + an SO_REUSEPORT bind on a concrete port, so the bench drives
+    weed.py externally with WEED_HTTP_WORKERS set), writes `num_files`
+    small objects through the volume gateway, then storms GETs with 8
+    client threads and reports reads/s.  `gated` is True when the box
+    has >= 2 usable cores — below that the workers time-slice one core
+    and the curve measures the scheduler, not the sharding."""
+    import signal as _signal
+    import socket
+    import subprocess
+    from concurrent.futures import ThreadPoolExecutor
+
+    from seaweedfs_tpu.rpc.http_rpc import RpcError, call
+    from seaweedfs_tpu.util.platform import available_cpu_count
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+
+    def free_port() -> int:
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    out: dict = {"counts": {}, "num_files": num_files,
+                 "read_reqs": read_reqs, "cores": available_cpu_count()}
+    out["gated"] = out["cores"] >= 2
+    for workers in counts:
+        workdir = tempfile.mkdtemp(prefix="swbench_gw_")
+        mport, vport = free_port(), free_port()
+        env = dict(os.environ, WEED_HTTP_WORKERS=str(workers),
+                   JAX_PLATFORMS="cpu")
+        proc = subprocess.Popen(
+            [sys.executable, os.path.join(repo, "weed.py"), "server",
+             "-ip", "127.0.0.1", "-dir", workdir,
+             "-masterPort", str(mport), "-volumePort", str(vport)],
+            env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL, cwd=repo)
+        master = f"127.0.0.1:{mport}"
+        try:
+            deadline = time.time() + 90
+            while True:
+                try:
+                    st = call(master, "/dir/status", timeout=2)
+                    if any(n.get("url")
+                           for dc in st.get("datacenters", [])
+                           for r in dc.get("racks", [])
+                           for n in r.get("nodes", [])):
+                        break
+                except (RpcError, OSError):
+                    pass
+                if proc.poll() is not None or time.time() > deadline:
+                    raise RuntimeError(
+                        f"weed server ({workers}w) failed to come up")
+                time.sleep(0.2)
+            body = os.urandom(payload_bytes)
+            fids = []
+            for _ in range(num_files):
+                a = call(master, "/dir/assign")
+                call(a["url"], "/" + a["fid"], raw=body, method="POST")
+                fids.append((a["url"], a["fid"]))
+
+            def one(i: int) -> tuple:
+                url, fid = fids[i % len(fids)]
+                t = time.perf_counter()
+                n = len(call(url, "/" + fid, parse=False))
+                return n, time.perf_counter() - t
+
+            with ThreadPoolExecutor(max_workers=8) as pool:
+                list(pool.map(one, range(min(200, read_reqs))))  # warm
+                t0 = time.perf_counter()
+                results = list(pool.map(one, range(read_reqs)))
+                elapsed = time.perf_counter() - t0
+            if any(n != payload_bytes for n, _ in results):
+                raise RuntimeError("short read during the GET storm")
+            lat = sorted(t for _, t in results)
+            out["counts"][str(workers)] = {
+                "rps": round(read_reqs / elapsed, 1),
+                "p50_ms": round(lat[len(lat) // 2] * 1000, 2),
+                "p99_ms": round(lat[int(len(lat) * 0.99)
+                                    if int(len(lat) * 0.99) < len(lat)
+                                    else -1] * 1000, 2),
+            }
+        finally:
+            if proc.poll() is None:
+                proc.send_signal(_signal.SIGTERM)
+                try:
+                    proc.wait(timeout=15)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    proc.wait()
+            shutil.rmtree(workdir, ignore_errors=True)
+    c = out["counts"]
+    if c.get("1") and c.get("2"):
+        out["speedup_2x"] = round(c["2"]["rps"] / c["1"]["rps"], 2)
+    return out
+
+
 def main():
     # never hang on a wedged TPU transport: probe device init in a
     # subprocess first; on timeout pin the CPU backend (env alone is not
@@ -2171,6 +2274,14 @@ def main():
     except Exception as e:
         print(f"note: elasticity bench failed: {e}", file=sys.stderr)
 
+    # -- prefork gateway worker scaling (smallfile read rps) -----------------
+    gateway_workers_stats: dict = {}
+    try:
+        _policy.reset_state()
+        gateway_workers_stats = bench_gateway_workers()
+    except Exception as e:
+        print(f"note: gateway workers bench failed: {e}", file=sys.stderr)
+
     vs_baseline = hbm_fused / cpu_kernel if cpu_kernel > 0 else 0.0
     from seaweedfs_tpu.util.platform import available_cpu_count
 
@@ -2249,6 +2360,7 @@ def main():
         "read_cache": read_cache_stats,
         "cluster_scale": cluster_scale_stats,
         "elasticity": elasticity_stats,
+        "gateway_workers": gateway_workers_stats,
         "smallfile_secured_vs_plain_write": (
             round(sec_write_rps / sf_write_rps, 2) if sf_write_rps
             else 0.0),
@@ -2271,7 +2383,10 @@ if __name__ == "__main__":
                "master_failover": bench_master_failover,
                "read_cache": bench_read_cache,
                "cluster_scale": bench_cluster_scale,
-               "elasticity": bench_elasticity}
+               "elasticity": bench_elasticity,
+               "gateway_workers": bench_gateway_workers,
+               # alias: the curve IS the smallfile read-rps phase
+               "smallfile_read_rps": bench_gateway_workers}
     if len(sys.argv) > 1:
         if sys.argv[1] in ("--list", "-l"):
             print("\n".join(sorted(_phases)))

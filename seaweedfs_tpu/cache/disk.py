@@ -41,6 +41,16 @@ class CacheVolume:
             return None
         return os.pread(self._file.fileno(), loc[1], loc[0])
 
+    def get_slice(self, fid: str) -> Optional[tuple]:
+        """(dup'd fd, offset, length) for zero-copy sendfile, or None.
+        The dup keeps the bytes readable even if this segment rotates
+        (reset() swaps in a NEW inode) or the cache closes mid-send;
+        the consumer owns — and must close — the returned fd."""
+        loc = self._index.get(fid)
+        if loc is None:
+            return None
+        return os.dup(self._file.fileno()), loc[0], loc[1]
+
     def has_room(self, n: int) -> bool:
         return self.file_size + n <= self.size_limit
 
@@ -62,7 +72,15 @@ class CacheVolume:
         return len(stale)
 
     def reset(self):
-        self._file.truncate(0)
+        # replace the inode instead of truncating it: in-flight
+        # sendfile slices hold dup'd fds to the OLD inode and must keep
+        # seeing their bytes until the transfer finishes
+        self._file.close()
+        try:
+            os.unlink(self.file_name)
+        except OSError:
+            pass
+        self._file = open(self.file_name, "wb+", buffering=0)
         self._index.clear()
         self.file_size = 0
 
@@ -94,6 +112,16 @@ class OnDiskCacheLayer:
                 data = v.get(fid)
                 if data is not None:
                     return data
+            return None
+
+    def get_slice(self, fid: str) -> Optional[tuple]:
+        """(dup'd fd, offset, length) under the layer lock, so the dup
+        happens before any concurrent rotation can reset the segment."""
+        with self._lock:
+            for v in self.volumes:
+                s = v.get_slice(fid)
+                if s is not None:
+                    return s
             return None
 
     def put(self, fid: str, data) -> None:

@@ -179,6 +179,23 @@ class TieredReadCache:
         self._count_miss()
         return None
 
+    def get_slice(self, fid: str) -> Optional[tuple]:
+        """Zero-copy variant for the sendfile reply path: a (dup'd fd,
+        offset, length) triple when the fid sits in a DISK layer, else
+        None.  RAM/HBM tiers have no backing fd and stay on the
+        in-memory reply path, which is already faster for them."""
+        if not self.layers:
+            return None
+        if self.mem.get(fid) is not None or (
+                self.hbm is not None and self.hbm.get(fid) is not None):
+            return None
+        for layer in self.layers:
+            s = layer.get_slice(fid)
+            if s is not None:
+                self._count_hit("disk")
+                return s
+        return None
+
     def put(self, fid: str, data: Any, nbytes: Optional[int] = None):
         if qos.enabled() and qos.current_class() == qos.BACKGROUND \
                 and not background_fills():

@@ -52,6 +52,14 @@ class FilerStore:
     def close(self):
         pass
 
+    def forget_connections(self):
+        """Drop (without closing) any backend handle opened before a
+        prefork fork().  Sqlite connections must not be used from two
+        processes; serving threads in the child are brand-new threads
+        that lazily open their own, so dropping the reference suffices.
+        Closing the inherited handle from the child would run sqlite
+        shutdown against the parent's live database, so leak it."""
+
 
 class MemoryStore(FilerStore):
     def __init__(self):
@@ -129,6 +137,9 @@ class SqliteStore(FilerStore):
             conn.isolation_level = None  # autocommit
             self._local.conn = conn
         return conn
+
+    def forget_connections(self):
+        self._local = threading.local()
 
     def insert_entry(self, entry: Entry):
         self._conn().execute(
@@ -265,6 +276,10 @@ class ShardedSqliteStore(FilerStore):
         for shard in self._shards:
             shard.close()
 
+    def forget_connections(self):
+        for shard in self._shards:
+            shard.forget_connections()
+
 
 class PerBucketStoreRouter(FilerStore):
     """Route /buckets/<name>/ subtrees to dedicated stores.
@@ -322,6 +337,13 @@ class PerBucketStoreRouter(FilerStore):
         bucket = self._bucket_of(path)
         if bucket and path == f"{self.buckets_root}/{bucket}":
             self._drop_bucket(bucket)
+
+    def forget_connections(self):
+        self.default.forget_connections()
+        with self._lock:
+            stores = list(self._buckets.values())
+        for store in stores:
+            store.forget_connections()
 
     def _drop_bucket(self, bucket: str):
         import os

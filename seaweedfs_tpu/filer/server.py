@@ -25,7 +25,8 @@ from typing import Iterator, Optional
 
 from .. import profiling, qos, tracing
 from ..rpc import policy
-from ..rpc.http_rpc import Request, Response, RpcError, RpcServer, call
+from ..rpc.http_rpc import (FileSlice, Request, Response, RpcError,
+                            RpcServer, call, sendfile_enabled)
 from ..util import faults
 from ..security import Guard, gen_read_jwt, gen_write_jwt
 from ..stats import metrics as stats
@@ -131,6 +132,10 @@ class FilerServer:
         self._io_pool = ThreadPoolExecutor(
             max_workers=16, thread_name_prefix="filer-io")
         self.server = RpcServer(host, port, service_name="filer")
+        # prefork workers must not touch a sqlite connection that was
+        # opened before the fork; new serve threads reopen lazily
+        self.server.on_worker_start(
+            lambda wid: self.filer.store.forget_connections())
         # observability mounts shadow the matching user paths, like the
         # /metadata/, /remote/ and /kv/ prefixes below
         self.server.add("GET", "/metrics", stats.metrics_handler)
@@ -992,6 +997,10 @@ class FilerServer:
             headers["Content-Length"] = str(length)
             return Response(b"", status, content_type, headers)
 
+        zero = self._sendfile_read(entry, start, length, status,
+                                   content_type, headers)
+        if zero is not None:
+            return zero
         streamed = self.read_stream(entry, start, length)
         if streamed is not None:
             body_iter, n = streamed
@@ -1007,6 +1016,34 @@ class FilerServer:
         stats.FilerStreamedReadCounter.labels("zero_copy").inc()
         body = parts[0] if len(parts) == 1 else iter(parts)
         return Response(body, status, content_type, headers)
+
+    def _sendfile_read(self, entry: Entry, start: int, length: int,
+                       status: int, content_type: str, headers: dict):
+        """Zero-copy GET for the common hot case: a single-chunk,
+        cipher-free entry whose chunk sits in the on-disk cache tier —
+        the bytes go disk cache -> socket via sendfile without ever
+        entering Python.  Returns None to fall back to the streamed /
+        buffered paths (RAM-cached chunks stay on those: an in-memory
+        memoryview write is already zero-copy for them)."""
+        if not sendfile_enabled() or entry.content \
+                or len(entry.chunks) != 1:
+            return None
+        c = entry.chunks[0]
+        if c.cipher_key or c.is_chunk_manifest or c.offset != 0:
+            return None
+        if start < 0 or start + length > c.size:
+            return None
+        sl = self.chunk_cache.get_slice(c.fid)
+        if sl is None:
+            return None
+        fd, off, ln = sl
+        if ln != c.size:  # cached bytes disagree with metadata: stale
+            os.close(fd)
+            return None
+        headers["Content-Length"] = str(length)
+        stats.FilerStreamedReadCounter.labels("sendfile").inc()
+        return Response(FileSlice(fd, off + start, length, close_fd=True),
+                        status, content_type, headers)
 
     def _list_directory(self, entry: Entry, req: Request):
         limit = int(req.param("limit", "100"))

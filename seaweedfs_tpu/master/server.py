@@ -251,6 +251,14 @@ class MasterServer:
     def _register_routes(self):
         s = self.server
         g = self._guarded
+        # every data/control read serves raft + heartbeat-fed topology
+        # state that exists ONLY in worker 0 — prefork read replicas
+        # forked before any election or heartbeat and must proxy these
+        # (only /metrics, /debug/* and the curator worker protocol stay
+        # shardable on the master port)
+        s.parent_prefixes.update((
+            "/dir/", "/cluster/", "/vol/", "/ec/", "/raft/", "/filer/",
+            "/col/", "/maintenance/", "/ui"))
         s.add("POST", "/api/heartbeat", self._handle_heartbeat)
         s.add("GET", "/dir/assign", self._handle_assign)
         s.add("POST", "/dir/assign", self._handle_assign)

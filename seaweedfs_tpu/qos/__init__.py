@@ -31,6 +31,7 @@ from .classify import (BACKGROUND, CLASSES, INTERACTIVE,  # noqa: F401
                        qos_scope, retry_after, set_qos)
 from .lanes import LANES, DeviceLanes, lanes_enabled  # noqa: F401
 from .quota import QUOTAS, CollectionQuotas  # noqa: F401
+from . import shm  # noqa: F401
 
 
 def snapshot(gate=None) -> dict:

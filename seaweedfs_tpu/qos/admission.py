@@ -31,6 +31,7 @@ from typing import Dict, Optional
 
 from ..stats import metrics as _stats
 from . import classify
+from . import shm as _shm
 from .classify import BACKGROUND, CLASSES, INTERACTIVE, STANDARD
 
 
@@ -104,6 +105,14 @@ class TenantBuckets:
         rate = _env_float(self.rate_env, 0.0)
         if rate <= 0:
             return True
+        s = _shm.ACTIVE
+        if s is not None:
+            # fleet-wide bucket: every prefork worker draws from one
+            # shared-memory slot, so the rate stays per-tenant rather
+            # than silently becoming per-tenant-per-worker
+            return s.tenant_take(
+                "t:" + tenant, rate,
+                _env_float(self.burst_env, max(rate, 1.0)), n)
         with self._lock:
             b = self._buckets.get(tenant)
             if b is None:
@@ -141,16 +150,36 @@ def class_weights() -> Dict[str, int]:
     return weights
 
 
+class _ShmDeficit:
+    """Mapping view over one service's shared DRR deficit slots.
+    Caller holds that service's cross-process drr lock for the whole
+    pop."""
+
+    __slots__ = ("_s", "_svc")
+
+    def __init__(self, s: "_shm.QosShm", service: str = ""):
+        self._s = s
+        self._svc = service
+
+    def __getitem__(self, cls: str) -> float:
+        return self._s.drr_get(cls, service=self._svc)
+
+    def __setitem__(self, cls: str, value: float):
+        self._s.drr_set(cls, value, service=self._svc)
+
+
 class DrrQueue:
     """Deficit-round-robin over the per-class waiter queues.  Unit-cost
     items; each visit to a backlogged class tops its deficit up by the
     class quantum (= weight) and drains while the deficit lasts.  Not
     thread-safe — the owning gate serializes access under its lock."""
 
-    def __init__(self, weights: Optional[Dict[str, int]] = None):
+    def __init__(self, weights: Optional[Dict[str, int]] = None,
+                 service: str = ""):
         self.queues: Dict[str, deque] = {c: deque() for c in CLASSES}
         self.weights = dict(weights) if weights else class_weights()
         self.deficit: Dict[str, float] = {c: 0.0 for c in CLASSES}
+        self.service = service  # selects this queue's shared DRR slots
         self._i = 0
 
     def push(self, cls: str, item) -> None:
@@ -166,6 +195,16 @@ class DrrQueue:
         """Next item under DRR, or None when all queues are empty."""
         if not len(self):
             return None
+        s = _shm.ACTIVE
+        if s is None or s.service_index(self.service) < 0:
+            return self._pop_from(self.deficit)
+        # prefork: deficits live in shared memory (per service, so
+        # combined daemons don't cross-couple) and weight fidelity
+        # holds across the whole worker fleet, not per process
+        with s.drr_lock(self.service):
+            return self._pop_from(_ShmDeficit(s, self.service))
+
+    def _pop_from(self, deficit):
         n = len(CLASSES)
         # weights >= 1 guarantee a backlogged class dispatches on its
         # visit, so two passes always yield an item
@@ -174,17 +213,17 @@ class DrrQueue:
             q = self.queues[cls]
             if not q:
                 # an idle class must not bank deficit for later bursts
-                self.deficit[cls] = 0.0
+                deficit[cls] = 0.0
                 self._i += 1
                 continue
-            if self.deficit[cls] < 1.0:
-                self.deficit[cls] += self.weights.get(cls, 1)
-            self.deficit[cls] -= 1.0
+            if deficit[cls] < 1.0:
+                deficit[cls] = deficit[cls] + self.weights.get(cls, 1)
+            deficit[cls] = deficit[cls] - 1.0
             item = q.popleft()
             if not q:
-                self.deficit[cls] = 0.0
+                deficit[cls] = 0.0
                 self._i += 1
-            elif self.deficit[cls] < 1.0:
+            elif deficit[cls] < 1.0:
                 self._i += 1
             return item
         return None  # unreachable with weights >= 1
@@ -228,7 +267,6 @@ _QUEUE_ENV = {INTERACTIVE: ("WEED_QOS_QUEUE_INTERACTIVE", 64),
               STANDARD: ("WEED_QOS_QUEUE_STANDARD", 32),
               BACKGROUND: ("WEED_QOS_QUEUE_BACKGROUND", 8)}
 
-
 class AdmissionGate:
     """Per-daemon front-end admission: weighted-fair queues over a
     bounded in-flight limit.
@@ -248,7 +286,7 @@ class AdmissionGate:
         self.default_limit = int(default_limit)
         self.now = now
         self._lock = threading.Lock()
-        self._drr = DrrQueue()
+        self._drr = DrrQueue(service=service)
         self.inflight: Dict[str, int] = {c: 0 for c in CLASSES}
         self.admitted: Dict[str, int] = {c: 0 for c in CLASSES}
         self.queued: Dict[str, int] = {c: 0 for c in CLASSES}
@@ -289,6 +327,7 @@ class AdmissionGate:
             tenant = classify.current_tenant()
         if not self.tenants.try_take(tenant):
             self.shed[cls] += 1
+            self._mirror(cls)
             _stats.QosTenantThrottledCounter.labels(self.service,
                                                     cls).inc()
             self._count(cls, "shed_tenant")
@@ -298,6 +337,7 @@ class AdmissionGate:
         limit = self.effective_limit()
         if limit <= 0:
             self.admitted[cls] += 1
+            self._mirror(cls)
             self._count(cls, "admit")
             return _NOOP_RELEASE
         waiter = None
@@ -363,6 +403,7 @@ class AdmissionGate:
         waiter = _Waiter(cls)
         self._drr.push(cls, waiter)
         self.queued[cls] += 1
+        self._mirror(cls)
         self._count(cls, "queued")
         return waiter
 
@@ -382,14 +423,39 @@ class AdmissionGate:
                 continue
             self.queued[w.cls] -= 1
             self.inflight[w.cls] += 1
+            self._mirror(w.cls)
             w.event.set()
+
+    def _mirror(self, cls: str):
+        """Publish this gate's counters for `cls` to its own
+        (service, worker) row — single writer, so no lock.  Rows are
+        partitioned by service so the gates of a combined daemon
+        (weed.py server) never clobber each other, and each gate's
+        limit is enforced against its OWN service's fleet sum rather
+        than the cross-service total."""
+        s = _shm.ACTIVE
+        if s is None:
+            return
+        for field in ("inflight", "queued", "admitted", "shed"):
+            s.gate_set(self.service, cls, field,
+                       getattr(self, field).get(cls, 0))
+
+    def _fleet_total(self, field: str, local: Dict[str, int]) -> int:
+        s = _shm.ACTIVE
+        if s is not None and s.service_index(self.service) >= 0:
+            return s.gate_total(field, service=self.service)
+        return sum(local.values())
 
     # -- introspection --------------------------------------------------------
     def total_inflight(self) -> int:
-        return sum(self.inflight.values())
+        """This service's fleet-wide in-flight when the shared segment
+        is active (prefork), else this process's sum — the admission
+        limit is enforced against this value, so limits are fleet-wide
+        per service (never coupled across a combined daemon's gates)."""
+        return self._fleet_total("inflight", self.inflight)
 
     def total_queued(self) -> int:
-        return sum(self.queued.values())
+        return self._fleet_total("queued", self.queued)
 
     def occupancy(self) -> float:
         """(in-flight + queued) / limit, clamped to [0, 1] — the
@@ -402,7 +468,7 @@ class AdmissionGate:
 
     def snapshot(self) -> dict:
         with self._lock:
-            return {
+            snap = {
                 "service": self.service,
                 "limit": self.effective_limit(),
                 "weights": dict(self._drr.weights),
@@ -414,6 +480,9 @@ class AdmissionGate:
                 "occupancy": round(self.occupancy(), 4),
                 "tenants": self.tenants.snapshot(),
             }
+            if _shm.ACTIVE is not None:
+                snap["shm"] = _shm.ACTIVE.snapshot()
+            return snap
 
     def _count(self, cls: str, outcome: str):
         _stats.QosRequestsCounter.labels(self.service, cls,
@@ -424,3 +493,7 @@ class AdmissionGate:
             self.inflight[cls])
         _stats.QosQueueDepthGauge.labels(self.service, cls).set(
             max(0, self.queued[cls]))
+        self._mirror(cls)
+        if _shm.ACTIVE is not None:
+            _stats.QosSharedGateOccupancyGauge.labels(self.service).set(
+                round(self.occupancy(), 4))

@@ -21,6 +21,7 @@ import time
 from typing import Dict, Optional, Tuple
 
 from ..stats import metrics as _stats
+from . import shm as _shm
 from .admission import TokenBucket
 
 
@@ -73,18 +74,27 @@ class CollectionQuotas:
         with self._lock:
             ops_rate, byte_rate = self._limits_for(collection or "")
             if ops_rate > 0 and ops > 0:
-                b = self._bucket(collection, "ops", ops_rate)
-                if not b.try_take(ops):
+                if not self._take(collection, "ops", ops_rate, ops):
                     self.rejects["ops"] += 1
                     _stats.QosQuotaRejectsCounter.labels("ops").inc()
                     return False
             if byte_rate > 0 and nbytes > 0:
-                b = self._bucket(collection, "bytes", byte_rate)
-                if not b.try_take(nbytes):
+                if not self._take(collection, "bytes", byte_rate,
+                                  nbytes):
                     self.rejects["bytes"] += 1
                     _stats.QosQuotaRejectsCounter.labels("bytes").inc()
                     return False
         return True
+
+    def _take(self, collection: str, kind: str, rate: float,
+              n: float) -> bool:
+        s = _shm.ACTIVE
+        if s is not None:
+            # prefork: one shared bucket per (collection, kind), so the
+            # quota bounds the fleet rather than each worker
+            return s.tenant_take(f"q:{collection}:{kind}", rate,
+                                 max(rate, 1.0), n)
+        return self._bucket(collection, kind, rate).try_take(n)
 
     def _bucket(self, collection: str, kind: str,
                 rate: float) -> TokenBucket:

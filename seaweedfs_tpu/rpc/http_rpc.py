@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import http.client
 import json
+import os
 import socket
 import threading
 import time
@@ -27,6 +28,7 @@ from .. import tracing
 from ..qos import classify as _qos
 from ..stats import metrics as _stats
 from ..util import faults as _faults
+from . import prefork as _prefork
 
 
 class RpcError(Exception):
@@ -156,6 +158,54 @@ def stream_file(path: str, chunk_size: int = 4 << 20,
     return Response(gen(), headers=h)
 
 
+def sendfile_enabled() -> bool:
+    """Zero-copy writeback is on unless WEED_SENDFILE=0 (or the platform
+    has no os.sendfile — then FileSlice bodies take the pread path)."""
+    return os.environ.get("WEED_SENDFILE", "1") != "0"
+
+
+class FileSlice:
+    """Zero-copy reply body: a byte range of an open file, written with
+    os.sendfile straight from the page cache to the client socket — the
+    data never crosses into Python.  Producers (volume .dat reads, disk
+    cache hits) hand a dup'd fd with close_fd=True when the underlying
+    file may be closed or replaced while the reply is in flight: the dup
+    pins the inode, so the bytes stay valid.
+
+    `on_close` fires exactly once when the reply path finishes with the
+    slice (the _reply_file finally) — resource gates ride it (the
+    volume download throttle holds its byte budget for the TRANSFER's
+    lifetime, not just header construction)."""
+
+    __slots__ = ("fd", "offset", "length", "_close_fd", "_on_close")
+
+    def __init__(self, fd: int, offset: int, length: int,
+                 close_fd: bool = False, on_close=None):
+        self.fd = fd
+        self.offset = offset
+        self.length = length
+        self._close_fd = close_fd
+        self._on_close = on_close
+
+    def read_bytes(self) -> bytes:
+        """Materialize the slice (HEAD replies, fallback paths, tests)."""
+        return os.pread(self.fd, self.length, self.offset)
+
+    def close(self):
+        if self._close_fd and self.fd >= 0:
+            try:
+                os.close(self.fd)
+            except OSError:
+                pass
+            self.fd = -1
+        cb, self._on_close = self._on_close, None
+        if cb is not None:
+            try:
+                cb()
+            except Exception:
+                pass
+
+
 _STATUS_PHRASES = {s.value: s.phrase for s in HTTPStatus}
 
 
@@ -218,6 +268,26 @@ class RpcServer:
         # hoisted per-request metric child: one labels() lookup per
         # server instead of per request
         self._inflight = _stats.RpcInflightGauge.labels(service_name)
+        self._sendfile_bytes = \
+            _stats.GatewaySendfileBytesCounter.labels(service_name)
+        # prefork (WEED_HTTP_WORKERS): only explicitly-bound ports shard
+        # into worker processes — port-0 servers are ephemeral (test
+        # fixtures, embedded sidecars) and must never fork the host
+        # process (pytest/bench carry JAX + thread pools)
+        self._prefork = None
+        self._prefork_workers = (
+            _prefork.worker_count()
+            if port != 0 and _prefork.fork_available() else 1)
+        # admin routes the parent re-delivers to every worker after
+        # handling them itself (graceful drain / leave must reach the
+        # whole fleet, whichever process accepted the request)
+        self.fanout_prefixes: set[str] = set()
+        # GET/HEAD routes workers must proxy to worker 0 anyway: state
+        # that lives only in the parent process (raft leadership, the
+        # heartbeat-fed topology) — a worker's fork-time copy would
+        # answer with stale or leaderless state, not just miss new keys
+        self.parent_prefixes: set[str] = set()
+        self._on_worker_start: list[Callable[[int], None]] = []
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -320,6 +390,37 @@ class RpcServer:
                 length = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(length) if length else b""
                 req = Request(self, path, query, body)
+                pf = outer._prefork
+                # admin routes that must reach the whole fleet: the
+                # receiving process executes them locally and re-delivers
+                # to every peer below — a worker must NOT forward them to
+                # the parent, since the forwarded copy (FWD marked) is
+                # served strictly locally and the fanout would be lost
+                fanout_path = (
+                    pf is not None and
+                    _prefork.FWD_HEADER not in self.headers and
+                    any(path.startswith(p)
+                        for p in outer.fanout_prefixes))
+                if pf is not None and _prefork.is_worker() and \
+                        not fanout_path and \
+                        _prefork.FWD_HEADER not in self.headers and \
+                        not path.startswith("/debug/") and \
+                        (method not in ("GET", "HEAD") or
+                         any(path.startswith(p)
+                             for p in outer.parent_prefixes)):
+                    # prefork workers are read replicas of a fork-time
+                    # snapshot: every mutation is relayed to the single
+                    # writer (the parent) over its control sideband
+                    try:
+                        resp = pf.forward_to_parent(method, raw_path,
+                                                    body, self.headers)
+                    except RpcError as e:
+                        resp = Response(
+                            json.dumps({"error": str(e)}).encode(),
+                            e.status, "application/json",
+                            headers=dict(e.headers))
+                    self._reply(resp)
+                    return
                 route, prefix = outer._match(method, path)
                 # route label for the span name / hop vector: the matched
                 # prefix ("*" = default route), never the raw path — label
@@ -382,6 +483,25 @@ class RpcServer:
                         resp = Response(
                             json.dumps({"error": f"{type(e).__name__}: {e}"}
                                        ).encode(), 500, "application/json")
+                    if pf is not None and \
+                            _prefork.FWD_HEADER not in self.headers:
+                        if resp.status == 404 and _prefork.is_worker() \
+                                and method in ("GET", "HEAD"):
+                            # fork-snapshot miss: data written after this
+                            # worker was born is visible to the parent
+                            try:
+                                resp = pf.forward_to_parent(
+                                    method, raw_path, body, self.headers)
+                            except RpcError:
+                                pass  # keep the honest local 404
+                        elif resp.status < 400 and fanout_path:
+                            # whichever process accepted the admin
+                            # request (with SO_REUSEPORT that is a
+                            # non-parent worker (N-1)/N of the time)
+                            # re-delivers it to every peer, parent
+                            # included — drain/leave must never
+                            # dead-end in one process
+                            pf.fanout(method, raw_path, body, self.headers)
                     if resp.status >= 400:
                         sp.status = f"error {resp.status}"
                     if sp.sampled:
@@ -405,6 +525,9 @@ class RpcServer:
                 body = resp.body
                 if isinstance(body, str):
                     body = body.encode()
+                if isinstance(body, FileSlice):
+                    self._reply_file(resp, body)
+                    return
                 if not isinstance(body, (bytes, bytearray, memoryview)):
                     # iterators stream; memoryview bodies (zero-copy
                     # cache hits) take the buffered single-write path —
@@ -438,6 +561,72 @@ class RpcServer:
                 self.wfile.write("".join(head).encode("latin-1"))
                 if self.command != "HEAD":
                     self.wfile.write(body)
+
+            def _reply_file(self, resp: Response, fs: FileSlice):
+                """Write a FileSlice body: buffered head, then
+                os.sendfile from the source fd to the client socket
+                (zero user-space copies).  Falls back to a pread loop
+                when sendfile is disabled/unavailable or refuses the fd
+                pair (e.g. non-regular files)."""
+                try:
+                    srv = Handler._server_line
+                    if not srv:
+                        srv = Handler._server_line = self.version_string()
+                    head = [f"HTTP/1.1 {resp.status} "
+                            f"{_STATUS_PHRASES.get(resp.status, '')}\r\n"
+                            f"Server: {srv}\r\n"
+                            f"Date: {self.date_time_string()}\r\n"
+                            f"Content-Type: {resp.content_type}\r\n"]
+                    if "Content-Length" not in resp.headers:
+                        head.append(f"Content-Length: {fs.length}\r\n")
+                    for k, v in resp.headers.items():
+                        head.append(f"{k}: {v}\r\n")
+                        if k.lower() == "connection" and \
+                                str(v).lower() == "close":
+                            self.close_connection = True
+                    head.append("\r\n")
+                    self.wfile.write("".join(head).encode("latin-1"))
+                    if self.command == "HEAD":
+                        return
+                    self.wfile.flush()  # head must precede spliced bytes
+                    sent = 0
+                    if sendfile_enabled() and hasattr(os, "sendfile"):
+                        out = self.connection.fileno()
+                        try:
+                            while sent < fs.length:
+                                n = os.sendfile(out, fs.fd,
+                                                fs.offset + sent,
+                                                fs.length - sent)
+                                if n == 0:
+                                    break  # source truncated under us
+                                sent += n
+                        except OSError:
+                            if sent:
+                                # mid-transfer failure: the framing is
+                                # already committed, sever the socket
+                                self.close_connection = True
+                                return
+                            sent = -1  # untouched: safe to fall back
+                        if sent > 0:
+                            outer._sendfile_bytes.inc(sent)
+                        if 0 < sent < fs.length:
+                            self.close_connection = True  # short source
+                        if sent >= 0:
+                            return
+                    # pread fallback (WEED_SENDFILE=0, platform without
+                    # sendfile, or sendfile rejected the fd pair)
+                    done = 0
+                    while done < fs.length:
+                        chunk = os.pread(fs.fd,
+                                         min(1 << 20, fs.length - done),
+                                         fs.offset + done)
+                        if not chunk:
+                            self.close_connection = True
+                            break
+                        self.wfile.write(chunk)
+                        done += len(chunk)
+                finally:
+                    fs.close()
 
             def _reply_stream(self, resp: Response, chunks):
                 """Stream an iterator body: raw writes under a known
@@ -537,11 +726,59 @@ class RpcServer:
                     time.sleep(0.01)
                 return False
 
-        self.httpd = Server((host, port), Handler)
+        self._handler_cls = Handler
+        self._server_cls = Server
+        self.httpd = Server((host, port), Handler, bind_and_activate=False)
+        if self._prefork_workers > 1 and _prefork.reuseport_available():
+            # ALL sockets on a port must set SO_REUSEPORT for a later
+            # one to join, so the parent's main listener opts in up
+            # front when workers will shard this port
+            try:
+                self.httpd.socket.setsockopt(
+                    socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+            except OSError:
+                pass
+        try:
+            self.httpd.server_bind()
+            self.httpd.server_activate()
+        except BaseException:
+            self.httpd.server_close()
+            raise
         self.httpd.daemon_threads = True
         self.host = host
         self.port = self.httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
+
+    def _new_listener(self, host: str, port: int, reuseport: bool = False):
+        """Another HTTP server sharing this RpcServer's routes: worker
+        listeners on the shared port (SO_REUSEPORT) and the loopback
+        sidebands the prefork group uses for forwarding/scraping."""
+        srv = self._server_cls((host, port), self._handler_cls,
+                               bind_and_activate=False)
+        srv.daemon_threads = True
+        if reuseport:
+            srv.socket.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+        try:
+            srv.server_bind()
+            srv.server_activate()
+        except BaseException:
+            srv.server_close()
+            raise
+        return srv
+
+    def on_worker_start(self, fn: Callable[[int], None]):
+        """Register a post-fork hook (runs in each worker child before
+        it starts accepting).  Daemons use this to reopen per-process
+        resources — e.g. the filer's sqlite connection, which cannot be
+        shared across a fork."""
+        self._on_worker_start.append(fn)
+
+    def on_worker_start_fire(self, wid: int):
+        for fn in self._on_worker_start:
+            try:
+                fn(wid)
+            except Exception:
+                pass
 
     def _rebuild_match_tables(self):
         """Precompile the route set.  Prefixes with an interior slash
@@ -592,6 +829,8 @@ class RpcServer:
     def _coerce(result) -> Response:
         if isinstance(result, Response):
             return result
+        if isinstance(result, FileSlice):
+            return Response(result)
         if isinstance(result, (dict, list)):
             return Response(json.dumps(result).encode(), 200,
                             "application/json")
@@ -619,8 +858,17 @@ class RpcServer:
         self._thread = threading.Thread(target=self.httpd.serve_forever,
                                         daemon=True)
         self._thread.start()
+        if self._prefork_workers > 1 and self._prefork is None:
+            # the parent keeps serving as worker 0 on the listener it
+            # already owns; N-1 children shard the same port
+            self._prefork = _prefork.PreforkGroup(self,
+                                                  self._prefork_workers)
+            self._prefork.start()
 
     def stop(self):
+        if self._prefork is not None and not _prefork.is_worker():
+            self._prefork.stop()
+            self._prefork = None
         self.httpd.shutdown()
         # sever live keep-alive connections: their handler threads would
         # otherwise keep answering from this daemon's torn-down state
@@ -656,8 +904,48 @@ class _ConnPool:
                  idle_ttl: float = 30.0):
         self._lock = threading.Lock()
         self._idle: dict[str, list] = {}  # addr -> [(conn, stored_at)]
-        self.max_idle = max_idle_per_addr
+        self.max_idle = self._env_max_idle(max_idle_per_addr)
         self.idle_ttl = idle_ttl
+        self._last_sweep = 0.0
+
+    @staticmethod
+    def _env_max_idle(default: int) -> int:
+        raw = os.environ.get("WEED_POOL_MAX_IDLE", "")
+        try:
+            return max(1, int(raw)) if raw else default
+        except ValueError:
+            return default
+
+    def configure_for_prefork(self, workers: int):
+        """Per-process-aware sizing: with N workers on this host, each
+        process keeps 1/N of the per-peer idle budget (floor 2) and
+        reaps idle sockets faster — otherwise N workers hold N full
+        pools against every peer, multiplying its fd load by N."""
+        if workers <= 1:
+            return
+        base = self._env_max_idle(16)
+        trimmed = []
+        with self._lock:
+            self.max_idle = max(2, base // workers)
+            self.idle_ttl = min(self.idle_ttl, 10.0)
+            for idle in self._idle.values():
+                while len(idle) > self.max_idle:
+                    trimmed.append(idle.pop(0)[0])
+        for conn in trimmed:
+            conn.close()
+
+    def reinit_after_fork(self):
+        """Forget every pooled connection WITHOUT closing the sockets,
+        and REPLACE the lock rather than acquire it.  Freshly-forked
+        workers inherit the parent's pooled fds; reusing them would
+        interleave two processes' requests on one TCP stream, and
+        close()ing them here is unnecessary (the child drops its
+        reference either way — the parent still owns the socket).  The
+        lock must not be acquired: the parent keeps serving while it
+        forks, so a child can inherit it mid-hold and would deadlock
+        before ever binding its listener."""
+        self._lock = threading.Lock()
+        self._idle = {}
         self._last_sweep = 0.0
 
     def _sweep(self, now: float):

@@ -748,6 +748,12 @@ class S3ApiServer:
         if method == "HEAD":
             headers["Content-Length"] = str(length)
             return Response(b"", status, content_type, headers)
+        # single-chunk objects resident in the disk cache tier go out
+        # zero-copy via sendfile, same as the filer read path
+        zero = self.filer_server._sendfile_read(
+            entry, start, length, status, content_type, headers)
+        if zero is not None:
+            return zero
         # multi-chunk objects stream through the filer's bounded-window
         # prefetch pipeline: first byte goes out after one chunk fetch
         # regardless of object size

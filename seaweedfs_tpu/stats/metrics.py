@@ -602,6 +602,28 @@ QosLanePreemptionsCounter = REGISTRY.counter(
 QosLaneWaitSecondsCounter = REGISTRY.counter(
     "SeaweedFS_qos_lane_wait_seconds_total",
     "cumulative seconds background batches waited on the foreground lane")
+QosSharedGateOccupancyGauge = REGISTRY.gauge(
+    "SeaweedFS_qos_shared_gate_occupancy",
+    "fleet-wide admission occupancy ((inflight+queued)/limit) read from "
+    "the cross-worker shared-memory gate rows",
+    ("service",))
+
+
+# -- prefork gateway workers (rpc/prefork.py): worker-fleet health and
+# the zero-copy writeback path ----------------------------------------------
+GatewayWorkersGauge = REGISTRY.gauge(
+    "SeaweedFS_gateway_workers",
+    "configured prefork worker processes sharding this gateway's port",
+    ("service",))
+GatewayWorkerRespawnsCounter = REGISTRY.counter(
+    "SeaweedFS_gateway_worker_respawns_total",
+    "crashed gateway workers respawned by the prefork supervisor",
+    ("service",))
+GatewaySendfileBytesCounter = REGISTRY.counter(
+    "SeaweedFS_gateway_sendfile_bytes_total",
+    "response bytes spliced to client sockets with os.sendfile "
+    "(zero-copy writeback), by service",
+    ("service",))
 
 
 # -- cluster elasticity: per-node load telemetry the autoscale
@@ -697,6 +719,56 @@ def metrics_handler(req):
 
     return Response(REGISTRY.expose().encode(),
                     content_type="text/plain; version=0.0.4")
+
+
+def _label_sample(line: str, worker: str) -> str:
+    """Inject worker="<id>" into one exposition sample line.  Split on
+    the LAST space (label values may contain escaped spaces/braces, the
+    value never does)."""
+    sample, _, value = line.rpartition(" ")
+    if not sample:
+        return line
+    if sample.endswith("}"):
+        return f'{sample[:-1]},worker="{worker}"}} {value}'
+    return f'{sample}{{worker="{worker}"}} {value}'
+
+
+def merge_expositions(parts: "list[tuple[str, str]]") -> str:
+    """Merge per-worker /metrics scrapes into one exposition: every
+    sample gains a worker="<id>" label, and each family's # HELP/# TYPE
+    header appears exactly once with ALL workers' samples grouped under
+    it (prometheus parsers reject duplicate family blocks).  `parts` is
+    [(worker_id, exposition_text), ...]; the prefork aggregation route
+    (rpc/prefork.py) feeds it the local registry plus sideband scrapes."""
+    meta: dict = {}          # family -> [help/type lines]
+    samples: dict = {}       # family -> [labeled sample lines]
+    order: list = []         # family first-seen order
+    for worker, text in parts:
+        family = ""
+        for line in text.splitlines():
+            if not line:
+                continue
+            if line.startswith("#"):
+                words = line.split(None, 3)
+                if len(words) >= 3 and words[1] in ("HELP", "TYPE"):
+                    family = words[2]
+                    if family not in meta:
+                        meta[family] = []
+                        samples[family] = []
+                        order.append(family)
+                    if len(meta[family]) < 2:  # HELP + TYPE, once
+                        meta[family].append(line)
+                continue
+            if family not in samples:  # headerless stray sample
+                meta[family] = []
+                samples[family] = []
+                order.append(family)
+            samples[family].append(_label_sample(line, worker))
+    out = []
+    for family in order:
+        out.extend(meta[family])
+        out.extend(samples[family])
+    return "\n".join(out) + "\n"
 
 
 def start_metrics_server(host: str = "127.0.0.1",

@@ -61,6 +61,10 @@ class DiskFile:
             os.close(self._fd)
             self._fd = None
 
+    def fileno(self) -> "int | None":
+        """Raw fd for zero-copy sendfile; None once closed."""
+        return self._fd
+
     @property
     def name(self) -> str:
         return self.path
@@ -139,6 +143,10 @@ class MmapFile:
             os.close(self._fd)
             self._fd = None
 
+    def fileno(self) -> "int | None":
+        """Raw fd for zero-copy sendfile; None once closed."""
+        return self._fd
+
     @property
     def name(self) -> str:
         return self.path
@@ -204,6 +212,9 @@ class TieredFile:
 
     def close(self):
         self._cache.clear()
+
+    def fileno(self) -> "int | None":
+        return None  # remote tier: no local fd to sendfile from
 
     @property
     def name(self) -> str:

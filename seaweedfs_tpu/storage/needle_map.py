@@ -38,6 +38,12 @@ from . import types as t
 
 _IDX_DTYPE = np.dtype([("key", ">u8"), ("off", ">u4"), ("size", ">i4")])
 
+# prefork gateways: the parent process serves all writes while forked
+# workers serve reads from their fork-time map snapshot.  Flushing every
+# idx append lets workers tail the file (refresh_from_idx) to pick up
+# needles written after the fork without any IPC.
+FLUSH_APPENDS = False
+
 
 class NeedleValue:
     __slots__ = ("offset", "size")
@@ -61,9 +67,11 @@ class BaseNeedleMap:
         self.max_key = 0
         self._index_file: Optional[io.BufferedWriter] = None
         self.index_path = index_path
+        self._idx_tail = 0  # bytes of the .idx this map has consumed
         if index_path is not None:
             if os.path.exists(index_path):
                 self._load_from_idx(index_path)
+                self._idx_tail = os.path.getsize(index_path)
             self._index_file = open(index_path, "ab")
 
     # kind-specific storage hooks -------------------------------------------
@@ -127,6 +135,34 @@ class BaseNeedleMap:
     def _append_idx(self, nid: int, offset: int, size: int):
         if self._index_file is not None:
             self._index_file.write(idx_mod.pack_entry(nid, offset, size))
+            self._idx_tail += t.NEEDLE_MAP_ENTRY_SIZE
+            if FLUSH_APPENDS:
+                self._index_file.flush()
+
+    def refresh_from_idx(self) -> int:
+        """Replay entries another process appended to the .idx since this
+        map last read it (prefork workers tailing the parent's writes).
+        Returns the number of entries applied.  Only valid for maps that
+        are not appending concurrently themselves — the prefork design
+        guarantees that by forwarding all writes to the parent."""
+        if self.index_path is None or not os.path.exists(self.index_path):
+            return 0
+        size = os.path.getsize(self.index_path)
+        size -= size % t.NEEDLE_MAP_ENTRY_SIZE
+        if size <= self._idx_tail:
+            return 0
+        applied = 0
+        with open(self.index_path, "rb") as f:
+            f.seek(self._idx_tail)
+            while self._idx_tail + t.NEEDLE_MAP_ENTRY_SIZE <= size:
+                entry = f.read(t.NEEDLE_MAP_ENTRY_SIZE)
+                if len(entry) < t.NEEDLE_MAP_ENTRY_SIZE:
+                    break
+                nid, off, sz = idx_mod.unpack_entry(entry)
+                self._apply(nid, off, sz)
+                self._idx_tail += t.NEEDLE_MAP_ENTRY_SIZE
+                applied += 1
+        return applied
 
     # -- query --------------------------------------------------------------
     def get(self, nid: int) -> Optional[NeedleValue]:

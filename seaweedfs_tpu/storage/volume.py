@@ -450,6 +450,75 @@ class Volume:
     def read_needle_blob(self, offset: int, size: int) -> bytes:
         return self.data.read_at(get_actual_size(size, self.version), offset)
 
+    def read_needle_slice(self, nid: int, cookie: Optional[int] = None,
+                          min_size: int = 0):
+        """Zero-copy read: ``(needle, data_offset, data_length, fd)``
+        where `needle` carries full metadata (flags/name/mime/etag/TTL)
+        but an EMPTY data field — the payload is meant to go straight
+        from the .dat to the socket via sendfile.  Returns None when the
+        record is not eligible (v1 volume, remote tier, compressed or
+        manifest payload, below `min_size`) so the caller falls back to
+        read_needle(); raises the same errors as read_needle for
+        missing/deleted/expired needles.  The returned fd is dup'd — the
+        caller owns it and must close it — so a racing vacuum commit that
+        swaps the .dat cannot invalidate an in-flight transfer."""
+        from .needle import VERSION1, VERSION3
+
+        with self.lock:
+            if self.version == VERSION1:
+                return None
+            fileno = getattr(self.data, "fileno", None)
+            raw_fd = fileno() if fileno is not None else None
+            if raw_fd is None:
+                return None  # remote tier (or closed handle)
+            nv = self.nm.get(nid)
+            if nv is None or nv.offset == 0:
+                raise NotFoundError(f"needle {nid:x} not found")
+            if t.size_is_deleted(nv.size):
+                raise DeletedError(f"needle {nid:x} already deleted")
+            if nv.size <= 0:
+                return None  # empty payload: nothing to sendfile
+            head = self.data.read_at(t.NEEDLE_HEADER_SIZE + 4, nv.offset)
+            if len(head) < t.NEEDLE_HEADER_SIZE + 4:
+                raise NotFoundError(f"needle {nid:x}: truncated record")
+            n = Needle()
+            n.parse_header(head)
+            if n.size != nv.size:
+                return None  # index/data divergence: read_needle reports it
+            data_size = int.from_bytes(
+                head[t.NEEDLE_HEADER_SIZE:t.NEEDLE_HEADER_SIZE + 4], "big")
+            if data_size < min_size or data_size == 0:
+                return None
+            # the metadata sections, CRC and (v3) appendAtNs trail the data
+            meta_len = n.size - 4 - data_size
+            tail_len = meta_len + t.NEEDLE_CHECKSUM_SIZE
+            if self.version == VERSION3:
+                tail_len += t.TIMESTAMP_SIZE
+            tail_off = nv.offset + t.NEEDLE_HEADER_SIZE + 4 + data_size
+            tail = self.data.read_at(tail_len, tail_off)
+            if len(tail) < tail_len:
+                raise NotFoundError(f"needle {nid:x}: truncated record")
+            # a synthetic zero-length dataSize prefix parses just the
+            # metadata sections into the needle, skipping the payload
+            n._parse_body_v2(b"\x00\x00\x00\x00" + tail[:meta_len])
+            n.data = b""
+            # stored CRC, unverified (the payload never enters memory);
+            # the write path stores the raw value, so the etag matches
+            n.checksum = int.from_bytes(tail[meta_len:meta_len + 4], "big")
+            if self.version == VERSION3:
+                n.append_at_ns = int.from_bytes(tail[meta_len + 4:], "big")
+            if cookie is not None and n.cookie != cookie:
+                raise CookieMismatchError(
+                    f"cookie mismatch for needle {nid:x}")
+            if n.is_compressed or n.is_chunk_manifest:
+                return None  # the response path needs these in memory
+            if n.has_ttl and self.ttl and n.last_modified:
+                expiry = n.last_modified + self.ttl.minutes() * 60
+                if time.time() >= expiry:
+                    raise NotFoundError(f"needle {nid:x} expired")
+            fd = os.dup(raw_fd)
+        return n, nv.offset + t.NEEDLE_HEADER_SIZE + 4, data_size, fd
+
     # -- scan (export/fsck support; volume_read.go:213-232) ------------------
     def scan(self):
         """Yield (needle, offset) for every record in the .dat, in file order."""

@@ -27,8 +27,10 @@ import numpy as np
 
 from .. import profiling, qos, tracing
 from ..rpc import policy
-from ..rpc.http_rpc import (Request, Response, RpcError, RpcServer, call,
-                            call_stream, stream_file)
+from ..rpc import prefork as _prefork
+from ..rpc.http_rpc import (FileSlice, Request, Response, RpcError,
+                            RpcServer, call, call_stream, sendfile_enabled,
+                            stream_file)
 from ..util import faults
 from ..security import Guard, gen_write_jwt, token_from_request
 from ..stats import metrics as stats
@@ -230,6 +232,17 @@ class VolumeServer:
             for conf in tier_backends:
                 tier.register_tier_backend(conf)
         self.server = RpcServer(host, port, service_name="volume")
+        if self.server._prefork_workers > 1:
+            # workers serve reads from their fork-time needle-map
+            # snapshot and tail the .idx for needles the parent wrote
+            # after the fork — which requires unbuffered idx appends
+            from ..storage import needle_map as _needle_map
+
+            _needle_map.FLUSH_APPENDS = True
+        # drain/leave must reach every prefork worker, not just the
+        # parent that executed them
+        self.server.fanout_prefixes.update({"/admin/drain",
+                                            "/admin/leave"})
         # the configured seed list survives leader redirects so a dead
         # leader never strands the heartbeat loop
         self._seed_masters = [m for m in master_address.split(",") if m]
@@ -896,7 +909,12 @@ class VolumeServer:
     def _handle_object_accounted(self, method: str, req: Request):
         out = self._handle_object_inner(method, req)
         body = getattr(out, "body", out)
-        n = len(body) if isinstance(body, (bytes, bytearray)) else 0
+        if isinstance(body, (bytes, bytearray)):
+            n = len(body)
+        elif isinstance(body, FileSlice):
+            n = body.length
+        else:
+            n = 0
         if method in ("POST", "PUT"):
             n += len(req.body or b"")
         with self._tele_lock:
@@ -993,13 +1011,20 @@ class VolumeServer:
             # volume not local: readMode local|proxy|redirect
             # (volume_server_handlers_read.go:30-70)
             return self._read_nonlocal(vid, method, req, fid)
+        self._refresh_worker_view(v)
         n = self._cached_needle(v, vid, nid, cookie)
+        if n is None:
+            resp = self._sendfile_read(v, vid, nid, cookie, method, req)
+            if resp is not None:
+                return resp
         if n is None:
             nv_before = v.nm.get(nid) if v is not None else None
             try:
                 n = self.store.read_needle(vid, nid, cookie=cookie)
             except (NotFoundError, EcNotFoundError):
-                raise RpcError("not found", 404)
+                n = self._retry_after_idx_refresh(v, vid, nid, cookie)
+                if n is None:
+                    raise RpcError("not found", 404)
             except (DeletedError, EcDeletedError):
                 raise RpcError("already deleted", 404)
             except (CookieMismatchError,) as e:
@@ -1012,6 +1037,125 @@ class VolumeServer:
             return self._build_read_response(n, method, req)
         finally:
             self.download_gate.release(len(n.data))
+
+    def _refresh_worker_view(self, v):
+        """Prefork worker: tail the .idx BEFORE resolving a read, not
+        only on a local miss — deletes and overwrites the parent
+        applied after the fork still RESOLVE in this worker's stale
+        snapshot (to the old offset/size), so a miss-only refresh would
+        serve deleted or superseded bytes indefinitely
+        (DELETE-then-GET returning 200 with the old data).  The
+        no-news case is one fstat: refresh_from_idx compares the .idx
+        size against the consumed tail and returns without reading."""
+        if v is None or not _prefork.is_worker():
+            return
+        refresh = getattr(v.nm, "refresh_from_idx", None)
+        if refresh is None:
+            return  # native map: the HTTP-layer parent retry covers it
+        with v.lock:
+            try:
+                refresh()
+            except OSError:
+                pass  # racing a vacuum's .idx swap: serve the snapshot
+
+    def _retry_after_idx_refresh(self, v, vid: int, nid: int,
+                                 cookie: int):
+        """Prefork worker: a needle-map miss may be a needle the parent
+        wrote after our fork.  Tail the (flush-per-append) .idx and
+        retry once before 404ing; the HTTP layer additionally retries
+        residual misses against the parent process."""
+        if not _prefork.is_worker() or v is None:
+            return None
+        refresh = getattr(v.nm, "refresh_from_idx", None)
+        if refresh is None:
+            return None  # native map: the HTTP-layer retry covers it
+        with v.lock:
+            applied = refresh()
+        if not applied:
+            return None
+        try:
+            return self.store.read_needle(vid, nid, cookie=cookie)
+        except (VolumeError, EcNotFoundError, EcDeletedError):
+            return None
+
+    def _sendfile_read(self, v, vid: int, nid: int, cookie: int,
+                       method: str, req: Request):
+        """Zero-copy GET: a big uncompressed needle goes straight from
+        the .dat to the client socket via sendfile — the payload never
+        enters Python.  Small needles (below WEED_SENDFILE_MIN) keep the
+        buffered path so they still populate the RAM needle cache, which
+        is faster for them than a syscall round trip.  Returns None to
+        fall back to the buffered path (which also owns all error
+        reporting: any storage error here falls through to it)."""
+        if v is None or not sendfile_enabled():
+            return None
+        try:
+            min_size = int(
+                os.environ.get("WEED_SENDFILE_MIN", "") or 65536)
+        except ValueError:
+            min_size = 65536
+        try:
+            sliced = v.read_needle_slice(nid, cookie, min_size=min_size)
+        except VolumeError:
+            return None
+        if sliced is None:
+            return None
+        n, data_off, data_len, fd = sliced
+        fd_owned = True  # until closed here or handed to a FileSlice
+        try:
+            headers = {"Etag": f'"{n.etag()}"', "Accept-Ranges": "bytes"}
+            if n.has_name:
+                headers["X-File-Name"] = n.name.decode(errors="replace")
+            if n.last_modified:
+                headers["X-Last-Modified"] = str(n.last_modified)
+            content_type = (n.mime.decode(errors="replace") if n.has_mime
+                            else "application/octet-stream")
+            status = 200
+            offset, length = data_off, data_len
+            range_header = req.headers.get("Range")
+            if range_header:
+                r = _parse_range(range_header, data_len)
+                if r is None:
+                    fd_owned = False
+                    os.close(fd)
+                    return Response(
+                        b"", 416, content_type,
+                        {"Content-Range": f"bytes */{data_len}"})
+                if r is not ...:  # a single satisfiable range
+                    start, end = r
+                    headers["Content-Range"] = (
+                        f"bytes {start}-{end - 1}/{data_len}")
+                    offset, length = data_off + start, end - start
+                    status = 206
+            if not self.download_gate.acquire(length):
+                fd_owned = False
+                os.close(fd)
+                stats.VolumeServerThrottleRejects.labels("download").inc()
+                raise RpcError("too many requests: download limit", 429)
+            gate = self.download_gate
+            try:
+                if method == "HEAD":
+                    fd_owned = False
+                    os.close(fd)
+                    gate.release(length)
+                    headers["Content-Length"] = str(length)
+                    return Response(b"", status, content_type, headers)
+                # the gate must be held for the TRANSFER's lifetime:
+                # the bytes move in _reply_file AFTER this handler
+                # returns, and -concurrentDownloadLimitMB exists to
+                # bound in-flight bytes for exactly these large reads —
+                # FileSlice.close() (the reply path's finally) releases
+                body = FileSlice(fd, offset, length, close_fd=True,
+                                 on_close=lambda: gate.release(length))
+                fd_owned = False  # the reply path closes it
+                return Response(body, status, content_type, headers)
+            except BaseException:
+                gate.release(length)
+                raise
+        except BaseException:
+            if fd_owned:
+                os.close(fd)
+            raise
 
     def _cached_needle(self, v, vid: int, nid: int, cookie: int):
         """Serve a needle read out of the unified read cache when the

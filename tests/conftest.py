@@ -55,11 +55,26 @@ def pytest_collection_modifyitems(config, items):
     mesh and silently not exercise the sharded path."""
     import pytest
 
-    if jax.device_count() >= 4:
-        return
-    skip = pytest.mark.skip(
-        reason=f"multidevice needs >=4 devices, backend has "
-               f"{jax.device_count()}")
-    for item in items:
-        if "multidevice" in item.keywords:
-            item.add_marker(skip)
+    if jax.device_count() < 4:
+        skip = pytest.mark.skip(
+            reason=f"multidevice needs >=4 devices, backend has "
+                   f"{jax.device_count()}")
+        for item in items:
+            if "multidevice" in item.keywords:
+                item.add_marker(skip)
+
+    # @pytest.mark.multiproc forks real prefork gateway workers; on a
+    # 1-core box the workers time-slice one CPU and the sharding/chaos
+    # assertions measure the scheduler.  WEED_TEST_FORCE_MULTIPROC=1
+    # overrides for boxes where affinity under-reports.
+    try:
+        cores = len(os.sched_getaffinity(0))
+    except AttributeError:
+        cores = os.cpu_count() or 1
+    if cores < 2 and os.environ.get("WEED_TEST_FORCE_MULTIPROC") != "1":
+        skip_mp = pytest.mark.skip(
+            reason=f"multiproc needs >=2 cores, have {cores} "
+                   "(set WEED_TEST_FORCE_MULTIPROC=1 to force)")
+        for item in items:
+            if "multiproc" in item.keywords:
+                item.add_marker(skip_mp)

@@ -225,5 +225,14 @@ class TestGrafanaDashboard:
                 "SeaweedFS_ec_inline_stripe_commit_seconds_bucket",
                 "SeaweedFS_ec_inline_bytes_total"):
             assert token in joined, f"no Inline EC panel queries {token}"
+        # the Gateway workers row queries the prefork families
+        for token in (
+                "SeaweedFS_gateway_workers",
+                "SeaweedFS_gateway_worker_respawns_total",
+                "SeaweedFS_qos_shared_gate_occupancy",
+                "SeaweedFS_gateway_sendfile_bytes_total"):
+            assert token in joined, \
+                f"no Gateway workers panel queries {token}"
         titles = [p.get("title") for p in dashboard["panels"]]
         assert "Inline EC" in titles
+        assert "Gateway workers" in titles

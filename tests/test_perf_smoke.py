@@ -302,3 +302,24 @@ def test_read_cache_warm_storm_beats_cold():
     assert 0.0 < fc["hit_ratio"] <= 1.0
     assert set(fc["tier_hits"]) == {"hbm", "ram", "disk"}
     assert set(fc["fills"]) == {"admitted", "qos_bypass"}
+
+
+@pytest.mark.multiproc
+def test_gateway_worker_curve_smoke():
+    """Mini bench_gateway_workers (1 and 2 workers, reduced storm):
+    sharding the volume gateway across 2 processes must buy >= 1.5x
+    the single-process smallfile read rate.  Only meaningful with real
+    parallelism — the multiproc marker auto-skips below 2 cores, the
+    same gate the bench's own `gated` flag reports (retried once for
+    scheduler noise on loaded CI boxes)."""
+    import bench
+
+    out = {}
+    for attempt in range(2):
+        out = bench.bench_gateway_workers(counts=(1, 2), num_files=120,
+                                          read_reqs=600)
+        if out.get("speedup_2x", 0) >= 1.5:
+            break
+    assert out["gated"] is True
+    assert out["counts"].get("1") and out["counts"].get("2"), out
+    assert out["speedup_2x"] >= 1.5, out

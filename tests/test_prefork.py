@@ -1,0 +1,547 @@
+"""Multi-process gateway front end: SO_REUSEPORT worker sharding.
+
+Three tiers:
+
+* pure-unit checks of the knob parsing, FileSlice zero-copy bodies,
+  and the per-process-aware connection pool sizing;
+* a single-process smoke that a combined ``weed server -filer -s3``
+  with ``WEED_HTTP_WORKERS=1`` brings every daemon up byte-identical
+  to the unsharded build (the 1-core-harness acceptance bar);
+* ``@pytest.mark.multiproc`` chaos slices against a real 2-worker
+  prefork fleet — registry contents, SIGKILL-one-worker respawn with
+  zero failed foreground reads, and no leaked shm/registry after a
+  graceful stop.  These auto-skip below 2 usable cores (conftest).
+"""
+
+import http.client
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from seaweedfs_tpu.rpc import prefork
+from seaweedfs_tpu.rpc.http_rpc import (FileSlice, Response, RpcServer,
+                                        _ConnPool, call, sendfile_enabled)
+from seaweedfs_tpu.stats import metrics as stats
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestWorkerKnobs:
+    def test_worker_count_parsing(self, monkeypatch):
+        monkeypatch.delenv("WEED_HTTP_WORKERS", raising=False)
+        assert prefork.worker_count() == 1
+        monkeypatch.setenv("WEED_HTTP_WORKERS", "4")
+        assert prefork.worker_count() == 4
+        monkeypatch.setenv("WEED_HTTP_WORKERS", "0")
+        assert prefork.worker_count() == 1
+        monkeypatch.setenv("WEED_HTTP_WORKERS", "not-a-number")
+        assert prefork.worker_count() == 1
+
+    def test_platform_probes(self):
+        assert isinstance(prefork.reuseport_available(), bool)
+        assert isinstance(prefork.fork_available(), bool)
+        assert prefork.role() in ("solo", "parent", "worker")
+
+    def test_port_zero_server_never_preforks(self, monkeypatch):
+        """Ephemeral port-0 servers (test fixtures, embedded sidecars)
+        must not fork the host process even with workers configured."""
+        monkeypatch.setenv("WEED_HTTP_WORKERS", "4")
+        s = RpcServer("127.0.0.1", 0, service_name="prefork-t")
+        try:
+            assert s._prefork_workers == 1
+        finally:
+            s.httpd.server_close()
+
+
+@pytest.fixture
+def slice_server(tmp_path):
+    payload = bytes(range(256)) * 64  # 16 KiB
+    blob = tmp_path / "blob.bin"
+    blob.write_bytes(payload)
+    server = RpcServer("127.0.0.1", 0, service_name="slice-t")
+
+    def handler(req):
+        fd = os.open(str(blob), os.O_RDONLY)
+        return Response(FileSlice(fd, 64, 4096, close_fd=True),
+                        content_type="application/octet-stream")
+
+    server.add("GET", "/slice", handler)
+    server.start()
+    yield server, payload
+    server.stop()
+
+
+class TestFileSlice:
+    def test_read_bytes_is_pread(self, tmp_path):
+        blob = tmp_path / "b.bin"
+        blob.write_bytes(b"0123456789abcdef")
+        fd = os.open(str(blob), os.O_RDONLY)
+        fs = FileSlice(fd, 4, 8, close_fd=True)
+        assert fs.read_bytes() == b"456789ab"
+        fs.close()
+        assert fs.fd == -1
+        fs.close()  # idempotent
+
+    def test_close_fd_false_leaves_fd_open(self, tmp_path):
+        blob = tmp_path / "b.bin"
+        blob.write_bytes(b"hello")
+        fd = os.open(str(blob), os.O_RDONLY)
+        try:
+            fs = FileSlice(fd, 0, 5)
+            fs.close()
+            assert os.pread(fd, 5, 0) == b"hello"  # still usable
+        finally:
+            os.close(fd)
+
+    def test_on_close_fires_exactly_once(self, tmp_path):
+        """Gate releases ride on_close — the download throttle stays
+        held for the transfer's lifetime and must release exactly once
+        even when close() is called twice (reply finally + GC)."""
+        blob = tmp_path / "b.bin"
+        blob.write_bytes(b"payload")
+        fired = []
+        fd = os.open(str(blob), os.O_RDONLY)
+        fs = FileSlice(fd, 0, 7, close_fd=True,
+                       on_close=lambda: fired.append(1))
+        assert fired == []  # held across construction and reads
+        assert fs.read_bytes() == b"payload"
+        assert fired == []
+        fs.close()
+        assert fired == [1]
+        fs.close()
+        assert fired == [1]
+
+    def test_sendfile_reply_over_the_wire(self, slice_server):
+        server, payload = slice_server
+        assert sendfile_enabled()
+        got = call(server.address, "/slice", parse=False)
+        assert got == payload[64:64 + 4096]
+
+    def test_pread_fallback_when_disabled(self, slice_server, monkeypatch):
+        monkeypatch.setenv("WEED_SENDFILE", "0")
+        assert not sendfile_enabled()
+        server, payload = slice_server
+        got = call(server.address, "/slice", parse=False)
+        assert got == payload[64:64 + 4096]
+
+
+class _FakeConn:
+    def __init__(self):
+        self.closed = False
+
+    def close(self):
+        self.closed = True
+
+
+class TestConnPoolPrefork:
+    def test_divides_idle_budget_across_workers(self):
+        pool = _ConnPool()
+        assert pool.max_idle == 16
+        pool.configure_for_prefork(4)
+        assert pool.max_idle == 4
+        assert pool.idle_ttl <= 10.0
+
+    def test_idle_floor_of_two(self):
+        pool = _ConnPool()
+        pool.configure_for_prefork(32)
+        assert pool.max_idle == 2
+
+    def test_single_worker_is_a_noop(self):
+        pool = _ConnPool()
+        pool.configure_for_prefork(1)
+        assert pool.max_idle == 16
+        assert pool.idle_ttl == 30.0
+
+    def test_env_override_feeds_the_split(self, monkeypatch):
+        monkeypatch.setenv("WEED_POOL_MAX_IDLE", "8")
+        pool = _ConnPool()
+        assert pool.max_idle == 8
+
+    def test_configure_trims_excess_idle(self):
+        pool = _ConnPool()
+        conns = [_FakeConn() for _ in range(10)]
+        now = time.monotonic()
+        pool._idle["peer:80"] = [(c, now) for c in conns]
+        pool.configure_for_prefork(4)  # budget drops 16 -> 4
+        assert len(pool._idle["peer:80"]) == 4
+        assert sum(c.closed for c in conns) == 6
+
+    def test_reinit_after_fork_forgets_without_closing(self):
+        """Forked children drop inherited pooled sockets but must NOT
+        close them — the parent still owns those TCP streams.  The
+        inherited lock is REPLACED, never acquired: it may have been
+        held by a parent thread at fork time, and acquiring it would
+        deadlock the child before it ever binds."""
+        pool = _ConnPool()
+        conn = _FakeConn()
+        pool._idle["peer:80"] = [(conn, time.monotonic())]
+        inherited = pool._lock
+        inherited.acquire()  # simulate mid-sweep parent thread at fork
+        try:
+            pool.reinit_after_fork()  # must not block
+        finally:
+            inherited.release()
+        assert pool._idle == {}
+        assert not conn.closed
+        assert pool._lock is not inherited
+
+
+class TestMergeExpositions:
+    def test_worker_labels_and_single_header_per_family(self):
+        a = ('# HELP m_total things\n# TYPE m_total counter\n'
+             'm_total{service="volume"} 1\nplain_gauge 5\n')
+        b = ('# HELP m_total things\n# TYPE m_total counter\n'
+             'm_total{service="volume"} 2\n')
+        merged = stats.merge_expositions([("0", a), ("1", b)])
+        assert merged.count("# HELP m_total") == 1
+        assert merged.count("# TYPE m_total") == 1
+        assert 'm_total{service="volume",worker="0"} 1' in merged
+        assert 'm_total{service="volume",worker="1"} 2' in merged
+        assert 'plain_gauge{worker="0"} 5' in merged
+
+
+# -- live weed.py subprocesses ----------------------------------------------
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _start_weed(args, env_extra, log_path):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", **env_extra)
+    log = open(log_path, "w")
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(REPO_ROOT, "weed.py")] + args,
+        env=env, cwd=REPO_ROOT, stdout=log, stderr=subprocess.STDOUT)
+    log.close()
+    return proc
+
+
+def _stop_weed(proc):
+    if proc.poll() is None:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=20)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=10)
+
+
+def _wait_for_volume(master_addr, proc, log_path, timeout=120.0):
+    """Poll the master until a volume server has registered."""
+    deadline = time.monotonic() + timeout
+    last_err = None
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            with open(log_path) as f:
+                tail = f.read()[-2000:]
+            raise AssertionError(
+                f"weed server exited rc={proc.returncode}:\n{tail}")
+        try:
+            topo = call(master_addr, "/dir/status", timeout=5)
+            nodes = [n for dc in topo.get("datacenters", [])
+                     for r in dc.get("racks", [])
+                     for n in r.get("nodes", [])]
+            if nodes:
+                return
+        except Exception as e:  # noqa: BLE001 - starting up
+            last_err = e
+        time.sleep(0.2)
+    raise AssertionError(f"volume never registered: {last_err}")
+
+
+def _write_read_roundtrip(master_addr, payload):
+    a = call(master_addr, "/dir/assign")
+    fid, url = a["fid"], a["url"]
+    call(url, "/" + fid, raw=payload, method="POST")
+    assert call(url, "/" + fid, parse=False) == payload
+    return fid, url
+
+
+def test_every_daemon_starts_with_one_worker(tmp_path):
+    """Acceptance bar: WEED_HTTP_WORKERS=1 behaves byte-identically to
+    the unsharded build — a combined master+volume+filer+s3 process
+    comes up single-process and every daemon serves its surface."""
+    data = tmp_path / "data"
+    data.mkdir()
+    mport, vport, fport, sport = (_free_port() for _ in range(4))
+    log_path = tmp_path / "weed.log"
+    proc = _start_weed(
+        ["server", "-ip", "127.0.0.1", "-dir", str(data),
+         "-masterPort", str(mport), "-volumePort", str(vport),
+         "-filer", "-filerPort", str(fport),
+         "-s3", "-s3Port", str(sport)],
+        {"WEED_HTTP_WORKERS": "1"}, log_path)
+    master = f"127.0.0.1:{mport}"
+    payload = b"one-worker-smoke" * 64
+    try:
+        _wait_for_volume(master, proc, log_path)
+        # volume read/write path
+        _write_read_roundtrip(master, payload)
+        # filer path
+        filer = f"127.0.0.1:{fport}"
+        call(filer, "/t/hello.bin", raw=payload, method="POST")
+        assert call(filer, "/t/hello.bin", parse=False) == payload
+        # s3 path answers (service listing is XML)
+        s3 = f"127.0.0.1:{sport}"
+        body = call(s3, "/", parse=False)
+        assert b"ListAllMyBucketsResult" in body
+        # single process: fleet gauge reports 1 worker, no respawns
+        metrics = call(master, "/metrics")
+        if isinstance(metrics, (bytes, bytearray)):
+            metrics = metrics.decode()
+        assert "SeaweedFS_gateway_workers" in metrics
+    finally:
+        _stop_weed(proc)
+
+
+@pytest.mark.multiproc
+def test_prefork_fleet_registry_and_chaos(tmp_path):
+    """2-worker volume+master fleet: the registry lists every worker
+    with a live pid and the shared QoS segment; SIGKILLing a worker
+    respawns it while foreground reads keep succeeding; a graceful
+    SIGTERM tears down the shm segment and the registry dir."""
+    data = tmp_path / "data"
+    data.mkdir()
+    registry_base = tmp_path / "registry"
+    registry_base.mkdir()
+    mport, vport = _free_port(), _free_port()
+    log_path = tmp_path / "weed.log"
+    proc = _start_weed(
+        ["server", "-ip", "127.0.0.1", "-dir", str(data),
+         "-masterPort", str(mport), "-volumePort", str(vport)],
+        {"WEED_HTTP_WORKERS": "2",
+         "WEED_PREFORK_DIR": str(registry_base)}, log_path)
+    master = f"127.0.0.1:{mport}"
+    payload = os.urandom(2048)
+    shm_names = []
+    try:
+        _wait_for_volume(master, proc, log_path)
+        fid, url = _write_read_roundtrip(master, payload)
+
+        # the master's raft/topology state lives only in worker 0 —
+        # its read replicas must proxy /dir/* there, so EVERY assign
+        # succeeds no matter which worker's socket accepts it (fresh
+        # connection per request to spread across the fleet)
+        mhost, mport_ = master.split(":")
+        for _ in range(20):
+            conn = http.client.HTTPConnection(mhost, int(mport_),
+                                              timeout=10)
+            try:
+                conn.request("GET", "/dir/assign")
+                body = json.loads(conn.getresponse().read())
+            finally:
+                conn.close()
+            assert "fid" in body, body
+
+        # every HTTP listener (master AND volume) sharded into its own
+        # registry dir; each holds w0+w1 entries with live pids
+        groups = sorted(os.listdir(registry_base))
+        assert any(g.startswith("volume-") for g in groups), groups
+        assert any(g.startswith("master-") for g in groups), groups
+
+        def entries(group):
+            out = {}
+            for name in os.listdir(registry_base / group):
+                if name.startswith("w") and name.endswith(".json"):
+                    with open(registry_base / group / name) as f:
+                        out[name] = json.load(f)
+            return out
+
+        vol_group = next(g for g in groups if g.startswith("volume-"))
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            ent = entries(vol_group)
+            if "w0.json" in ent and "w1.json" in ent:
+                break
+            time.sleep(0.2)
+        ent = entries(vol_group)
+        assert set(ent) >= {"w0.json", "w1.json"}, ent
+        for e in ent.values():
+            os.kill(e["pid"], 0)  # pid is alive
+        assert ent["w0.json"]["pid"] == proc.pid  # parent IS worker 0
+
+        # shared QoS segment advertised and present under /dev/shm
+        for group in groups:
+            shm_meta = registry_base / group / "qos_shm.json"
+            if shm_meta.exists():
+                with open(shm_meta) as f:
+                    shm_names.append(json.load(f)["name"])
+        assert shm_names, "no group advertised a qos shm segment"
+        for name in shm_names:
+            assert os.path.exists("/dev/shm/" + name.lstrip("/")), name
+
+        # chaos: SIGKILL worker 1 of the volume fleet; foreground reads
+        # must not fail while the supervisor respawns it
+        victim = ent["w1.json"]["pid"]
+        os.kill(victim, signal.SIGKILL)
+        respawned = None
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            got = call(url, "/" + fid, parse=False)
+            assert got == payload, "foreground read failed during respawn"
+            now = entries(vol_group).get("w1.json")
+            if now and now["pid"] != victim:
+                respawned = now
+                break
+            time.sleep(0.1)
+        assert respawned is not None, "worker 1 never respawned"
+        os.kill(respawned["pid"], 0)
+        for _ in range(20):  # reads stay clean on the respawned fleet
+            assert call(url, "/" + fid, parse=False) == payload
+
+        # the respawn is visible on the aggregated exposition
+        metrics = call(url, "/metrics")
+        if isinstance(metrics, (bytes, bytearray)):
+            metrics = metrics.decode()
+        assert "SeaweedFS_gateway_worker_respawns_total" in metrics
+    finally:
+        _stop_weed(proc)
+
+    # graceful stop left nothing behind: no shm segment, no registry
+    for name in shm_names:
+        assert not os.path.exists("/dev/shm/" + name.lstrip("/")), \
+            f"leaked shm segment {name}"
+    assert os.listdir(registry_base) == [], "leaked prefork registry"
+
+
+@pytest.mark.multiproc
+def test_sharded_reads_spread_across_workers(tmp_path):
+    """GETs against a 2-worker volume port land on more than one
+    process (per-worker counters in the merged exposition)."""
+    data = tmp_path / "data"
+    data.mkdir()
+    mport, vport = _free_port(), _free_port()
+    log_path = tmp_path / "weed.log"
+    proc = _start_weed(
+        ["server", "-ip", "127.0.0.1", "-dir", str(data),
+         "-masterPort", str(mport), "-volumePort", str(vport)],
+        {"WEED_HTTP_WORKERS": "2"}, log_path)
+    master = f"127.0.0.1:{mport}"
+    payload = os.urandom(1024)
+    try:
+        _wait_for_volume(master, proc, log_path)
+        fid, url = _write_read_roundtrip(master, payload)
+        # fresh TCP connection per GET: the keep-alive pool would pin
+        # every request to whichever worker accepted the first one,
+        # while SO_REUSEPORT spreads new connections by 4-tuple hash
+        host, port = url.split(":")
+        for _ in range(80):
+            conn = http.client.HTTPConnection(host, int(port), timeout=10)
+            try:
+                conn.request("GET", "/" + fid)
+                assert conn.getresponse().read() == payload
+            finally:
+                conn.close()
+        metrics = call(url, "/metrics")
+        if isinstance(metrics, (bytes, bytearray)):
+            metrics = metrics.decode()
+        workers = set()
+        for line in metrics.splitlines():
+            if line.startswith("SeaweedFS_volumeServer_request_total{"):
+                m = [kv for kv in line.split("{", 1)[1].split("}")[0]
+                     .split(",") if kv.startswith("worker=")]
+                if m:
+                    workers.add(m[0])
+        assert len(workers) == 2, \
+            f"merged exposition shows workers {workers}"
+    finally:
+        _stop_weed(proc)
+
+
+@pytest.mark.multiproc
+def test_drain_fans_out_from_a_forked_worker(tmp_path):
+    """/admin/drain landing on worker 1 (not the parent) must still
+    reach the WHOLE fleet — with SO_REUSEPORT the kernel hands
+    (N-1)/N of admin requests to forked workers, so fanout has to run
+    from whichever process accepted, not only from the parent.  We
+    deliver straight to worker 1's sideband (same routes, no FWD
+    header) to pin the accept deterministically, then require every
+    worker's draining gauge to flip in the merged exposition."""
+    data = tmp_path / "data"
+    data.mkdir()
+    registry_base = tmp_path / "registry"
+    registry_base.mkdir()
+    mport, vport = _free_port(), _free_port()
+    log_path = tmp_path / "weed.log"
+    proc = _start_weed(
+        ["server", "-ip", "127.0.0.1", "-dir", str(data),
+         "-masterPort", str(mport), "-volumePort", str(vport)],
+        {"WEED_HTTP_WORKERS": "2",
+         "WEED_PREFORK_DIR": str(registry_base)}, log_path)
+    master = f"127.0.0.1:{mport}"
+    url = f"127.0.0.1:{vport}"
+    try:
+        _wait_for_volume(master, proc, log_path)
+        _write_read_roundtrip(master, os.urandom(512))
+
+        vol_group = None
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            groups = [g for g in os.listdir(registry_base)
+                      if g.startswith("volume-")]
+            if groups:
+                w1 = registry_base / groups[0] / "w1.json"
+                if w1.exists():
+                    vol_group = registry_base / groups[0]
+                    break
+            time.sleep(0.2)
+        assert vol_group is not None, "volume worker 1 never registered"
+        with open(vol_group / "w1.json") as f:
+            w1_sideband = json.load(f)["sideband"]
+
+        # the request lands on worker 1, never touching the parent's
+        # accept queue — exactly what SO_REUSEPORT does most of the time
+        resp = call(w1_sideband, "/admin/drain",
+                    payload={"draining": True}, method="POST")
+        assert resp.get("draining") is True, resp
+
+        def draining_workers():
+            metrics = call(url, "/metrics")
+            if isinstance(metrics, (bytes, bytearray)):
+                metrics = metrics.decode()
+            out = {}
+            for line in metrics.splitlines():
+                if line.startswith("SeaweedFS_volumeServer_draining{"):
+                    labels = line.split("{", 1)[1].split("}")[0]
+                    wid = [kv.split("=")[1].strip('"')
+                           for kv in labels.split(",")
+                           if kv.startswith("worker=")]
+                    if wid:
+                        out[wid[0]] = float(line.rsplit(None, 1)[1])
+            return out
+
+        deadline = time.monotonic() + 30
+        seen = {}
+        while time.monotonic() < deadline:
+            seen = draining_workers()
+            if seen.get("0") == 1.0 and seen.get("1") == 1.0:
+                break
+            time.sleep(0.2)
+        assert seen.get("1") == 1.0, \
+            f"receiving worker never drained: {seen}"
+        assert seen.get("0") == 1.0, \
+            f"drain on worker 1 did not fan out to the parent: {seen}"
+
+        # and the undo fans out the same way
+        call(w1_sideband, "/admin/drain",
+             payload={"draining": False}, method="POST")
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            seen = draining_workers()
+            if seen.get("0") == 0.0 and seen.get("1") == 0.0:
+                break
+            time.sleep(0.2)
+        assert seen == {"0": 0.0, "1": 0.0}, seen
+    finally:
+        _stop_weed(proc)

@@ -1,0 +1,271 @@
+"""Shared-memory QoS state: fleet-wide token buckets, DRR deficits,
+and admission-gate occupancy shared across real processes.
+
+The cross-process tests attach a genuine second interpreter to the
+segment (subprocess, not fork — the child imports only qos.shm, which
+is jax-free and starts in ~0.1 s), so the byte layout, the fcntl
+byte-range locks, and the monotonic refill math are exercised across
+address spaces, exactly as prefork workers use them.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from seaweedfs_tpu.qos import shm
+from seaweedfs_tpu.qos.classify import CLASSES
+
+pytestmark = pytest.mark.qos
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def segment():
+    shm.destroy()  # stray ACTIVE segment from an earlier test
+    seg = shm.create(4)
+    assert seg is not None, "shared memory unavailable on this platform"
+    yield seg
+    shm.destroy()
+
+
+def _run_child(code: str) -> str:
+    """Run `code` in a fresh interpreter; returns its stdout."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO_ROOT + os.pathsep +
+               os.environ.get("PYTHONPATH", ""))
+    res = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=120)
+    assert res.returncode == 0, res.stderr
+    return res.stdout.strip()
+
+
+class TestSegmentLifecycle:
+    def test_create_attach_destroy(self, segment):
+        assert segment.nworkers == 4
+        assert shm.ACTIVE is segment
+        # create() while ACTIVE returns the existing segment
+        assert shm.create(4) is segment
+        name = segment.name
+        assert os.path.exists("/dev/shm/" + name.lstrip("/"))
+        shm.destroy()
+        assert shm.ACTIVE is None
+        assert not os.path.exists("/dev/shm/" + name.lstrip("/"))
+
+    def test_snapshot_shape(self, segment):
+        snap = segment.snapshot()
+        assert snap["segment"] == segment.name
+        assert snap["nworkers"] == 4
+        assert set(snap) >= {"fleet_inflight", "fleet_queued",
+                             "services"}
+        segment.gate_set("volume", "standard", "inflight", 3)
+        snap = segment.snapshot()
+        vol = snap["services"]["volume"]
+        assert vol["inflight"] == 3
+        assert vol["workers"]["0"]["standard"]["inflight"] == 3
+        assert set(vol) >= {"inflight", "queued", "drr_deficit",
+                            "workers"}
+
+
+class TestTenantBucketCrossProcess:
+    def test_fleet_wide_enforcement(self, segment):
+        """The acceptance bar: a tenant at its configured rate is
+        limited REGARDLESS of which worker admits it.  rate ~0 means no
+        refill during the test; burst 10 across two processes must
+        grant exactly 10 total."""
+        rate, burst = 1e-06, 10.0
+        granted_here = sum(
+            segment.tenant_take("t:alice", rate, burst) for _ in range(6))
+        assert granted_here == 6
+        out = _run_child(f"""
+from seaweedfs_tpu.qos import shm
+seg = shm.attach({segment.name!r})
+print(sum(seg.tenant_take("t:alice", 1e-06, 10.0) for _ in range(20)))
+""")
+        assert int(out) == 4, \
+            "child process saw its own bucket, not the shared one"
+        st = segment.tenant_stats("t:alice")
+        assert st["taken"] == 10
+        assert st["denied"] == 16
+        assert st["tokens"] < 1.0
+
+    def test_zero_rate_is_unlimited(self, segment):
+        assert all(segment.tenant_take("t:free", 0.0, 0.0)
+                   for _ in range(100))
+
+    def test_distinct_tenants_do_not_share(self, segment):
+        assert segment.tenant_take("t:a", 1e-06, 1.0)
+        assert not segment.tenant_take("t:a", 1e-06, 1.0)
+        assert segment.tenant_take("t:b", 1e-06, 1.0)
+
+    def test_refill_over_time(self, segment):
+        # drain the burst, then a huge rate refills within one call
+        assert segment.tenant_take("t:fast", 1e9, 1.0)
+        assert segment.tenant_take("t:fast", 1e9, 1.0)
+
+
+class TestTenantBucketsIntegration:
+    def test_admission_layer_uses_shared_segment(self, segment,
+                                                 monkeypatch):
+        """TenantBuckets (the admission-gate layer every daemon uses)
+        must route through the ACTIVE segment so limits hold across the
+        worker fleet, not per process."""
+        from seaweedfs_tpu.qos.admission import TenantBuckets
+
+        monkeypatch.setenv("WEED_QOS_TENANT_RPS", "0.000001")
+        monkeypatch.setenv("WEED_QOS_TENANT_BURST", "10")
+        buckets = TenantBuckets()
+        granted = sum(buckets.try_take("carol") for _ in range(6))
+        assert granted == 6
+        out = _run_child(f"""
+from seaweedfs_tpu.qos import shm
+seg = shm.attach({segment.name!r})
+print(sum(seg.tenant_take("t:carol", 1e-06, 10.0) for _ in range(20)))
+""")
+        assert int(out) == 4
+        assert segment.tenant_stats("t:carol")["taken"] == 10
+
+
+class TestDrrCrossProcess:
+    def test_deficit_shared_across_processes(self, segment):
+        segment.drr_set("interactive", 3.5)
+        out = _run_child(f"""
+from seaweedfs_tpu.qos import shm
+seg = shm.attach({segment.name!r})
+print(seg.drr_get("interactive"))
+seg.drr_set("background", 1.25)
+""")
+        assert float(out) == pytest.approx(3.5)
+        assert segment.drr_get("background") == pytest.approx(1.25)
+
+    def test_weight_fidelity_through_drr_queue(self, segment):
+        """DrrQueue dispatch with shm-backed deficits keeps the 4/2/1
+        class-weight service ratio — the deficits surviving the trip
+        through micro-int shared slots must not skew scheduling."""
+        from seaweedfs_tpu.qos.admission import DrrQueue, class_weights
+
+        q = DrrQueue()
+        weights = class_weights()
+        n = 280
+        for i in range(n):
+            for cls in CLASSES:
+                q.push(cls, (cls, i))
+        served = {cls: 0 for cls in CLASSES}
+        # few enough rounds that every class stays backlogged (the
+        # heaviest class must not drain its queue mid-measurement)
+        total = sum(weights[cls] for cls in CLASSES) * 20
+        assert max(weights.values()) * 20 < n
+        for _ in range(total):
+            item = q.pop()
+            if item is None:
+                break
+            served[item[0]] += 1
+        # every class progressed, in weight proportion (+-1 quantum)
+        assert all(served[cls] > 0 for cls in CLASSES)
+        ratio = served["interactive"] / max(1, served["background"])
+        expect = weights["interactive"] / weights["background"]
+        assert ratio == pytest.approx(expect, rel=0.35), served
+
+
+class TestGateRowsCrossProcess:
+    def test_child_row_visible_to_parent(self, segment):
+        _run_child(f"""
+from seaweedfs_tpu.qos import shm
+seg = shm.attach({segment.name!r})
+shm.set_worker_id(3)
+seg.gate_set("volume", "interactive", "inflight", 5)
+seg.gate_set("volume", "interactive", "queued", 2)
+""")
+        assert segment.gate_total("inflight") == 5
+        assert segment.gate_total("queued") == 2
+        assert segment.gate_total("inflight", service="volume") == 5
+        snap = segment.snapshot()
+        assert snap["fleet_inflight"] == 5
+
+    def test_reset_worker_zeroes_a_respawned_slot(self, segment):
+        shm.set_worker_id(2)
+        try:
+            segment.gate_set("volume", "standard", "inflight", 7)
+            segment.gate_set("volume", "standard", "queued", 1)
+            assert segment.gate_total("inflight") == 7
+            # what _child_main does post-fork
+            segment.reset_worker(2, "volume")
+            assert segment.gate_total("inflight") == 0
+            assert segment.gate_total("queued") == 0
+        finally:
+            shm.set_worker_id(0)
+
+
+class TestServicePartitioning:
+    """A combined `weed server` runs several prefork groups against the
+    ONE process-global segment, each numbering workers 1..N-1
+    independently: rows must be keyed by (service, worker) or the
+    volume group's worker 1 and the filer group's worker 1 — different
+    processes — clobber each other's single-writer rows."""
+
+    def test_same_wid_different_services_no_clobber(self, segment):
+        shm.set_worker_id(1)
+        try:
+            segment.gate_set("volume", "standard", "inflight", 4)
+            segment.gate_set("filer", "standard", "inflight", 9)
+            assert segment.gate_total("inflight", service="volume") == 4
+            assert segment.gate_total("inflight", service="filer") == 9
+            assert segment.gate_total("inflight") == 13
+        finally:
+            shm.set_worker_id(0)
+
+    def test_reset_worker_is_service_scoped(self, segment):
+        """A volume worker respawning at wid 1 must not zero the live
+        filer worker's counters at the same wid."""
+        shm.set_worker_id(1)
+        try:
+            segment.gate_set("volume", "standard", "inflight", 4)
+            segment.gate_set("filer", "standard", "inflight", 9)
+            segment.reset_worker(1, "volume")
+            assert segment.gate_total("inflight", service="volume") == 0
+            assert segment.gate_total("inflight", service="filer") == 9
+        finally:
+            shm.set_worker_id(0)
+
+    def test_drr_deficits_partitioned_by_service(self, segment):
+        segment.drr_set("interactive", 2.5, service="volume")
+        segment.drr_set("interactive", 7.0, service="filer")
+        assert segment.drr_get("interactive", service="volume") \
+            == pytest.approx(2.5)
+        assert segment.drr_get("interactive", service="filer") \
+            == pytest.approx(7.0)
+
+    def test_admission_limits_decoupled_across_services(self, segment,
+                                                        monkeypatch):
+        """One service's in-flight load must not consume another's
+        admission limit (the gates mirror into per-service rows and
+        enforce against per-service sums)."""
+        from seaweedfs_tpu.qos.admission import AdmissionGate
+
+        monkeypatch.setenv("WEED_QOS_SHMTEST_LIMIT", "1")
+        monkeypatch.setenv("WEED_QOS_QUEUE_TIMEOUT", "0.2")
+        vol = AdmissionGate("volume",
+                            limit_env="WEED_QOS_SHMTEST_LIMIT")
+        fil = AdmissionGate("filer",
+                            limit_env="WEED_QOS_SHMTEST_LIMIT")
+        release_vol = vol.admit("standard")
+        try:
+            assert segment.gate_total("inflight", service="volume") == 1
+            assert vol.total_inflight() == 1
+            assert fil.total_inflight() == 0
+            # must admit instantly: the filer's limit of 1 is not
+            # consumed by the volume gate's in-flight request
+            release_fil = fil.admit("standard")
+            release_fil()
+        finally:
+            release_vol()
+
+    def test_registry_full_fails_open_per_process(self, segment):
+        for i in range(shm.MAX_SERVICES):
+            assert segment.service_index(f"svc{i}") == i
+        assert segment.service_index("one-too-many") == -1
+        segment.gate_set("one-too-many", "standard", "inflight", 5)
+        assert segment.gate_total("inflight",
+                                  service="one-too-many") == 0

@@ -1138,6 +1138,13 @@ def cmd_scaffold(args):
         print(text, end="")
 
 
+def _workers_flag(p):
+    p.add_argument("-workers", type=int, default=0,
+                   help="prefork this many gateway worker processes per "
+                        "HTTP listener via SO_REUSEPORT (sets "
+                        "WEED_HTTP_WORKERS; 0/1 = single process)")
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(prog="weed", description=__doc__)
     parser.add_argument("-v", type=int, default=0,
@@ -1160,6 +1167,7 @@ def main(argv=None):
     p.add_argument("-tcp", action="store_true",
                    help="serve per-file assigns on the native fast-path "
                         "port (port+20000) via leased fid ranges")
+    _workers_flag(p)
     p.set_defaults(fn=cmd_master)
 
     p = sub.add_parser("master.follower",
@@ -1200,6 +1208,7 @@ def main(argv=None):
                    help="in-flight upload byte throttle (0 = unlimited)")
     p.add_argument("-concurrentDownloadLimitMB", type=int, default=0,
                    help="in-flight download byte throttle (0 = unlimited)")
+    _workers_flag(p)
     p.set_defaults(fn=cmd_volume)
 
     p = sub.add_parser("filer", help="start a filer server")
@@ -1228,6 +1237,7 @@ def main(argv=None):
                    help="directory for the tiered on-disk chunk cache")
     p.add_argument("-cacheCapacityMB", type=int, default=1024,
                    help="on-disk chunk cache budget (with -cacheDir)")
+    _workers_flag(p)
     p.set_defaults(fn=cmd_filer)
 
     p = sub.add_parser("filer.store",
@@ -1255,6 +1265,7 @@ def main(argv=None):
     p.add_argument("-config", default="", help="identities json")
     p.add_argument("-encryptVolumeData", action="store_true",
                    help="encrypt chunk data at rest")
+    _workers_flag(p)
     p.set_defaults(fn=cmd_s3)
 
     p = sub.add_parser("iam", help="start an IAM management API (+s3+filer)")
@@ -1295,6 +1306,7 @@ def main(argv=None):
     p.add_argument("-encryptVolumeData", action="store_true",
                    help="encrypt chunk data at rest (per-chunk AES keys "
                         "in filer metadata)")
+    _workers_flag(p)
     p.set_defaults(fn=cmd_server)
 
     p = sub.add_parser("shell", help="interactive admin shell")
@@ -1522,6 +1534,9 @@ def main(argv=None):
         sorted(sub.choices))))
 
     args = parser.parse_args(argv)
+    if getattr(args, "workers", 0):
+        # flag wins over env; RpcServer reads WEED_HTTP_WORKERS at bind
+        os.environ["WEED_HTTP_WORKERS"] = str(args.workers)
     if args.v:
         from seaweedfs_tpu.util import glog
 
