@@ -2375,6 +2375,87 @@ def main():
     }))
 
 
+def _flatten_metrics(d, prefix=""):
+    """Numeric leaves of a bench result as {dotted.path: value}."""
+    out = {}
+    if isinstance(d, dict):
+        for k, v in d.items():
+            out.update(_flatten_metrics(v, f"{prefix}{k}."))
+    elif isinstance(d, bool):
+        pass
+    elif isinstance(d, (int, float)):
+        out[prefix[:-1]] = float(d)
+    return out
+
+
+_LOWER_IS_BETTER = ("p50", "p99", "latency", "_ms", "seconds",
+                    "overhead", "write_amp", "failover_gap")
+_TRACKED = ("rps", "gibps", "value", "throughput", "p50", "p99",
+            "latency_ms", "failover_gap")
+
+
+def _metric_direction(path):
+    """+1 higher-is-better, -1 lower-is-better, 0 untracked."""
+    leaf = path.rsplit(".", 1)[-1]
+    if not any(t in leaf for t in _TRACKED):
+        return 0
+    return -1 if any(t in leaf for t in _LOWER_IS_BETTER) else 1
+
+
+def compare_results(prev: dict, curr: dict, threshold_pct: float):
+    """Per-metric delta rows + the subset that regressed past the
+    threshold.  Only tracked metrics (throughputs, rps, latencies) can
+    fail the comparison; context fields are informational."""
+    pv, cv = _flatten_metrics(prev), _flatten_metrics(curr)
+    rows, regressions = [], []
+    for path in sorted(set(pv) & set(cv)):
+        a, b = pv[path], cv[path]
+        direction = _metric_direction(path)
+        if a == 0:
+            delta_pct = 0.0 if b == 0 else float("inf")
+        else:
+            delta_pct = (b - a) / abs(a) * 100.0
+        regressed = bool(direction) and (
+            -direction * delta_pct > threshold_pct)
+        rows.append((path, a, b, delta_pct, direction, regressed))
+        if regressed:
+            regressions.append(path)
+    return rows, regressions
+
+
+def cmd_compare(argv):
+    """`bench.py --compare prev.json [curr.json]` — regression gate.
+
+    Compares a previous run's JSON against the current one (second
+    file, or stdin when omitted) and exits non-zero when any tracked
+    metric regressed more than WEED_BENCH_REGRESS_PCT (default 20%)."""
+    if not argv:
+        sys.exit("usage: bench.py --compare prev.json [curr.json]")
+    with open(argv[0]) as f:
+        prev = json.load(f)
+    if len(argv) > 1:
+        with open(argv[1]) as f:
+            curr = json.load(f)
+    else:
+        curr = json.load(sys.stdin)
+    threshold = float(os.environ.get("WEED_BENCH_REGRESS_PCT", "")
+                      or 20.0)
+    rows, regressions = compare_results(prev, curr, threshold)
+    if not rows:
+        sys.exit("no common numeric metrics between the two results")
+    print(f"{'metric':52s} {'prev':>12s} {'curr':>12s} {'delta':>9s}")
+    for path, a, b, delta, direction, regressed in rows:
+        flag = " REGRESSED" if regressed else ""
+        arrow = {1: "^", -1: "v", 0: " "}[direction]
+        print(f"{path:52s} {a:12.3f} {b:12.3f} {delta:+8.1f}%"
+              f" {arrow}{flag}")
+    if regressions:
+        print(f"\n{len(regressions)} tracked metric(s) regressed more "
+              f"than {threshold:g}%: {', '.join(regressions)}")
+        sys.exit(1)
+    print(f"\nno tracked metric regressed more than {threshold:g}%")
+
+
 if __name__ == "__main__":
     # single-phase mode: `python bench.py ec_rebuild` runs one phase and
     # prints its JSON alone — the full suite stays the no-argument default
@@ -2390,6 +2471,9 @@ if __name__ == "__main__":
     if len(sys.argv) > 1:
         if sys.argv[1] in ("--list", "-l"):
             print("\n".join(sorted(_phases)))
+            sys.exit(0)
+        if sys.argv[1] == "--compare":
+            cmd_compare(sys.argv[2:])
             sys.exit(0)
         if sys.argv[1] not in _phases:
             sys.exit(f"unknown bench phase {sys.argv[1]!r}; "

@@ -29,6 +29,8 @@ from ..rpc.http_rpc import (FileSlice, Request, Response, RpcError,
                             RpcServer, call, sendfile_enabled)
 from ..util import faults
 from ..security import Guard, gen_read_jwt, gen_write_jwt
+from ..stats import events as events_mod
+from ..stats import healthz
 from ..stats import metrics as stats
 from ..storage.needle import PAIR_NAME_PREFIX
 from .entry import Attr, Entry, FileChunk, total_size
@@ -147,6 +149,8 @@ class FilerServer:
         self.qos_gate = qos.AdmissionGate("filer",
                                           limit_env="WEED_QOS_FILER_LIMIT")
         qos.mount(self.server, gate=self.qos_gate)
+        events_mod.mount(self.server)
+        healthz.mount_health(self.server, ready=self._ready_checks)
         self.server.add("GET", "/metadata/subscribe", self._h_subscribe)
         self.server.add("GET", "/metadata/aggregate", self._h_aggregate)
         self.server.add("POST", "/remote/configure", self._h_remote_configure)
@@ -167,6 +171,14 @@ class FilerServer:
     @property
     def address(self) -> str:
         return self.server.address
+
+    def _ready_checks(self):
+        return [("master", bool(self.masters),
+                 f"masters={','.join(self.masters) or 'unknown'}"),
+                ("store", self.filer.store is not None,
+                 type(self.filer.store).__name__
+                 if self.filer.store is not None else "no store"),
+                healthz.gate_check(self.qos_gate)]
 
     def start(self):
         self.server.start()

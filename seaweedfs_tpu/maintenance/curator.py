@@ -158,6 +158,9 @@ class Curator:
         self.scans = 0
         self.enqueued = 0
         self.now = time.time  # fake-clock seam
+        # health plane seam: returns the names of firing SLO alerts so
+        # scan_scale() can use them as an opt-in scale-up trigger
+        self.alerts_fn = None
 
     @property
     def interval(self) -> float:
@@ -199,11 +202,17 @@ class Curator:
         snap = detectors.snapshot(self.master.topo)
         now = self.now()
         vacuum_on = getattr(self.master, "auto_vacuum_interval", 0) > 0
+        alerts = None
+        if self.alerts_fn is not None:
+            try:
+                alerts = self.alerts_fn()
+            except Exception:
+                alerts = None
         specs = detectors.scan(
             snap, now=now, last_scrub=self.last_scrub,
             garbage_threshold=getattr(self.master, "garbage_threshold",
                                       0.3),
-            vacuum_enabled=vacuum_on)
+            vacuum_enabled=vacuum_on, alerts=alerts)
         self.scans += 1
         ids = []
         cooldown = self.cooldown()
@@ -216,17 +225,35 @@ class Curator:
             if jid is not None:
                 ids.append(jid)
                 self.enqueued += 1
+                from ..stats import events as events_mod
+
                 if spec["type"] in (TYPE_SCALE_UP, TYPE_SCALE_DRAIN):
                     from ..stats import metrics as stats
 
                     action = ("up" if spec["type"] == TYPE_SCALE_UP
                               else "drain")
                     stats.ScaleEventsCounter.labels(action).inc()
+                    events_mod.emit(
+                        events_mod.SCALE_UP if action == "up"
+                        else events_mod.SCALE_DRAIN,
+                        service="master", node=spec["type"],
+                        detail=dict(spec["params"]))
+                else:
+                    events_mod.emit(events_mod.JOB_ENQUEUED,
+                                    service="master", node=spec["type"],
+                                    detail={"id": jid,
+                                            "volume": spec["volume"]})
         return ids
 
     # -- completion hook -----------------------------------------------------
     def on_complete(self, job, report: Optional[dict]):
         self._recent[(job.type, job.volume)] = self.now()
+        from ..stats import events as events_mod
+
+        events_mod.emit(events_mod.JOB_DONE, service="master",
+                        node=job.type,
+                        detail={"id": job.id, "volume": job.volume,
+                                "outcome": job.outcome})
         if job.type == TYPE_DEEP_SCRUB:
             self.last_scrub[job.volume] = self.now()
             # scrub findings close the loop: corruption becomes a

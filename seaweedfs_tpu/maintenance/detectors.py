@@ -83,7 +83,8 @@ def scan(snap: dict, now: float, last_scrub: dict,
          scale_enabled: Optional[bool] = None,
          scale_up_occ: Optional[float] = None,
          scale_drain_occ: Optional[float] = None,
-         scale_min_nodes: Optional[int] = None) -> list[dict]:
+         scale_min_nodes: Optional[int] = None,
+         alerts: Optional[list] = None) -> list[dict]:
     """All detectors over one snapshot -> job specs
     ({type, volume, collection, params}), urgent first."""
     if scrub_interval is None:
@@ -166,7 +167,8 @@ def scan(snap: dict, now: float, last_scrub: dict,
     specs.extend(scan_scale(snap, scale_enabled=scale_enabled,
                             scale_up_occ=scale_up_occ,
                             scale_drain_occ=scale_drain_occ,
-                            scale_min_nodes=scale_min_nodes))
+                            scale_min_nodes=scale_min_nodes,
+                            alerts=alerts))
     return specs
 
 
@@ -175,7 +177,9 @@ def scan_scale(snap: dict, scale_enabled: Optional[bool] = None,
                scale_drain_occ: Optional[float] = None,
                scale_min_nodes: Optional[int] = None,
                scale_up_rps: Optional[float] = None,
-               scale_drain_rps: Optional[float] = None) -> list[dict]:
+               scale_drain_rps: Optional[float] = None,
+               alerts: Optional[list] = None,
+               scale_on_alert: Optional[bool] = None) -> list[dict]:
     """Autoscaler detectors over per-node telemetry.
 
     Opt-in via WEED_SCALE=1 (capacity changes must never surprise a
@@ -202,9 +206,20 @@ def scan_scale(snap: dict, scale_enabled: Optional[bool] = None,
         scale_up_rps = _env_float("WEED_SCALE_UP_RPS", 0.0)
     if scale_drain_rps is None:
         scale_drain_rps = _env_float("WEED_SCALE_DRAIN_RPS", 1.0)
+    if scale_on_alert is None:
+        scale_on_alert = os.environ.get("WEED_SCALE_ON_ALERT", "0") \
+            not in ("0", "", "false", "no")
     nodes = [n for n in snap.get("nodes", []) if not n["draining"]]
     if not nodes:
         return []
+    # opt-in SLO trigger: a firing burn-rate alert (health plane) means
+    # the error budget is being spent NOW — add capacity without
+    # waiting for occupancy to cross its threshold
+    if scale_on_alert and alerts:
+        return [{"type": TYPE_SCALE_UP, "volume": 0, "collection": "",
+                 "params": {"reason": "slo.alert",
+                            "alerts": sorted(alerts),
+                            "nodes": len(nodes)}}]
     occs = [n["occupancy"] for n in nodes]
     mean_occ = sum(occs) / len(occs)
     mean_rps = sum(n["rps"] for n in nodes) / len(nodes)

@@ -78,6 +78,7 @@ class RaftNode:
         self.voted_for: Optional[str] = None
         self.leader: Optional[str] = None
         self.on_become_leader: Optional[Callable[[], None]] = None
+        self.on_step_down: Optional[Callable[[], None]] = None
 
         # -- replicated log + snapshot (boltdb store analogue) ---------------
         # entry: {"index": i, "term": t, "cmd": {...}}; the entry at
@@ -414,12 +415,15 @@ class RaftNode:
                 self.term = term
                 self.voted_for = None
                 self._save_state()
+            was_leader = self.state == LEADER
             if self.state != FOLLOWER:
                 glog.infof("raft: %s stepping down at term %d",
                            self.address, term)
             self.state = FOLLOWER
             self._last_heard = self.clock()
         self._sync_metrics()
+        if was_leader and self.on_step_down:
+            self.on_step_down()
 
     # -- leader-side replication ----------------------------------------------
     def _replicate_to(self, peer: str) -> bool:

@@ -21,6 +21,8 @@ from typing import Optional
 from .. import profiling, qos, tracing
 from ..rpc.http_rpc import RpcError, RpcServer, call
 from ..security import Guard, gen_write_jwt
+from ..stats import events as events_mod
+from ..stats import healthz
 from ..stats import metrics as stats
 from ..storage import types as t
 from ..storage.super_block import ReplicaPlacement
@@ -90,6 +92,15 @@ class MasterServer:
 
         self.curator = Curator(self, journal_dir=raft_dir,
                                interval=maintenance_interval)
+        # leader-resident health plane: /metrics scrape loop -> ring
+        # TSDB -> SLO burn-rate alerts + the merged cluster event
+        # journal (GET /cluster/health|alerts|events)
+        from .health import HealthPlane
+
+        self.health = HealthPlane(self)
+        self.curator.alerts_fn = self.health.firing
+        self.raft.on_become_leader = self._on_leader
+        self.raft.on_step_down = self._on_step_down
         self._register_routes()
         self._reaper: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -109,11 +120,13 @@ class MasterServer:
         self._reaper = threading.Thread(target=self._reap_loop, daemon=True)
         self._reaper.start()
         self.curator.start()
+        self.health.start()
         if self.enable_native_assign:
             self._start_native_assign()
 
     def stop(self):
         self._stop.set()
+        self.health.stop()
         self.curator.stop()
         self.raft.stop()
         with self._change_cond:
@@ -258,7 +271,7 @@ class MasterServer:
         # shardable on the master port)
         s.parent_prefixes.update((
             "/dir/", "/cluster/", "/vol/", "/ec/", "/raft/", "/filer/",
-            "/col/", "/maintenance/", "/ui"))
+            "/col/", "/maintenance/", "/ui", "/readyz"))
         s.add("POST", "/api/heartbeat", self._handle_heartbeat)
         s.add("GET", "/dir/assign", self._handle_assign)
         s.add("POST", "/dir/assign", self._handle_assign)
@@ -298,6 +311,24 @@ class MasterServer:
         # maintenance curator: status/queue views, worker lease
         # protocol, pause/run controls
         self.curator.mount(s, g)
+        # cluster health plane + liveness/readiness probes
+        self.health.mount(s)
+        healthz.mount_health(s, ready=self._ready_checks)
+
+    def _ready_checks(self):
+        leader = self.raft.leader or ""
+        return [("raft", bool(leader), f"leader={leader or 'unknown'}"),
+                ("fsm", self.raft.fsm is not None, "raft fsm attached")]
+
+    def _on_leader(self):
+        events_mod.emit(events_mod.LEADER_ELECTED, service="master",
+                        node=self.address,
+                        detail={"term": self.raft.term})
+
+    def _on_step_down(self):
+        events_mod.emit(events_mod.LEADER_STEPDOWN, service="master",
+                        node=self.address,
+                        detail={"term": self.raft.term})
 
     def _handle_ui(self, req):
         """Status page (server/master_ui/master.html)."""

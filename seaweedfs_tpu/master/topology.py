@@ -204,6 +204,11 @@ class Topology:
                                 hb.get("max_volume_count", 8), dc, rack)
                 self.nodes[node_id] = node
                 rack.nodes[node_id] = node
+                from ..stats import events as events_mod
+
+                events_mod.emit(events_mod.NODE_UP, service="volume",
+                                node=node_id,
+                                detail={"dc": dc_name, "rack": rack_name})
             node.last_seen = time.time()
             node.max_volume_count = hb.get("max_volume_count",
                                            node.max_volume_count)
@@ -305,9 +310,14 @@ class Topology:
         for nid in dead:
             self.unregister_node(nid)
         if dead:
+            from ..stats import events as events_mod
             from ..stats import metrics as stats
 
             stats.TopologyDeadNodesCounter.inc(len(dead))
+            for nid in dead:
+                events_mod.emit(events_mod.NODE_DOWN, service="volume",
+                                node=nid,
+                                detail={"reason": "heartbeat timeout"})
         return dead
 
     # -- layouts / lookup ----------------------------------------------------

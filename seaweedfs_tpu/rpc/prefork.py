@@ -250,6 +250,11 @@ class PreforkGroup:
                 if done == 0 or self._stopping:
                     continue
                 _stats.GatewayWorkerRespawnsCounter.labels(service).inc()
+                from ..stats import events as _events
+
+                _events.emit(_events.WORKER_RESPAWN, service=service,
+                             node=self.server.address,
+                             detail={"worker": wid, "pid": pid})
                 try:
                     self._fork(wid)
                 except OSError:

@@ -27,6 +27,8 @@ from ..filer.filer_store import NotFoundError
 from ..filer.server import FilerServer
 from .. import profiling, qos, tracing
 from ..rpc.http_rpc import Request, Response, RpcError, RpcServer
+from ..stats import events as events_mod
+from ..stats import healthz
 from ..stats import metrics as stats
 from ..util import faults
 from .auth import (ACTION_ADMIN, ACTION_LIST, ACTION_READ, ACTION_WRITE,
@@ -140,6 +142,8 @@ class S3ApiServer:
         self.qos_gate = qos.AdmissionGate("s3",
                                           limit_env="WEED_QOS_S3_LIMIT")
         qos.mount(self.server, gate=self.qos_gate)
+        events_mod.mount(self.server)
+        healthz.mount_health(self.server, ready=self._ready_checks)
         self.server.default_route = self._handle
         self._stop_event = threading.Event()
         self._register_thread: Optional[threading.Thread] = None
@@ -147,6 +151,16 @@ class S3ApiServer:
     @property
     def address(self) -> str:
         return self.server.address
+
+    def _ready_checks(self):
+        return [("filer", self.filer_server is not None,
+                 getattr(self.filer_server, "address", "unknown")
+                 if self.filer_server is not None else "no filer"),
+                ("master", bool(getattr(self.filer_server,
+                                        "master_address", "")),
+                 getattr(self.filer_server, "master_address", "")
+                 or "unknown"),
+                healthz.gate_check(self.qos_gate)]
 
     def start(self):
         self.server.start()

@@ -575,21 +575,54 @@ def cluster_check(env: CommandEnv) -> list[str]:
         problems.append(f"master unreachable: {e}")
         return problems
     for n in collect_volume_servers(env):
-        try:
-            call(n.url, "/admin/status", timeout=5)
-        except RpcError as e:
-            problems.append(f"volume server {n.url} unreachable: {e}")
+        problems.extend(_probe_ready(n.url, "volume server"))
     for f in env.master("/cluster/nodes?type=filer") \
             .get("cluster_nodes", []):
-        try:
-            call(f["address"], "/metadata/subscribe?since=-1", timeout=5)
-        except RpcError as e:
-            problems.append(f"filer {f['address']} unreachable: {e}")
+        problems.extend(_probe_ready(f["address"], "filer"))
+    # firing SLO burn-rate alerts from the leader's health plane
+    try:
+        for a in env.master("/cluster/alerts").get("alerts", []):
+            problems.append(
+                f"slo: alert {a['rule']} firing "
+                f"(burn fast={a['burn_fast']} slow={a['burn_slow']})")
+    except RpcError:
+        pass  # pre-health-plane master
     under = [a for a in volume_fix_replication(env, plan_only=True)
              if a["action"] == "copy"]
     for a in under:
         problems.append(f"volume {a['volume']} under-replicated")
     return problems
+
+
+def _probe_ready(address: str, what: str) -> list[str]:
+    """Liveness (/healthz) then readiness (/readyz) of one daemon;
+    a 503 readyz reports the individual failing checks."""
+    problems = []
+    try:
+        call(address, "/healthz", timeout=5)
+    except RpcError as e:
+        return [f"{what} {address} unreachable: {e}"]
+    try:
+        call(address, "/readyz", timeout=5)
+    except RpcError as e:
+        detail = ""
+        try:
+            import json as _json
+
+            body = _json.loads(str(e))
+            detail = ", ".join(
+                f"{c['name']}: {c['detail']}"
+                for c in body.get("checks", []) if not c["ok"])
+        except Exception:
+            pass
+        problems.append(f"{what} {address} not ready"
+                        + (f" ({detail})" if detail else f": {e}"))
+    return problems
+
+
+def cluster_health(env: CommandEnv) -> dict:
+    """The leader health plane's single JSON rollup."""
+    return env.master("/cluster/health")
 
 
 def cluster_raft_ps(env: CommandEnv) -> dict:

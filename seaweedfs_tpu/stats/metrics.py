@@ -111,6 +111,16 @@ class Gauge(_Metric):
         with self._lock:
             self._values[labels] = self._values.get(labels, 0.0) + amount
 
+    def remove(self, *values):
+        """Drop every label series whose leading label values match —
+        a departed scrape target must not export a stale series
+        forever (and get re-ingested as a live signal)."""
+        prefix = tuple(str(v) for v in values)
+        with self._lock:
+            for k in [k for k in self._values
+                      if k[:len(prefix)] == prefix]:
+                del self._values[k]
+
     def expose(self) -> list[str]:
         lines = ["# HELP %s %s" % (self.name, self.help),
                  "# TYPE %s gauge" % self.name]
@@ -647,6 +657,48 @@ VolumeServerDrainingGauge = REGISTRY.gauge(
     "SeaweedFS_volumeServer_draining",
     "1 while this volume server is draining (read-only, being "
     "evacuated before deregistration)")
+
+
+# -- cluster health plane (master/health.py): the leader-resident scrape
+# loop, the ring TSDB it fills, the SLO burn-rate evaluator, and the
+# structured event journal ---------------------------------------------------
+ClusterTargetUpGauge = REGISTRY.gauge(
+    "SeaweedFS_cluster_target_up",
+    "1 when the leader's last /metrics scrape of this daemon "
+    "succeeded, 0 when it failed or timed out", ("target", "kind"))
+ClusterScrapeErrorsCounter = REGISTRY.counter(
+    "SeaweedFS_cluster_scrape_errors_total",
+    "scrape attempts that failed or blew their per-target deadline",
+    ("target",))
+ClusterScrapeRoundsCounter = REGISTRY.counter(
+    "SeaweedFS_cluster_scrape_rounds_total",
+    "scrape rounds completed by the leader's health plane")
+ClusterScrapeDutyGauge = REGISTRY.gauge(
+    "SeaweedFS_cluster_scrape_duty_ratio",
+    "scrape-loop busy seconds per second of wall clock at the "
+    "configured WEED_HEALTH_SCRAPE_MS cadence (self-measured)")
+ClusterTsdbSeriesGauge = REGISTRY.gauge(
+    "SeaweedFS_cluster_tsdb_series",
+    "live series held by the in-memory ring TSDB")
+ClusterTsdbDroppedCounter = REGISTRY.counter(
+    "SeaweedFS_cluster_tsdb_dropped_total",
+    "samples dropped because the WEED_TSDB_MAX_SERIES cap was hit")
+ClusterSloBurnRateGauge = REGISTRY.gauge(
+    "SeaweedFS_cluster_slo_burn_rate",
+    "error-budget burn rate per SLO rule and window (1.0 = burning "
+    "exactly the budget; >1 exhausts it early)", ("rule", "window"))
+ClusterSloAlertGauge = REGISTRY.gauge(
+    "SeaweedFS_cluster_slo_alert_firing",
+    "1 while this SLO rule's multi-window burn-rate alert is firing",
+    ("rule",))
+ClusterSloTransitionsCounter = REGISTRY.counter(
+    "SeaweedFS_cluster_slo_alert_transitions_total",
+    "alert state transitions per SLO rule (fire|clear)",
+    ("rule", "to"))
+ClusterEventsCounter = REGISTRY.counter(
+    "SeaweedFS_cluster_events_total",
+    "structured events appended to this process's journal, by kind",
+    ("kind",))
 
 
 # -- process self-metrics (the reference's Go runtime collectors:

@@ -161,6 +161,11 @@ class FaultRegistry:
             self.seed = seed
             self.log = []
         _set_active(bool(rules))
+        if rules:
+            from ..stats import events as _events
+
+            _events.emit(_events.FAULTS_ACTIVE, service="faults",
+                         detail={"rules": len(rules), "seed": seed})
 
     def add_rule(self, spec: str):
         rules = parse_spec(spec)

@@ -33,6 +33,8 @@ from ..rpc.http_rpc import (FileSlice, Request, Response, RpcError,
                             stream_file)
 from ..util import faults
 from ..security import Guard, gen_write_jwt, token_from_request
+from ..stats import events as events_mod
+from ..stats import healthz
 from ..stats import metrics as stats
 from ..storage import types as t
 from ..storage.erasure_coding import TOTAL_SHARDS_COUNT, to_ext
@@ -258,7 +260,7 @@ class VolumeServer:
         # a disk-failure demotion must reach the master NOW, not at the
         # next pulse: assigns in the gap would keep landing on the
         # demoted volume (the heartbeat reports read_only per volume)
-        self.store.on_demote = lambda vid: self._try_heartbeat()
+        self.store.on_demote = self._on_demote
         # unified read cache over the needle-read path: parsed needles
         # keyed by fid, validated against the live needle map on every
         # hit (RAM + optional HBM tier; no disk tier — the needles are
@@ -697,8 +699,25 @@ class VolumeServer:
         faults.mount(s)
         profiling.mount(s)
         qos.mount(s, gate=self.qos_gate)
+        events_mod.mount(s)
+        healthz.mount_health(s, ready=self._ready_checks)
         s.add("GET", "/ui", self._h_ui)
         s.default_route = self._handle_object
+
+    def _ready_checks(self):
+        n_locations = len(self.store.locations)
+        return [("store", n_locations > 0,
+                 f"{n_locations} mounted location(s)"),
+                ("master", bool(self.master_address),
+                 f"master={self.master_address or 'unknown'}"),
+                ("draining", not self.draining,
+                 "draining" if self.draining else "serving"),
+                healthz.gate_check(self.qos_gate)]
+
+    def _on_demote(self, vid: int):
+        events_mod.emit(events_mod.READONLY_DEMOTION, service="volume",
+                        node=self.address, detail={"volume": vid})
+        self._try_heartbeat()
 
     def _h_ui(self, req: Request):
         """Status page (server/volume_server_ui/volume.html)."""
@@ -828,6 +847,10 @@ class VolumeServer:
                 except NotFoundError:
                     pass  # deleted between listing and demotion
         stats.VolumeServerDrainingGauge.set(1.0 if draining else 0.0)
+        events_mod.emit(events_mod.DRAIN, service="volume",
+                        node=self.address,
+                        detail={"draining": draining,
+                                "demoted": len(demoted)})
         self._try_heartbeat()  # master must see read_only NOW
         return {"draining": draining, "volumes": sorted(demoted)}
 

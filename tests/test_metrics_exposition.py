@@ -195,3 +195,62 @@ class TestExpositionFormat:
         hops = [s for s in fams["SeaweedFS_rpc_hop_seconds"]["samples"]
                 if s[0].endswith("_count")]
         assert sum(v for _, _, v in hops) >= 2
+
+
+class TestMergeExpositions:
+    """Edge cases of the prefork fleet-merge: the leader's scrape loop
+    feeds the merged text straight into the health-plane TSDB, so a
+    merge that emits duplicate family blocks or shuffles histogram
+    buckets would corrupt every downstream SLO."""
+
+    W0 = ("# HELP SeaweedFS_demo_total demo counter\n"
+          "# TYPE SeaweedFS_demo_total counter\n"
+          "SeaweedFS_demo_total 3\n")
+
+    def test_conflicting_help_first_wins_single_block(self):
+        w1 = self.W0.replace("demo counter", "OTHER help text")
+        merged = m.merge_expositions([("0", self.W0), ("1", w1)])
+        fams = strict_parse(merged)  # rejects duplicate HELP blocks
+        fam = fams["SeaweedFS_demo_total"]
+        assert "demo counter" in fam["help"]
+        assert "OTHER" not in merged
+        # both workers' samples grouped under the single header
+        workers = {s[1]["worker"] for s in fam["samples"]}
+        assert workers == {"0", "1"}
+
+    def test_absent_worker_part_mid_read(self):
+        """A worker that died mid-scrape contributes an empty (or
+        truncated, headerless) part; the merge must not invent
+        families or drop the healthy workers' samples."""
+        merged = m.merge_expositions(
+            [("0", self.W0), ("1", ""), ("2", self.W0)])
+        fams = strict_parse(merged)
+        samples = fams["SeaweedFS_demo_total"]["samples"]
+        assert {s[1]["worker"] for s in samples} == {"0", "2"}
+        assert sum(s[2] for s in samples) == 6.0
+
+    def test_histogram_bucket_merge_ordering(self):
+        """Per-worker le-buckets must stay contiguous per series (the
+        worker label separates the series); the merged text must still
+        satisfy the strict cumulative-monotone histogram checks."""
+        hist = ("# HELP SeaweedFS_demo_seconds demo latency\n"
+                "# TYPE SeaweedFS_demo_seconds histogram\n"
+                'SeaweedFS_demo_seconds_bucket{le="0.1"} %d\n'
+                'SeaweedFS_demo_seconds_bucket{le="1"} %d\n'
+                'SeaweedFS_demo_seconds_bucket{le="+Inf"} %d\n'
+                "SeaweedFS_demo_seconds_sum %f\n"
+                "SeaweedFS_demo_seconds_count %d\n")
+        merged = m.merge_expositions([
+            ("0", hist % (1, 2, 3, 1.5, 3)),
+            ("1", hist % (4, 4, 9, 8.0, 9)),
+        ])
+        fams = strict_parse(merged)
+        assert check_histograms(fams) == 2  # one series per worker
+        # and the health-plane parser agrees on totals
+        from seaweedfs_tpu.stats import tsdb
+
+        types, samples = tsdb.parse_exposition(merged)
+        assert types["SeaweedFS_demo_seconds"] == "histogram"
+        counts = [v for n, labels, v in samples
+                  if n == "SeaweedFS_demo_seconds_count"]
+        assert sorted(counts) == [3.0, 9.0]

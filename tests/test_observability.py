@@ -233,6 +233,44 @@ class TestGrafanaDashboard:
                 "SeaweedFS_gateway_sendfile_bytes_total"):
             assert token in joined, \
                 f"no Gateway workers panel queries {token}"
+        # the Cluster health row queries the health-plane families
+        for token in (
+                "SeaweedFS_cluster_target_up",
+                "SeaweedFS_cluster_scrape_errors_total",
+                "SeaweedFS_cluster_slo_burn_rate",
+                "SeaweedFS_cluster_slo_alert_firing",
+                "SeaweedFS_cluster_events_total",
+                "SeaweedFS_cluster_scrape_duty_ratio"):
+            assert token in joined, \
+                f"no Cluster health panel queries {token}"
         titles = [p.get("title") for p in dashboard["panels"]]
         assert "Inline EC" in titles
         assert "Gateway workers" in titles
+        assert "Cluster health" in titles
+
+    def test_lint_dashboards_clean(self):
+        from seaweedfs_tpu.stats import lint
+
+        assert lint.run() == []
+
+    def test_lint_flags_unknown_family(self, tmp_path):
+        from seaweedfs_tpu.stats import lint
+
+        bad = tmp_path / "dash.json"
+        bad.write_text(json.dumps({"panels": [
+            {"title": "bogus", "targets": [
+                {"expr": "rate(SeaweedFS_no_such_family_total[1m])"}]}]}))
+        problems = lint.lint_dashboard(str(bad))
+        assert problems and "SeaweedFS_no_such_family_total" in problems[0]
+
+    def test_lint_flags_bad_slo_rule(self):
+        from seaweedfs_tpu.stats import lint, slo
+
+        rules = slo.parse_rules(
+            "bad-family,kind=latency,family=SeaweedFS_nope,le=0.1;"
+            "not-histogram,kind=latency,"
+            "family=SeaweedFS_cluster_target_up,le=0.1")
+        problems = lint.lint_slo_rules(rules)
+        assert len(problems) == 2
+        assert "unknown family" in problems[0]
+        assert "needs a histogram" in problems[1]

@@ -479,6 +479,7 @@ def _shell_handlers(env):
             if flag(a, "drain") else scale.scale_status(env)),
         "cluster.ps": lambda a: show(vol.cluster_ps(env)),
         "cluster.check": lambda a: show(vol.cluster_check(env)),
+        "cluster.health": lambda a: show(vol.cluster_health(env)),
         "cluster.raft.ps": lambda a: show(vol.cluster_raft_ps(env)),
         "raft.status": lambda a: show(vol.cluster_raft_ps(env)),
         "cluster.raft.add": lambda a: show(vol.cluster_raft_add(
@@ -1125,6 +1126,82 @@ def cmd_maintenance(args):
     print(json.dumps(out, indent=2, default=str))
 
 
+def _render_top(h, master):
+    """One frame of `weed top` from the /cluster/health rollup."""
+    lines = [f"cluster {h.get('status', '?').upper():10s}  "
+             f"leader {h.get('leader') or '?'}  "
+             f"(via {master}, scrape "
+             f"{h.get('scrape', {}).get('interval_ms', 0):.0f}ms, "
+             f"duty {h.get('scrape', {}).get('duty', 0):.4f})", ""]
+    lines.append(f"{'NODE':28s} {'KIND':8s} {'UP':3s} READY")
+    for addr, n in sorted(h.get("nodes", {}).items()):
+        ready = "-"
+        if n.get("up"):
+            try:
+                call(addr, "/readyz", timeout=2)
+                ready = "yes"
+            except (RpcError, OSError):
+                ready = "NO"
+        lines.append(f"{addr:28s} {n.get('kind', '?'):8s} "
+                     f"{'up' if n.get('up') else 'DOWN':3s} {ready}")
+    lines.append("")
+    lines.append(f"{'SLO RULE':20s} {'BURN 5m':>8s} {'BURN 1h':>8s} "
+                 f"{'P99 ms':>8s} STATE")
+    for name, a in sorted(h.get("slo", {}).items()):
+        p99 = a.get("detail", {}).get("p99_ms")
+        lines.append(
+            f"{name:20s} {a.get('burn_fast', 0):8.2f} "
+            f"{a.get('burn_slow', 0):8.2f} "
+            f"{p99 if p99 is not None else '-':>8} "
+            f"{'FIRING' if a.get('firing') else 'ok'}")
+    events = h.get("events", [])[-8:]
+    if events:
+        lines.append("")
+        lines.append("RECENT EVENTS")
+        for e in events:
+            lines.append(f"  {e['ts']:.1f} {e['kind']:16s} "
+                         f"{e.get('service', ''):8s} {e.get('node', '')}")
+    return lines
+
+
+def cmd_top(args):
+    """Live terminal view over GET /cluster/health (+ per-node readyz
+    probes) — the cluster-wide answer to `kubectl get nodes`."""
+    import time as _time
+
+    frames = 0
+    while True:
+        try:
+            h = call(args.master, "/cluster/health", timeout=5)
+        except (RpcError, OSError) as e:
+            print(f"error: master {args.master} unreachable: {e}")
+            sys.exit(1)
+        lines = _render_top(h, args.master)
+        if not args.once and sys.stdout.isatty():
+            sys.stdout.write("\x1b[2J\x1b[H")
+        print("\n".join(lines), flush=True)
+        frames += 1
+        if args.once or (args.n and frames >= args.n):
+            return
+        try:
+            _time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return
+
+
+def cmd_lint_dashboards(args):
+    """Grafana-vs-registry + SLO-rule lint; non-zero exit on any
+    dangling metric reference (wired into the perf_smoke tests)."""
+    from seaweedfs_tpu.stats import lint
+
+    problems = lint.run(args.path or None)
+    for prob in problems:
+        print(f"lint: {prob}")
+    if problems:
+        sys.exit(1)
+    print("dashboards + SLO rules reference only registered families")
+
+
 def cmd_scaffold(args):
     from seaweedfs_tpu.util.config import scaffold
 
@@ -1344,6 +1421,24 @@ def main(argv=None):
     p.add_argument("-collection", default="",
                    help="run: collection for the explicit job")
     p.set_defaults(fn=cmd_maintenance)
+
+    p = sub.add_parser("top", help="live cluster health view "
+                                   "(/cluster/health + readyz probes)")
+    p.add_argument("-master", default="127.0.0.1:9333")
+    p.add_argument("-interval", type=float, default=2.0,
+                   help="seconds between redraws")
+    p.add_argument("-n", type=int, default=0,
+                   help="frames to render (0 = until interrupted)")
+    p.add_argument("-once", action="store_true",
+                   help="print one frame and exit (scripting)")
+    p.set_defaults(fn=cmd_top)
+
+    p = sub.add_parser("lint-dashboards",
+                       help="check grafana panels and SLO rules against "
+                            "the metrics registry")
+    p.add_argument("-path", default="",
+                   help="dashboard json (default: bundled dashboard)")
+    p.set_defaults(fn=cmd_lint_dashboards)
 
     p = sub.add_parser("benchmark", help="write/read load benchmark")
     p.add_argument("-master", default="127.0.0.1:9333")
