@@ -1918,6 +1918,188 @@ def bench_elasticity(num_objects: int = 150,
         _cleanup_scale_workdirs()
 
 
+def bench_topology_evolution(num_objects: int = 200,
+                             probe_reqs: int = 200,
+                             grow_timeout: float = 40.0,
+                             split_timeout: float = 60.0) -> dict:
+    """Online topology evolution under load: a 1-master / 2-shard filer
+    cluster serves a steady metadata replay (baseline p99), then grows
+    the control plane 1->3 masters (learner join, snapshot catch-up,
+    voter promotion) and splits the filer map 2->8 shards (two-phase
+    dual-write handover) while a background writer keeps inserting.
+    Reports the wall time of each transition, read p99 at every
+    topology, and the acked-write ledger — a lost acked write or a
+    failed insert is the regression this phase exists to catch."""
+    import tempfile
+    import threading
+
+    from seaweedfs_tpu import loadgen
+    from seaweedfs_tpu.filer.entry import Entry
+    from seaweedfs_tpu.filer.filer_store import ShardedSqliteStore
+    from seaweedfs_tpu.filer.store_server import FilerStoreServer
+    from seaweedfs_tpu.master.server import MasterServer
+    from seaweedfs_tpu.rpc.http_rpc import RpcError, call
+
+    overrides = {"WEED_FILER_SHARDS": "2",
+                 "WEED_FILER_SHARD_LEASE": "2.0"}
+    saved = {k: os.environ.get(k) for k in overrides}
+    os.environ.update(overrides)
+    workdir = tempfile.mkdtemp(prefix="swbench_topology_")
+    d0 = os.path.join(workdir, "m0")
+    os.makedirs(d0)
+    m0 = MasterServer(port=0, pulse_seconds=0.5, raft_dir=d0,
+                      raft_election_timeout=0.3,
+                      maintenance_interval=3600.0)
+    m0.start()
+    stores = []
+    for i in range(2):
+        s = FilerStoreServer(
+            port=0, store=ShardedSqliteStore(
+                os.path.join(workdir, f"s{i}"), shard_count=2),
+            masters=[m0.address])
+        s.start()
+        stores.append(s)
+    new_masters: list = []
+
+    def insert(path: str, timeout: float = 5.0) -> bool:
+        for s in stores:
+            try:
+                call(s.address, "/store/insert",
+                     payload=Entry(full_path=path).to_dict(),
+                     method="POST", timeout=timeout)
+                return True
+            except RpcError:
+                continue
+        return False
+
+    def readable(path: str) -> bool:
+        for s in stores:
+            try:
+                call(s.address, "/store/find?path=" + path, timeout=5)
+                return True
+            except RpcError:
+                continue
+        return False
+
+    def probe(paths: list, reqs: int) -> float:
+        """Closed-loop metadata-read storm; returns p99 ms."""
+        sched = [loadgen.Request(
+            t=0.0, op="GET", obj=i, size=64,
+            tenant="bench", qos_class="interactive")
+            for i in range(reqs)]
+        out = loadgen.replay(
+            sched,
+            lambda r: readable(paths[r.obj % len(paths)]),
+            workers=4, open_loop=False)
+        return out["p99_ms"]
+
+    def wait_for(pred, timeout: float) -> float:
+        """Poll until pred(); returns elapsed seconds or -1."""
+        t0 = time.monotonic()
+        deadline = t0 + timeout
+        while time.monotonic() < deadline:
+            if pred():
+                return time.monotonic() - t0
+            time.sleep(0.05)
+        return -1.0
+
+    acked: list = []
+    failed = [0]
+    stop = threading.Event()
+
+    def writer_loop():
+        i = 0
+        while not stop.is_set():
+            path = f"/evo{i}/obj"
+            ok = False
+            for _ in range(3):      # bounded retry: acked or failed
+                if insert(path):
+                    ok = True
+                    break
+                time.sleep(0.05)
+            if ok:
+                acked.append(path)
+            else:
+                failed[0] += 1
+            i += 1
+            time.sleep(0.01)
+
+    grow_seconds = split_seconds = -1.0
+    steady_p99 = grown_p99 = split_p99 = 0.0
+    lost_acked = 0
+    try:
+        ok = wait_for(
+            lambda: sum(len(s._held) for s in stores) == 2, 20.0)
+        assert ok >= 0, "shard leases never converged"
+        seeds = [f"/seed{i}/obj" for i in range(num_objects)]
+        for p in seeds:
+            insert(p, timeout=30.0)
+        steady_p99 = probe(seeds, probe_reqs)
+
+        writer = threading.Thread(target=writer_loop, daemon=True)
+        writer.start()
+
+        # -- grow the control plane 1 -> 3 (learner join) --------------
+        for i in (1, 2):
+            d = os.path.join(workdir, f"m{i}")
+            os.makedirs(d)
+            m = MasterServer(port=0, pulse_seconds=0.5, raft_dir=d,
+                             peers=[m0.address], join=True,
+                             raft_election_timeout=0.3,
+                             maintenance_interval=3600.0)
+            m.start()
+            new_masters.append(m)
+        grow_seconds = wait_for(
+            lambda: all(m.address in m0.raft.voters
+                        for m in new_masters), grow_timeout)
+        grown_p99 = probe(seeds, probe_reqs)
+
+        # -- split the filer map 2 -> 8 under the same write load ------
+        call(m0.address, "/filer/shard_resize",
+             payload={"op": "start", "to": 8}, method="POST",
+             timeout=10)
+
+        def split_done():
+            r = call(m0.address, "/filer/shards", timeout=5)
+            return r["slots"] == 8 and not r.get("resize")
+
+        split_seconds = wait_for(split_done, split_timeout)
+        wait_for(lambda: sum(len(s._held) for s in stores) == 8, 20.0)
+        split_p99 = probe(seeds, probe_reqs)
+
+        stop.set()
+        writer.join(timeout=10)
+        sample = acked[::max(1, len(acked) // 200)]
+        lost_acked = sum(1 for p in sample if not readable(p))
+    finally:
+        stop.set()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        for s in stores:
+            s.stop()
+        for m in new_masters:
+            m.stop()
+        m0.stop()
+        shutil.rmtree(workdir, ignore_errors=True)
+    return {
+        "metric": "topology_evolution",
+        "masters": 1 + len(new_masters),
+        "shards_from": 2,
+        "shards_to": 8,
+        "grow_seconds": round(grow_seconds, 2),
+        "split_seconds": round(split_seconds, 2),
+        "steady_p99_ms": round(steady_p99, 3),
+        "grown_p99_ms": round(grown_p99, 3),
+        "split_p99_ms": round(split_p99, 3),
+        "acked_writes": len(acked),
+        "failed_writes": failed[0],
+        "lost_acked": lost_acked,
+    }
+
+
 def bench_gateway_workers(counts: tuple = (1, 2, 4), num_files: int = 300,
                           read_reqs: int = 1500,
                           payload_bytes: int = 2048) -> dict:
@@ -2274,6 +2456,15 @@ def main():
     except Exception as e:
         print(f"note: elasticity bench failed: {e}", file=sys.stderr)
 
+    # -- online topology evolution: master growth + shard split --------------
+    topology_stats: dict = {}
+    try:
+        _policy.reset_state()
+        topology_stats = bench_topology_evolution()
+    except Exception as e:
+        print(f"note: topology evolution bench failed: {e}",
+              file=sys.stderr)
+
     # -- prefork gateway worker scaling (smallfile read rps) -----------------
     gateway_workers_stats: dict = {}
     try:
@@ -2360,6 +2551,7 @@ def main():
         "read_cache": read_cache_stats,
         "cluster_scale": cluster_scale_stats,
         "elasticity": elasticity_stats,
+        "topology_evolution": topology_stats,
         "gateway_workers": gateway_workers_stats,
         "smallfile_secured_vs_plain_write": (
             round(sec_write_rps / sf_write_rps, 2) if sf_write_rps
@@ -2465,6 +2657,7 @@ if __name__ == "__main__":
                "read_cache": bench_read_cache,
                "cluster_scale": bench_cluster_scale,
                "elasticity": bench_elasticity,
+               "topology_evolution": bench_topology_evolution,
                "gateway_workers": bench_gateway_workers,
                # alias: the curve IS the smallfile read-rps phase
                "smallfile_read_rps": bench_gateway_workers}

@@ -87,6 +87,16 @@ class FilerStoreServer:
         self._lease_stop = threading.Event()
         self._lease_thread: Optional[threading.Thread] = None
         self._pulled: set[int] = set()  # slots already handover-filled
+        # online split/merge (two-phase): while a resize is in prepare,
+        # a STAGING store holds the target layout; every write lands in
+        # both (dual-write) and held slots are copied over, so at commit
+        # the staging store simply becomes the store
+        self._resize: Optional[dict] = None
+        self._staging: Optional[FilerStore] = None
+        self._staging_to = 0
+        self._staged: set[int] = set()   # slots copied into staging
+        self._retired_stores: list = []  # pre-flip stores, kept open
+                                         # for readers already inside
         self.server = RpcServer(host, port)
         self.server.add("POST", "/store/insert", self._h_insert)
         self.server.add("POST", "/store/update", self._h_insert)
@@ -129,6 +139,10 @@ class FilerStoreServer:
                 pass  # the lease TTL frees them anyway
         self.server.stop()
         self.store.close()
+        if self._staging is not None:
+            self._staging.close()
+        for s in self._retired_stores:
+            s.close()
 
     # -- shard-lease protocol -------------------------------------------------
     def _master_call(self, path: str, payload: dict) -> dict:
@@ -154,6 +168,11 @@ class FilerStoreServer:
         r = self._master_call("/filer/shard_lease",
                               {"holder": self.address,
                                "ttl": self._lease_ttl})
+        total = int(r.get("slots_total") or self._slots)
+        if total != self._slots:
+            # the slot map flipped to a new count: adopt the staged
+            # layout BEFORE interpreting slot numbers from this reply
+            self._adopt_layout(total)
         granted = set(int(s) for s in r.get("slots", []))
         prev = {int(k): v
                 for k, v in (r.get("prev") or {}).items() if v}
@@ -163,32 +182,126 @@ class FilerStoreServer:
         # granted slot must not answer "not found" for entries its
         # previous holder still has (requests 503 until then — the
         # clients' retry window, not a wrong answer)
+        not_ready: set[int] = set()
         for slot in sorted(fresh):
-            self._pull_handover(slot, prev.get(slot, ""))
+            if not self._pull_handover(slot, prev.get(slot) or []):
+                not_ready.add(slot)
         with self._lock:
-            self._held = granted
+            self._held = granted - not_ready
             self._map = {int(k): v
                          for k, v in (r.get("map") or {}).items()}
             self._epoch = int(r.get("epoch", 0))
+            self._resize = r.get("resize")
+        rz = r.get("resize")
+        if rz and rz.get("phase") == "prepare":
+            self._prepare_resize(int(rz["to"]))
 
-    def _pull_handover(self, slot: int, prev_holder: str):
+    def _pull_handover(self, slot: int, sources) -> bool:
         """Best-effort: copy a newly-granted slot's entries from its
-        previous holder (graceful rebalance keeps data; after a crash the
-        slot starts empty but WRITABLE — availability over history)."""
-        if not prev_holder or prev_holder == self.address \
-                or slot in self._pulled:
-            return
+        previous holder(s) — a merge can fold several (graceful
+        rebalance keeps data; after a crash the slot starts empty but
+        WRITABLE — availability over history).  Returns False when a
+        live source is still on a different slot layout (409): the slot
+        is withheld this cycle and retried, never served half-filled."""
+        if isinstance(sources, str):  # pre-resize masters send one addr
+            sources = [sources] if sources else []
+        sources = [s for s in sources if s and s != self.address]
+        if not sources or slot in self._pulled:
+            return True
         if not hasattr(self.store, "load_slot"):
-            return
-        try:
-            r = call(prev_holder, f"/store/dump?slot={slot}", timeout=30)
-            self.store.load_slot(slot, r.get("entries", []))
+            return True
+        ok = True
+        for src in sources:
+            try:
+                r = call(src,
+                         f"/store/dump?slot={slot}&slots={self._slots}",
+                         timeout=30)
+                self.store.load_slot(slot, r.get("entries", []))
+                glog.infof("filer.store: slot %d handover from %s "
+                           "(%d entries)", slot, src,
+                           len(r.get("entries", [])))
+            except RpcError as e:
+                if e.status == 409:
+                    # source still dumping the OLD layout: copying now
+                    # would interleave two hash spaces — wait it out
+                    ok = False
+                    continue
+                pass  # holder gone: take over without its entries
+        if ok:
             self._pulled.add(slot)
-            glog.infof("filer.store: slot %d handover from %s "
-                       "(%d entries)", slot, prev_holder,
-                       len(r.get("entries", [])))
-        except RpcError:
-            self._pulled.add(slot)  # holder gone: take over empty
+        return ok
+
+    # -- online split / merge participation ------------------------------------
+    def _staging_dir(self, to: int) -> str:
+        return getattr(self.store, "directory", "") + f".r{to}"
+
+    def _prepare_resize(self, to: int):
+        """Prepare phase: stand up the target-layout staging store,
+        dual-write into it (enabled the moment _staging is set), copy
+        every held slot's entries across, then ack to the master.
+        Idempotent — runs once per lease cycle until the commit."""
+        if not hasattr(self.store, "dump_slot"):
+            # nothing local to re-shard (memory store): ready at once
+            self._ack_resize()
+            return
+        with self._lock:
+            if self._staging is None or self._staging_to != to:
+                self._staging = ShardedSqliteStore(
+                    self._staging_dir(to), shard_count=to)
+                self._staging_to = to
+                self._staged = set()
+        while True:
+            with self._lock:
+                todo = sorted(self._held - self._staged)
+                if not todo:
+                    break
+                slot = todo[0]
+                # the whole slot copy holds the write lock, so no entry
+                # can slip between the dump and the dual-write window
+                for d in self.store.dump_slot(slot):
+                    self._staging.insert_entry(Entry.from_dict(d))
+                self._staged.add(slot)
+        self._ack_resize()
+
+    def _ack_resize(self):
+        try:
+            self._master_call("/filer/shard_resize",
+                              {"op": "ack", "holder": self.address})
+        except RpcError as e:
+            glog.v(1).infof("filer.store: resize ack failed: %s", e)
+
+    def _adopt_layout(self, total: int):
+        """Commit phase: the map flipped — the staging store becomes THE
+        store.  A holder that crashed during prepare (no staging)
+        rebuilds the target layout from its local shards first; local
+        re-sharding is lossless because the new count divides (or is a
+        multiple of) the old one."""
+        with self._lock:
+            if total == self._slots:
+                return
+            if hasattr(self.store, "dump_slot"):
+                if self._staging is None or self._staging_to != total:
+                    glog.warningf(
+                        "filer.store: layout flip to %d slots without "
+                        "staged data; re-sharding locally", total)
+                    staging = ShardedSqliteStore(
+                        self._staging_dir(total), shard_count=total)
+                    for slot in range(
+                            getattr(self.store, "shard_count", 0)):
+                        for d in self.store.dump_slot(slot):
+                            staging.insert_entry(Entry.from_dict(d))
+                    self._staging = staging
+                self._retired_stores.append(self.store)
+                self.store = self._staging
+            self._staging = None
+            self._staging_to = 0
+            self._staged = set()
+            self._slots = total
+            self._held = set()
+            self._pulled = set()
+            self._resize = None
+        glog.infof("filer.store: %s adopted %d-slot layout",
+                   self.address, total)
 
     def _lease_loop(self):
         period = max(0.5, self._lease_ttl / 3.0)
@@ -234,6 +347,8 @@ class FilerStoreServer:
             return self._proxy(req, owner, "/store/insert", payload=d)
         with self._lock:
             self.store.insert_entry(entry)
+            if self._staging is not None:
+                self._staging.insert_entry(entry)
         return {}
 
     def _h_find(self, req: Request):
@@ -259,12 +374,16 @@ class FilerStoreServer:
             return self._proxy(req, owner, "/store/delete", payload=d)
         with self._lock:
             self.store.delete_entry(path)
+            if self._staging is not None:
+                self._staging.delete_entry(path)
         return {}
 
     def _h_delete_children(self, req: Request):
         d = req.json()
         with self._lock:
             self.store.delete_folder_children(d.get("path", ""))
+            if self._staging is not None:
+                self._staging.delete_folder_children(d.get("path", ""))
             holders = (set(self._map.values()) - {self.address}
                        if not req.headers.get(HOP_HEADER) else set())
         # descendant dirs hash to arbitrary slots: fan out to every
@@ -336,6 +455,8 @@ class FilerStoreServer:
             return
         with self._lock:
             self.store.insert_entry(entry)
+            if self._staging is not None:
+                self._staging.insert_entry(entry)
 
     def _h_delete_routed(self, req: Request, path: str):
         parent = path.rsplit("/", 1)[0] or "/"
@@ -346,17 +467,28 @@ class FilerStoreServer:
             return
         with self._lock:
             self.store.delete_entry(path)
+            if self._staging is not None:
+                self._staging.delete_entry(path)
 
     def _h_dump(self, req: Request):
-        """Slot handover source: every entry in one local shard slot."""
+        """Slot handover source: every entry in one local shard slot.
+        The caller declares its slot layout (`slots=`); a mismatch is a
+        409 — serving slot s of an N-slot space from an M-slot store
+        would silently hand over the wrong hash range."""
         slot = int(req.param("slot", "-1"))
         if slot < 0:
             raise RpcError("slot required", 400)
+        expected = req.param("slots", "") or ""
+        if expected and int(expected) != self._slots:
+            raise RpcError(
+                f"shard layout mismatch: have {self._slots} slots, "
+                f"caller expects {expected}", 409)
         if not hasattr(self.store, "dump_slot"):
             raise RpcError(
                 f"{type(self.store).__name__} is not slot-addressable",
                 400)
-        return {"slot": slot, "entries": self.store.dump_slot(slot)}
+        return {"slot": slot, "slots": self._slots,
+                "entries": self.store.dump_slot(slot)}
 
     def _h_status(self, req: Request):
         with self._lock:
@@ -365,6 +497,9 @@ class FilerStoreServer:
                     "slots": self._slots,
                     "held": sorted(self._held),
                     "epoch": self._epoch,
+                    "resize": dict(self._resize) if self._resize
+                    else None,
+                    "staged": sorted(self._staged),
                     "map": {str(k): v
                             for k, v in sorted(self._map.items())}}
 

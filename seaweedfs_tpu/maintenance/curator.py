@@ -20,7 +20,8 @@ from typing import Optional
 
 from ..util import glog
 from . import detectors
-from .jobs import (JOB_TYPES, LEASED, TYPE_BALANCE, TYPE_DEEP_SCRUB,
+from .jobs import (JOB_TYPES, LEASED, TYPE_SHARD_SPLIT,
+                   TYPE_BALANCE, TYPE_DEEP_SCRUB,
                    TYPE_EC_REBUILD, TYPE_SCALE_DRAIN, TYPE_SCALE_UP, Job)
 from .queue import JobQueue
 
@@ -243,7 +244,43 @@ class Curator:
                                     service="master", node=spec["type"],
                                     detail={"id": jid,
                                             "volume": spec["volume"]})
+        self._scan_shard_scale(now, cooldown)
         return ids
+
+    def _scan_shard_scale(self, now: float, cooldown: float):
+        """Shard-count elasticity: unlike volume-server jobs these are
+        not queued for workers — the curator proposes the filer.resize
+        directly and the master's driver completes the two-phase flip."""
+        raft = getattr(self.master, "raft", None)
+        if raft is None or getattr(raft, "fsm", None) is None \
+                or not hasattr(raft, "lock"):
+            return
+        with raft.lock:
+            m = raft.fsm.shard_map
+            shards = {"slots": m.slots,
+                      "holders": sum(1 for exp in m.members.values()
+                                     if exp > now),
+                      "resize": m.resize is not None}
+        for spec in detectors.scan_shard_scale(shards):
+            if now - self._recent.get((spec["type"], 0), 0) < cooldown:
+                continue
+            try:
+                r = raft.propose({"type": "filer.resize", "op": "start",
+                                  "to": int(spec["params"]["to"]),
+                                  "now": now})
+            except Exception:
+                continue  # lost leadership mid-tick: next leader rescans
+            if isinstance(r, dict) and r.get("error"):
+                continue
+            self._recent[(spec["type"], 0)] = now
+            from ..stats import events as events_mod
+
+            events_mod.emit(
+                events_mod.SHARD_SPLIT
+                if spec["type"] == TYPE_SHARD_SPLIT
+                else events_mod.SHARD_MERGE,
+                service="master", node="curator",
+                detail=dict(spec["params"], phase="prepare"))
 
     # -- completion hook -----------------------------------------------------
     def on_complete(self, job, report: Optional[dict]):

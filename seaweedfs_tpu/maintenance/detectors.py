@@ -16,7 +16,8 @@ from typing import Optional
 from ..storage.erasure_coding import TOTAL_SHARDS_COUNT
 from .jobs import (TYPE_BALANCE, TYPE_DEEP_SCRUB, TYPE_EC_REBUILD,
                    TYPE_FIX_REPLICATION, TYPE_SCALE_DRAIN,
-                   TYPE_SCALE_UP, TYPE_VACUUM)
+                   TYPE_SCALE_UP, TYPE_SHARD_MERGE, TYPE_SHARD_SPLIT,
+                   TYPE_VACUUM)
 
 
 def _env_float(name: str, default: float) -> float:
@@ -238,4 +239,50 @@ def scan_scale(snap: dict, scale_enabled: Optional[bool] = None,
                  "params": {"server": victim["url"],
                             "occupancy": round(max(occs), 4),
                             "rps": round(mean_rps, 1)}}]
+    return []
+
+
+def scan_shard_scale(shards: dict,
+                     enabled: Optional[bool] = None,
+                     split_per_holder: Optional[float] = None,
+                     merge_per_holder: Optional[float] = None
+                     ) -> list[dict]:
+    """Filer shard-count elasticity over the replicated shard map.
+
+    Opt-in via WEED_SHARD_SCALE=1.  `shards` is the curator's view:
+    {"slots": N, "holders": active store servers, "resize": in-flight}.
+    SPLIT when holders outgrow the slot space (fewer than
+    WEED_SHARD_SPLIT_PER_HOLDER slots per holder means joiners sit
+    idle) — to the smallest doubling that restores the floor.  MERGE
+    one halving at a time when the space is far too fine
+    (more than WEED_SHARD_MERGE_PER_HOLDER slots per holder), so a
+    shrunk fleet stops paying per-slot lease/handover overhead.  The
+    doubling/halving rule keeps old and new counts divisible, which is
+    what makes holders' re-sharding purely local."""
+    if enabled is None:
+        enabled = os.environ.get("WEED_SHARD_SCALE", "0") not in (
+            "0", "", "false", "no")
+    if not enabled or shards.get("resize"):
+        return []
+    slots = int(shards.get("slots", 0))
+    holders = int(shards.get("holders", 0))
+    if slots <= 0 or holders <= 0:
+        return []
+    if split_per_holder is None:
+        split_per_holder = _env_float("WEED_SHARD_SPLIT_PER_HOLDER", 1.0)
+    if merge_per_holder is None:
+        merge_per_holder = _env_float("WEED_SHARD_MERGE_PER_HOLDER",
+                                      16.0)
+    if split_per_holder > 0 and slots < holders * split_per_holder:
+        to = slots
+        while to < holders * split_per_holder:
+            to *= 2
+        return [{"type": TYPE_SHARD_SPLIT, "volume": 0, "collection": "",
+                 "params": {"from": slots, "to": to,
+                            "holders": holders}}]
+    if merge_per_holder > 0 and slots % 2 == 0 \
+            and slots > holders * merge_per_holder:
+        return [{"type": TYPE_SHARD_MERGE, "volume": 0, "collection": "",
+                 "params": {"from": slots, "to": slots // 2,
+                            "holders": holders}}]
     return []

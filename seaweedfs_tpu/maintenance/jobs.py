@@ -24,6 +24,10 @@ TYPE_DEEP_SCRUB = "deep.scrub"
 TYPE_BALANCE = "balance"
 TYPE_SCALE_UP = "scale.up"
 TYPE_SCALE_DRAIN = "scale.drain"
+# filer shard-count elasticity: handled by the curator proposing
+# filer.resize through raft directly, never enqueued as worker jobs
+TYPE_SHARD_SPLIT = "filer.shard_split"
+TYPE_SHARD_MERGE = "filer.shard_merge"
 
 PRIORITIES = {
     TYPE_EC_REBUILD: 0,

@@ -8,6 +8,7 @@ log covering everything a failed-over leader must resume with exactly:
   topology.epoch    placement-generation bump (volume growth)
   curator.*         every maintenance/queue.py mutation
   filer.lease       the directory-prefix shard map for filer metadata
+  filer.resize      online shard split/merge (two-phase prepare/commit)
 
 Commands are plain JSON dicts carrying their own `now` timestamp, so
 replaying the same log (or a snapshot + suffix) on a fresh node yields
@@ -130,6 +131,23 @@ class ControlFSM:
         return self.shard_map.lease(cmd.get("holder", ""), self._now,
                                     float(cmd.get("ttl", 10.0)))
 
+    def _apply_filer_resize(self, cmd: dict):
+        """Online shard split/merge, two-phase: start opens the prepare
+        window (holders dual-write + re-shard locally), ack records one
+        holder's readiness, commit flips the map, abort cancels."""
+        op = cmd.get("op", "")
+        if op == "start":
+            return self.shard_map.resize_start(int(cmd.get("to", 0)),
+                                               self._now)
+        if op == "ack":
+            return self.shard_map.resize_ack(cmd.get("holder", ""),
+                                             self._now)
+        if op == "commit":
+            return self.shard_map.resize_commit(self._now)
+        if op == "abort":
+            return self.shard_map.resize_abort(self._now)
+        return {"error": f"unknown resize op {op!r}"}
+
     _HANDLERS = {
         "volume.assign": _apply_volume_assign,
         "topology.epoch": _apply_topology_epoch,
@@ -141,6 +159,7 @@ class ControlFSM:
         "curator.expire": _apply_curator_expire,
         "curator.pause": _apply_curator_pause,
         "filer.lease": _apply_filer_lease,
+        "filer.resize": _apply_filer_resize,
     }
 
     # -- snapshot / restore ----------------------------------------------------

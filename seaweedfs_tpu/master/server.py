@@ -27,7 +27,7 @@ from ..stats import metrics as stats
 from ..storage import types as t
 from ..storage.super_block import ReplicaPlacement
 from ..storage.ttl import TTL
-from ..util import faults
+from ..util import faults, glog
 from . import volume_growth
 from .raft import RaftNode
 from .topology import Topology
@@ -53,7 +53,8 @@ class MasterServer:
                  raft_election_timeout: Optional[float] = None,
                  auto_vacuum_interval: float = 15 * 60.0,
                  enable_native_assign: bool = False,
-                 maintenance_interval: Optional[float] = None):
+                 maintenance_interval: Optional[float] = None,
+                 join: bool = False):
         self.topo = Topology(
             volume_size_limit=volume_size_limit_mb * 1024 * 1024,
             pulse_seconds=pulse_seconds)
@@ -63,12 +64,20 @@ class MasterServer:
         self.server = RpcServer(host, port, service_name="master")
         if raft_election_timeout is None:
             raft_election_timeout = _env_float("WEED_RAFT_ELECTION", 0.8)
+        # `join`: this master is NOT part of the configured cluster yet —
+        # it boots as a non-voting learner and registers with the leader
+        # via /raft/join; the leader commits the membership change and
+        # auto-promotes it to voter once its log has caught up
+        self.join_mode = bool(join)
+        self._join_targets = list(peers or [])
         self.raft = RaftNode(
             self.server.address,
+            (peers or []) if join else
             (peers or []) + [self.server.address],
             state_dir=raft_dir,
             election_timeout=raft_election_timeout,
-            heartbeat_interval=_env_float("WEED_RAFT_HEARTBEAT", 0.25))
+            heartbeat_interval=_env_float("WEED_RAFT_HEARTBEAT", 0.25),
+            learner=join)
         self.topo.vid_allocator = self.raft.next_volume_id
         self.topo.max_volume_id = self.raft.max_volume_id
         # location-change feed for /dir/watch long-polls (KeepConnected).
@@ -101,6 +110,7 @@ class MasterServer:
         self.curator.alerts_fn = self.health.firing
         self.raft.on_become_leader = self._on_leader
         self.raft.on_step_down = self._on_step_down
+        self.raft.on_membership = self._on_membership
         self._register_routes()
         self._reaper: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -117,6 +127,8 @@ class MasterServer:
     def start(self):
         self.server.start()
         self.raft.start()
+        if self.join_mode:
+            threading.Thread(target=self._join_loop, daemon=True).start()
         self._reaper = threading.Thread(target=self._reap_loop, daemon=True)
         self._reaper.start()
         self.curator.start()
@@ -250,6 +262,72 @@ class MasterServer:
         # burns its own thread on the holder RPCs.
         while not self._stop.wait(self.topo.pulse_seconds):
             self.topo.reap_dead_nodes()
+            try:
+                self._drive_shard_resize()
+            except Exception as e:  # driver must not kill the reaper
+                glog.v(1).infof("shard-resize driver: %s", e)
+
+    def _join_loop(self):
+        """Learner registration: keep asking the existing cluster to
+        admit us until a leader commits the add_learner entry (the
+        leader then replicates/snapshots us up and auto-promotes)."""
+        payload = {"address": self.address}
+        while not self._stop.wait(1.0):
+            with self.raft.lock:
+                if self.address in self.raft.voters:
+                    return  # promoted: registration complete
+            for target in self._join_targets:
+                try:
+                    call(target, "/raft/join", payload=payload,
+                         method="POST", timeout=5)
+                    break
+                except RpcError as e:
+                    hint = (e.headers or {}).get("X-Raft-Leader", "")
+                    if hint and hint != target:
+                        try:
+                            call(hint, "/raft/join", payload=payload,
+                                 method="POST", timeout=5)
+                            break
+                        except RpcError:
+                            continue
+
+    def _drive_shard_resize(self):
+        """Leader-side two-phase coordinator for filer shard split/merge:
+        once every active holder acked its local re-shard, commit the
+        slot-map flip; a prepare that cannot complete within
+        WEED_SHARD_RESIZE_TIMEOUT is aborted (holders discard staging
+        on the next lease)."""
+        if not self.raft.is_leader:
+            return
+        now = time.time()
+        with self.raft.lock:
+            m = self.raft.fsm.shard_map
+            if m.resize is None:
+                return
+            rz = dict(m.resize)
+            frm = m.slots
+            pending = m.resize_pending(now)
+        kind = (events_mod.SHARD_SPLIT if int(rz["to"]) > frm
+                else events_mod.SHARD_MERGE)
+        if not pending:
+            r = self.raft.propose({"type": "filer.resize",
+                                   "op": "commit", "now": now})
+            if isinstance(r, dict) and not r.get("error"):
+                events_mod.emit(kind, service="master",
+                                node=self.address,
+                                detail={"from": frm, "to": rz["to"],
+                                        "phase": "commit",
+                                        "epoch": r.get("epoch")})
+        elif now - float(rz.get("started", now)) > \
+                _env_float("WEED_SHARD_RESIZE_TIMEOUT", 60.0):
+            r = self.raft.propose({"type": "filer.resize",
+                                   "op": "abort", "now": now})
+            if isinstance(r, dict) and not r.get("error"):
+                events_mod.emit(kind, service="master",
+                                node=self.address,
+                                detail={"from": frm, "to": rz["to"],
+                                        "phase": "abort",
+                                        "waiting_on": pending})
 
     # -- routes --------------------------------------------------------------
     def _guarded(self, fn):
@@ -294,10 +372,13 @@ class MasterServer:
         s.add("GET", "/raft/status", self._handle_raft_status)
         s.add("POST", "/raft/add_peer", g(self._handle_raft_add_peer))
         s.add("POST", "/raft/remove_peer", g(self._handle_raft_remove_peer))
+        s.add("POST", "/raft/join", self._handle_raft_join)
         s.add("POST", "/raft/update_peers",
               lambda req: (self.raft.set_peers(req.json()["peers"]),
                            {"peers": self.raft.peers})[1])
         s.add("POST", "/filer/shard_lease", self._handle_filer_shard_lease)
+        s.add("POST", "/filer/shard_resize",
+              self._handle_filer_shard_resize)
         s.add("GET", "/filer/shards", self._handle_filer_shards)
         s.add("POST", "/dir/leave", self._handle_leave)
         s.add("GET", "/col/list", self._handle_collection_list)
@@ -324,6 +405,16 @@ class MasterServer:
         events_mod.emit(events_mod.LEADER_ELECTED, service="master",
                         node=self.address,
                         detail={"term": self.raft.term})
+
+    def _on_membership(self, change: dict):
+        """Committed raft.config entry (leader-side): journal it so the
+        cluster history shows who joined/left and why."""
+        events_mod.emit(events_mod.MEMBERSHIP, service="master",
+                        node=change.get("address", ""),
+                        detail={"op": change.get("op", ""),
+                                "voters": change.get("voters") or [],
+                                "learners": change.get("learners") or [],
+                                "index": change.get("index", 0)})
 
     def _on_step_down(self):
         events_mod.emit(events_mod.LEADER_STEPDOWN, service="master",
@@ -582,7 +673,38 @@ class MasterServer:
         with self.raft.lock:
             return {"slots": m.slots, "epoch": m.epoch,
                     "map": m.assignments(),
+                    "resize": dict(m.resize) if m.resize else None,
                     "leader": self.raft.leader or ""}
+
+    def _handle_filer_shard_resize(self, req):
+        """Online shard split/merge (filer.shards.split/merge): `start`
+        opens the prepare window, holders `ack` their local re-shard,
+        and the leader's driver commits the flip once all acks land
+        (or aborts on WEED_SHARD_RESIZE_TIMEOUT)."""
+        if not self.raft.is_leader:
+            return self._proxy_to_leader(req, "/filer/shard_resize")
+        d = req.json()
+        op = d.get("op", "")
+        if op not in ("start", "ack", "abort"):
+            raise RpcError(f"unknown resize op {op!r}", 400)
+        cmd = {"type": "filer.resize", "op": op, "now": time.time()}
+        if op == "start":
+            cmd["to"] = int(d.get("to", 0))
+            with self.raft.lock:
+                frm = self.raft.fsm.shard_map.slots
+        if op == "ack":
+            cmd["holder"] = d.get("holder", "")
+        r = self.raft.propose(cmd)
+        if isinstance(r, dict) and r.get("error"):
+            raise RpcError(r["error"], 400)
+        if op == "start":
+            events_mod.emit(
+                events_mod.SHARD_SPLIT if cmd["to"] > frm
+                else events_mod.SHARD_MERGE,
+                service="master", node=self.address,
+                detail={"from": frm, "to": cmd["to"],
+                        "phase": "prepare"})
+        return r
 
     def _handle_leave(self, req):
         """A volume server announces departure (VolumeServerLeave);
@@ -592,17 +714,35 @@ class MasterServer:
         return {}
 
     def _handle_raft_add_peer(self, req):
-        """cluster.raft.add (shell/command_cluster_raft_add.go)."""
-        self.raft.add_peer(req.json()["address"])
-        return {"peers": self.raft.peers}
+        """cluster.raft.add (shell/command_cluster_raft_add.go): commit
+        an add-learner config entry through the log; the leader promotes
+        the learner to voter once it has caught up."""
+        if not self.raft.is_leader and self.raft.leader:
+            return self._proxy_to_leader(req, "/raft/add_peer")
+        change = self.raft.add_server(req.json()["address"])
+        return {"peers": self.raft.peers, "change": change}
 
     def _handle_raft_remove_peer(self, req):
-        """cluster.raft.remove (shell/command_cluster_raft_remove.go)."""
+        """cluster.raft.remove (shell/command_cluster_raft_remove.go):
+        commit a remove config entry; the removed server self-demotes to
+        a single-node observer once it sees the committed entry."""
+        if not self.raft.is_leader and self.raft.leader:
+            return self._proxy_to_leader(req, "/raft/remove_peer")
         try:
-            self.raft.remove_peer(req.json()["address"])
+            change = self.raft.remove_server(req.json()["address"])
         except ValueError as e:
             raise RpcError(str(e), 400)
-        return {"peers": self.raft.peers}
+        return {"peers": self.raft.peers, "change": change}
+
+    def _handle_raft_join(self, req):
+        """A booting learner announces itself (see _join_loop); only the
+        leader can commit the config entry, so followers forward."""
+        address = req.json().get("address", "")
+        if not address:
+            raise RpcError("address required", 400)
+        if not self.raft.is_leader:
+            return self._proxy_to_leader(req, "/raft/join")
+        return self.raft.add_server(address)
 
     # -- collections (master_server_handlers_admin.go /col/*) ----------------
     def _handle_collection_list(self, req):

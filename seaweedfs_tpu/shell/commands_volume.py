@@ -637,6 +637,25 @@ def cluster_raft_remove(env: CommandEnv, address: str) -> dict:
     return env.master("/raft/remove_peer", {"address": address})
 
 
+# -- filer shard split / merge (online slot-count evolution) -----------------
+
+def filer_shards_status(env: CommandEnv) -> dict:
+    return env.master("/filer/shards")
+
+
+def filer_shards_split(env: CommandEnv, to: int) -> dict:
+    """Grow the filer metadata slot count online (two-phase: holders
+    re-shard locally + dual-write, then the map flips atomically)."""
+    return env.master("/filer/shard_resize",
+                      {"op": "start", "to": int(to)})
+
+
+def filer_shards_merge(env: CommandEnv, to: int) -> dict:
+    """Shrink the slot count online; same two-phase handover."""
+    return env.master("/filer/shard_resize",
+                      {"op": "start", "to": int(to)})
+
+
 # -- lock / unlock (command_lock_unlock.go, LeaseAdminToken) -----------------
 
 def shell_lock(env: CommandEnv, client: str = "shell") -> dict:

@@ -33,6 +33,7 @@ from . import metrics as _stats
 # event kinds emitted around the tree (free-form, these are the core set)
 LEADER_ELECTED = "raft.leader"
 LEADER_STEPDOWN = "raft.stepdown"
+MEMBERSHIP = "raft.membership"
 NODE_DOWN = "node.down"
 NODE_UP = "node.up"
 SCRAPE_ERROR = "scrape.error"
@@ -42,6 +43,8 @@ JOB_ENQUEUED = "job.enqueued"
 JOB_DONE = "job.done"
 SCALE_UP = "scale.up"
 SCALE_DRAIN = "scale.drain"
+SHARD_SPLIT = "filer.shard_split"
+SHARD_MERGE = "filer.shard_merge"
 DRAIN = "vs.drain"
 READONLY_DEMOTION = "vs.readonly"
 WORKER_RESPAWN = "worker.respawn"

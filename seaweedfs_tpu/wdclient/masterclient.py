@@ -185,8 +185,13 @@ class MasterClient:
         self._seq = max(self._seq, r.get("seq", self._seq))
         leader = r.get("leader")
         if leader and leader not in self.masters:
-            glog.v(1).infof("watch leader %s outside master list", leader)
-        elif leader and leader != self.current_master:
+            # the cluster grew under us (raft membership change):
+            # adopt the new master so failover can reach it, then
+            # follow it like any other leader announcement
+            glog.infof("adopting new master %s announced as leader",
+                       leader)
+            self.masters.append(leader)
+        if leader and leader != self.current_master:
             # follow the announced leader so the next assign goes
             # straight there instead of bouncing off a 409
             self.current_master = leader

@@ -54,14 +54,14 @@ class TestShardMap:
         assert held == set(range(8))
         assert set(r_a["slots"]).isdisjoint(r_b["slots"])
         # the joiner sees the incumbent as handover source
-        assert all(p == "a" for p in r_b["prev"].values())
+        assert all(p == ["a"] for p in r_b["prev"].values())
 
     def test_expiry_frees_slots(self):
         m = ShardMap(slots=8)
         m.lease("a", now=0.0, ttl=5.0)
         r = m.lease("b", now=6.0, ttl=5.0)  # a's lease lapsed
         assert len(r["slots"]) == 8
-        assert all(p == "a" for p in r["prev"].values())
+        assert all(p == ["a"] for p in r["prev"].values())
 
     def test_release_frees_immediately(self):
         m = ShardMap(slots=8)

@@ -73,6 +73,11 @@ class Harness:
         self.net = Net(self.rng)
         self.tmp_path = tmp_path
         self.addrs = [f"fuzz-node-{i}" for i in range(n)]
+        # a restart must reuse the node's ORIGINAL static peer list (a
+        # wiped disk falls back to -peers, never to addresses that
+        # joined later), so pin it before membership fuzz mutates addrs
+        self.bootstrap = list(self.addrs)
+        self.learner_init = set()  # addresses that boot as learners
         self.dirs = {}
         for a in self.addrs:
             d = tmp_path / a
@@ -85,11 +90,12 @@ class Harness:
         self.allocated = []       # successful next_volume_id results
 
     def _make(self, addr):
-        node = RaftNode(addr, list(self.addrs),
+        node = RaftNode(addr, list(self.bootstrap),
                         state_dir=self.dirs[addr],
                         election_timeout=1.0, heartbeat_interval=0.25,
                         clock=self.clock,
-                        transport=self.net.transport(addr))
+                        transport=self.net.transport(addr),
+                        learner=addr in self.learner_init)
         node.rand = self.rng.random
         return node
 
@@ -124,6 +130,17 @@ class Harness:
                 assert prior[1] == cmd, \
                     (f"log mismatch at {key}: {node.address} disagrees "
                      f"with {prior[0]}")
+        # at most ONE uncommitted config change in any leader's log —
+        # the single-server-change safety condition
+        for node in self.live():
+            if node.state == LEADER:
+                pending = [e for e in node.log
+                           if e["index"] > node.commit_index
+                           and isinstance(e["cmd"], dict)
+                           and e["cmd"].get("type") == "raft.config"]
+                assert len(pending) <= 1, \
+                    (f"{len(pending)} config changes in flight on "
+                     f"leader {node.address}")
         # commit stability
         for node in self.live():
             for i in range(node.snapshot_index + 1,
@@ -227,6 +244,141 @@ def test_raft_fuzz(n, seed, tmp_path):
         if node.commit_index == leader.commit_index:
             assert json.dumps(node.fsm.snapshot(), sort_keys=True) == \
                 want, f"FSM divergence on {node.address}"
+
+
+class MemberHarness(Harness):
+    """Harness variant that fuzzes MEMBERSHIP too: spare addresses
+    join as learners (later promoted by the leader), random members
+    get removed, all interleaved with the base partitions / drops /
+    crashes — every base invariant plus the one-config-in-flight rule
+    must hold throughout."""
+
+    def __init__(self, n, seed, tmp_path, spares=2):
+        super().__init__(n, seed, tmp_path)
+        self.spares = [f"fuzz-join-{i}" for i in range(spares)]
+        self.removed = set()
+
+    def _leader(self):
+        for node in self.live():
+            if node.state == LEADER:
+                return node
+        return None
+
+    def try_add(self):
+        if not self.spares:
+            return
+        leader = self._leader()
+        if leader is None:
+            return
+        addr = self.spares[0]
+        if addr not in self.net.nodes:
+            d = self.tmp_path / addr
+            d.mkdir(exist_ok=True)
+            self.dirs[addr] = str(d)
+            self.learner_init.add(addr)
+            self.addrs.append(addr)
+            self.net.nodes[addr] = self._make(addr)
+        try:
+            leader.add_server(addr)
+        except RpcError:
+            return  # change in flight / lost leadership: retried later
+        self.spares.pop(0)
+
+    def try_remove(self):
+        leader = self._leader()
+        if leader is None:
+            return
+        candidates = [a for a in leader.peers if a not in self.removed]
+        if len([a for a in candidates if a in leader.voters]) <= 2:
+            return  # keep >= 2 voters so the fuzz stays live
+        addr = self.rng.choice(candidates)
+        try:
+            leader.remove_server(addr, reason="fuzz")
+        except RpcError:
+            return
+        self.removed.add(addr)
+
+    def live_voters(self):
+        return [n for n in self.live()
+                if not n.observer and n.address in n.voters]
+
+    def step(self):
+        roll = self.rng.random()
+        if roll < 0.88:
+            super().step()
+            return
+        if roll < 0.94:
+            self.try_add()
+        else:
+            self.try_remove()
+        self.check()
+
+    def heal_and_converge(self):
+        self.net.partitions.clear()
+        self.net.drop_pct = 0.0
+        for addr in sorted(self.net.down):
+            self.restart(addr)
+        for _ in range(800):
+            self.clock.advance(0.1)
+            for node in self.live():
+                node.tick()
+            self.check()
+            ldrs = [n for n in self.live() if n.state == LEADER]
+            if len(ldrs) == 1:
+                leader = ldrs[0]
+                leader.tick()
+                leader.tick()
+                # converge over the CURRENT membership: demoted
+                # observers stop receiving appends and stay behind by
+                # design
+                members = [n for n in self.live()
+                           if n.address in leader._known()]
+                if members and all(
+                        n.commit_index == leader.commit_index
+                        for n in members):
+                    return leader
+        raise AssertionError("cluster never converged after healing")
+
+
+@pytest.mark.parametrize("seed", [5, 23])
+def test_raft_membership_fuzz(seed, tmp_path):
+    """Randomized single-server membership changes under the full
+    chaos mix: every raft safety invariant (election safety, log
+    matching, commit stability, linearizable allocation, <= 1 config
+    change in flight) holds, and the healed cluster — whatever its
+    final membership — still commits."""
+    h = MemberHarness(3, seed, tmp_path)
+    for _ in range(200):
+        h.clock.advance(0.1)
+        for node in h.live():
+            node.tick()
+        if any(x.state == LEADER for x in h.live()):
+            break
+    h.check()
+
+    for _ in range(400):
+        h.step()
+
+    leader = h.heal_and_converge()
+    assert not leader.observer
+    # the config the cluster settled on is internally consistent:
+    # every member the leader replicates to agrees on the voter set
+    # at the leader's commit point
+    want_cfg = leader._config_at(leader.commit_index)[0]
+    for node in h.live():
+        if node.address in leader._known() \
+                and node.commit_index == leader.commit_index:
+            assert node._config_at(node.commit_index)[0] == want_cfg
+    # removed members really ended up demoted (once they learned it)
+    for addr in h.removed:
+        node = h.net.nodes.get(addr)
+        if node is not None and addr not in leader._known() \
+                and node._config_index <= node.commit_index \
+                and node._config_index > 0:
+            assert node.observer or addr not in node.voters
+    # ...and the survivors still make progress
+    final = leader.next_volume_id()
+    assert final > (h.allocated[-1] if h.allocated else 0)
 
 
 def test_fuzz_replay_is_deterministic(tmp_path):

@@ -83,9 +83,11 @@ def cmd_master(args):
                      pulse_seconds=args.pulseSeconds,
                      guard=_load_guard(),
                      peers=peers, raft_dir=args.mdir,
-                     enable_native_assign=args.tcp)
+                     enable_native_assign=args.tcp,
+                     join=args.join)
     m.start()
-    print(f"master listening on {m.address}" +
+    mode = " (joining as learner)" if args.join else ""
+    print(f"master listening on {m.address}{mode}" +
           (f", raft peers {m.raft.peers}" if peers else ""))
     _wait_forever([m])
 
@@ -486,6 +488,11 @@ def _shell_handlers(env):
             env, a[0])),
         "cluster.raft.remove": lambda a: show(vol.cluster_raft_remove(
             env, a[0])),
+        "filer.shards": lambda a: show(vol.filer_shards_status(env)),
+        "filer.shards.split": lambda a: show(vol.filer_shards_split(
+            env, int(a[0]))),
+        "filer.shards.merge": lambda a: show(vol.filer_shards_merge(
+            env, int(a[0]))),
         "lock": lambda a: show(vol.shell_lock(env)),
         "unlock": lambda a: show(vol.shell_unlock(env)),
         # fs family
@@ -1240,6 +1247,10 @@ def main(argv=None):
     p.add_argument("-pulseSeconds", type=float, default=5.0)
     p.add_argument("-peers", default="",
                    help="comma-separated other master addresses (raft)")
+    p.add_argument("-join", action="store_true",
+                   help="join the -peers cluster as a non-voting "
+                        "learner (promoted to voter after catch-up) "
+                        "instead of bootstrapping as a voter")
     p.add_argument("-mdir", default="", help="raft state directory")
     p.add_argument("-tcp", action="store_true",
                    help="serve per-file assigns on the native fast-path "
