@@ -2203,6 +2203,135 @@ def bench_gateway_workers(counts: tuple = (1, 2, 4), num_files: int = 300,
     return out
 
 
+def bench_workload_analytics(num_objects: int = 400,
+                             rate_rps: float = 800.0,
+                             duration_s: float = 5.0,
+                             num_parts: int = 3,
+                             read_iters: int = 400) -> dict:
+    """Workload-analytics accuracy + cost: the seeded zipfian replay
+    (loadgen) is fed straight into WEED_HEAT_MAX_KEYS-bounded access
+    recorders sharded across num_parts simulated daemons, merged the
+    way the leader merges heartbeat summaries, and the sketch answers
+    are checked against ground truth computed from the same schedule:
+    every true head key must appear in the merged top-K, and
+    per-tenant byte totals must land within 1%.  Recorder cost is the
+    measured per-record() time expressed as a share of a real volume
+    server's per-read service time — the <=2% gate perf_smoke
+    enforces."""
+    import tempfile
+
+    from seaweedfs_tpu import loadgen
+    from seaweedfs_tpu.master.server import MasterServer
+    from seaweedfs_tpu.rpc.http_rpc import call
+    from seaweedfs_tpu.stats import access as access_mod
+    from seaweedfs_tpu.volume_server.server import VolumeServer
+
+    # cap the sketch well below the object count so the bench exercises
+    # truncated Space-Saving merges, not exact counting
+    saved = {k: os.environ.get(k) for k in ("WEED_HEAT",
+                                            "WEED_HEAT_MAX_KEYS")}
+    os.environ["WEED_HEAT"] = "1"
+    os.environ["WEED_HEAT_MAX_KEYS"] = str(max(64, num_objects // 4))
+    try:
+        schedule = loadgen.build_schedule(
+            duration_s=duration_s, rate_rps=rate_rps,
+            n_objects=num_objects, n_tenants=32, write_ratio=0.0)
+        recorders = [access_mod.AccessRecorder(node=f"bench{i}")
+                     for i in range(num_parts)]
+        true_reads: dict = {}
+        tenant_bytes: dict = {}
+        # time the second half only: steady state, not cold caches
+        half = len(schedule) // 2
+        t0 = 0.0
+        for n, req in enumerate(schedule):
+            if n == half:
+                t0 = time.perf_counter()
+            fid = f"7,{req.obj:08x}"
+            recorders[n % num_parts].record(
+                "read", collection="bench", tenant=req.tenant,
+                volume=7, fid=fid, nbytes=req.size, latency_s=5e-4,
+                qos_class=req.qos_class)
+            true_reads[fid] = true_reads.get(fid, 0) + 1
+            tenant_bytes[req.tenant] = (tenant_bytes.get(req.tenant, 0)
+                                        + req.size)
+        record_us = ((time.perf_counter() - t0)
+                     / max(1, len(schedule) - half) * 1e6)
+
+        agg = access_mod.UsageAggregator()
+        for i, rec in enumerate(recorders):
+            agg.ingest(f"bench{i}", rec.summary())
+        usage = agg.usage(topk=20)
+        sketch_top = [e["fid"] for e in usage["top_keys"]]
+        true_top = [k for k, _ in sorted(true_reads.items(),
+                                         key=lambda kv: (-kv[1], kv[0]))]
+        head = true_top[:5]
+        topk_hits = sum(1 for f in head if f in sketch_top)
+
+        tenant_err = 0.0
+        for name, truth in tenant_bytes.items():
+            by_op = usage["tenants"].get(name, {}).get("bytes") or {}
+            got = sum(by_op.values())
+            tenant_err = max(tenant_err, abs(got - truth) / truth)
+        sketch_bytes = sum(rec.memory_bytes() for rec in recorders)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    # per-read service time on a live volume server (recorder on),
+    # for the overhead ratio the perf_smoke gate enforces
+    workdir = tempfile.mkdtemp(prefix="swbench_wa_")
+    master = MasterServer(port=0, pulse_seconds=1.0,
+                          maintenance_interval=3600.0)
+    master.start()
+    vs = VolumeServer([workdir], master.address, port=0,
+                      pulse_seconds=1.0)
+    vs.start()
+    vs.heartbeat_once()
+    try:
+        payload = b"w" * 2048
+        fids = []
+        for _ in range(40):
+            a = call(master.address, "/dir/assign", timeout=30)
+            call(a["url"], f"/{a['fid']}", raw=payload, method="POST",
+                 timeout=30)
+            fids.append((a["url"], a["fid"]))
+        for url, fid in fids:  # warm
+            call(url, f"/{fid}", timeout=30)
+        t0 = time.perf_counter()
+        for i in range(read_iters):
+            url, fid = fids[i % len(fids)]
+            call(url, f"/{fid}", timeout=30)
+        read_us = (time.perf_counter() - t0) / read_iters * 1e6
+    finally:
+        vs.stop()
+        master.stop()
+        shutil.rmtree(workdir, ignore_errors=True)
+
+    overhead_pct = record_us / read_us * 100.0 if read_us else 0.0
+    return {
+        "requests": len(schedule),
+        "objects": num_objects,
+        "parts": num_parts,
+        "seed": loadgen.load_seed(),
+        "topk_hits": topk_hits,
+        "topk_expected": len(head),
+        "topk_ok": topk_hits == len(head),
+        "tenant_bytes_err_pct": round(tenant_err * 100.0, 4),
+        "tenant_bytes_ok": tenant_err <= 0.01,
+        "distinct_keys_est": usage["totals"]["distinct_keys"],
+        "distinct_keys_true": len(true_reads),
+        "sketch_bytes": sketch_bytes,
+        "record_us": round(record_us, 3),
+        "read_us": round(read_us, 1),
+        "read_rps": round(1e6 / read_us, 1) if read_us else 0.0,
+        "recorder_overhead_pct": round(overhead_pct, 3),
+        "overhead_ok": overhead_pct <= 2.0,
+    }
+
+
 def main():
     # never hang on a wedged TPU transport: probe device init in a
     # subprocess first; on timeout pin the CPU backend (env alone is not
@@ -2473,6 +2602,15 @@ def main():
     except Exception as e:
         print(f"note: gateway workers bench failed: {e}", file=sys.stderr)
 
+    # -- workload analytics: sketch accuracy + recorder overhead -------------
+    workload_stats: dict = {}
+    try:
+        _policy.reset_state()
+        workload_stats = bench_workload_analytics()
+    except Exception as e:
+        print(f"note: workload analytics bench failed: {e}",
+              file=sys.stderr)
+
     vs_baseline = hbm_fused / cpu_kernel if cpu_kernel > 0 else 0.0
     from seaweedfs_tpu.util.platform import available_cpu_count
 
@@ -2553,6 +2691,7 @@ def main():
         "elasticity": elasticity_stats,
         "topology_evolution": topology_stats,
         "gateway_workers": gateway_workers_stats,
+        "workload_analytics": workload_stats,
         "smallfile_secured_vs_plain_write": (
             round(sec_write_rps / sf_write_rps, 2) if sf_write_rps
             else 0.0),
@@ -2581,9 +2720,11 @@ def _flatten_metrics(d, prefix=""):
 
 
 _LOWER_IS_BETTER = ("p50", "p99", "latency", "_ms", "seconds",
-                    "overhead", "write_amp", "failover_gap")
+                    "overhead", "write_amp", "failover_gap",
+                    "sketch_bytes")
 _TRACKED = ("rps", "gibps", "value", "throughput", "p50", "p99",
-            "latency_ms", "failover_gap")
+            "latency_ms", "failover_gap", "overhead_pct",
+            "sketch_bytes")
 
 
 def _metric_direction(path):
@@ -2659,6 +2800,7 @@ if __name__ == "__main__":
                "elasticity": bench_elasticity,
                "topology_evolution": bench_topology_evolution,
                "gateway_workers": bench_gateway_workers,
+               "workload_analytics": bench_workload_analytics,
                # alias: the curve IS the smallfile read-rps phase
                "smallfile_read_rps": bench_gateway_workers}
     if len(sys.argv) > 1:

@@ -24,18 +24,48 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from typing import Any, Optional
 
 from .. import qos
 from ..stats import metrics as stats
+from ..stats.sketch import SpaceSaving
 from .disk import OnDiskCacheLayer
 from .hbm import HbmTier
 from .ram import RamCache
 
 # RAM hits before a chunk is considered hot enough to pin in HBM
 _PROMOTE_AFTER = 2
-# bound on the promotion heat map so it cannot grow without limit
+# hard ceiling on promotion-heat counters regardless of knobs
 _HEAT_MAX = 65536
+
+
+def _heat_capacity() -> int:
+    """Promotion heat is a Space-Saving sketch bounded by the same
+    WEED_HEAT_MAX_KEYS knob as the access recorder: under pressure it
+    evicts the *coldest* counter instead of (as the old dict did)
+    dropping every fid's accumulated heat at once."""
+    try:
+        knob = int(os.environ.get("WEED_HEAT_MAX_KEYS", "") or 4096)
+    except ValueError:
+        knob = 4096
+    return max(16, min(_HEAT_MAX, knob))
+
+
+def _heat_epoch_s() -> float:
+    try:
+        return max(0.25, float(
+            os.environ.get("WEED_HEAT_EPOCH_S", "") or 60.0))
+    except ValueError:
+        return 60.0
+
+
+def _heat_decay() -> float:
+    try:
+        return min(1.0, max(0.0, float(
+            os.environ.get("WEED_HEAT_DECAY", "") or 0.5)))
+    except ValueError:
+        return 0.5
 
 
 def _env_mb(name: str, default_mb: int) -> int:
@@ -92,13 +122,14 @@ class TieredReadCache:
             ]
         self.hbm: Optional[HbmTier] = (
             HbmTier(hbm_bytes) if hbm_bytes > 0 else None)
-        # layers lock themselves; this guards counters + the heat map
+        # layers lock themselves; this guards counters + the heat sketch
         self._stat_lock = threading.Lock()
         self.hits = 0
         self.misses = 0
         self.tier_hits = {"hbm": 0, "ram": 0, "disk": 0}
         self.fills = {"admitted": 0, "qos_bypass": 0}
-        self._heat: dict[str, int] = {}
+        self._heat = SpaceSaving(_heat_capacity())
+        self._heat_epoch = time.monotonic()
 
     # -- accounting ----------------------------------------------------
 
@@ -147,13 +178,21 @@ class TieredReadCache:
         if self.hbm is None:
             return
         with self._stat_lock:
-            if len(self._heat) >= _HEAT_MAX:
-                self._heat.clear()
-            heat = self._heat.get(fid, 0) + 1
-            self._heat[fid] = heat
-            if heat < _PROMOTE_AFTER:
+            # epoch-windowed exponential decay: heat from the last
+            # epoch counts at WEED_HEAT_DECAY weight, so yesterday's
+            # hot chunk must re-earn its HBM slot
+            now = time.monotonic()
+            epoch = _heat_epoch_s()
+            elapsed = now - self._heat_epoch
+            if elapsed >= epoch:
+                self._heat.scale(_heat_decay() ** int(elapsed // epoch))
+                self._heat_epoch = now
+            self._heat.offer(fid)
+            if self._heat.estimate(fid) < _PROMOTE_AFTER:
                 return
-            del self._heat[fid]
+            # promoted: retire its counter so steady hitters don't
+            # re-put into HBM on every RAM hit
+            self._heat.counts.pop(fid, None)
         self.hbm.put(fid, data)
 
     # -- the read-through interface ------------------------------------
@@ -231,7 +270,7 @@ class TieredReadCache:
         for layer in self.layers:
             dropped = layer.invalidate(fid) or dropped
         with self._stat_lock:
-            self._heat.pop(fid, None)
+            self._heat.counts.pop(fid, None)
         if dropped:
             stats.ReadCacheInvalidationsCounter.inc(labels=(reason,))
             self._publish_resident()
@@ -247,8 +286,8 @@ class TieredReadCache:
         for layer in self.layers:
             dropped += layer.drop_prefix(prefix)
         with self._stat_lock:
-            for k in [k for k in self._heat if k.startswith(prefix)]:
-                del self._heat[k]
+            for k in [k for k in self._heat.counts if k.startswith(prefix)]:
+                del self._heat.counts[k]
         if dropped:
             stats.ReadCacheInvalidationsCounter.inc(dropped, labels=(reason,))
             self._publish_resident()
@@ -263,7 +302,7 @@ class TieredReadCache:
         for layer in self.layers:
             layer.clear()
         with self._stat_lock:
-            self._heat.clear()
+            self._heat = SpaceSaving(self._heat.capacity)
         self._publish_resident()
 
     def __len__(self) -> int:

@@ -29,6 +29,7 @@ from ..rpc.http_rpc import (FileSlice, Request, Response, RpcError,
                             RpcServer, call, sendfile_enabled)
 from ..util import faults
 from ..security import Guard, gen_read_jwt, gen_write_jwt
+from ..stats import access
 from ..stats import events as events_mod
 from ..stats import healthz
 from ..stats import metrics as stats
@@ -148,8 +149,11 @@ class FilerServer:
         # classify/count only, never queue)
         self.qos_gate = qos.AdmissionGate("filer",
                                           limit_env="WEED_QOS_FILER_LIMIT")
+        # workload analytics sketches for this filer's chunk traffic
+        self.access_recorder = access.AccessRecorder(node="filer")
         qos.mount(self.server, gate=self.qos_gate)
         events_mod.mount(self.server)
+        access.mount(self.server, self.access_recorder)
         healthz.mount_health(self.server, ready=self._ready_checks)
         self.server.add("GET", "/metadata/subscribe", self._h_subscribe)
         self.server.add("GET", "/metadata/aggregate", self._h_aggregate)
@@ -300,7 +304,12 @@ class FilerServer:
                 # writes standard; the collection is the tenant key
                 cls = qos.INTERACTIVE if method in ("GET", "HEAD") \
                     else qos.STANDARD
-            tenant = req.param("collection") or self.collection or ""
+            # an upstream gateway's X-QoS-Tenant (the S3 layer sends
+            # its sigv4-derived key) wins over the collection fallback
+            # so usage accounting and the token buckets agree on who
+            # the tenant is, whichever door the request came through
+            tenant = (req.headers.get(qos.TENANT_HEADER)
+                      or req.param("collection") or self.collection or "")
             cls = qos.class_for_tenant(tenant, cls)
             release = self.qos_gate.admit(cls, tenant)
             prev = qos.set_qos(cls, tenant)
@@ -662,9 +671,12 @@ class FilerServer:
         (reader_cache.go)."""
         from ..stats.metrics import FilerChunkCacheCounter
 
+        t0 = time.monotonic()
         cached = self.chunk_cache.get(fid)
         if cached is not None:
             FilerChunkCacheCounter.inc(labels=("hit",))
+            self._record_chunk(fid, len(cached),
+                               time.monotonic() - t0, "ram")
             return cached
         FilerChunkCacheCounter.inc(labels=("miss",))
         urls = self._lookup_urls(fid)
@@ -692,7 +704,23 @@ class FilerServer:
             data = policy.hedged(
                 "/chunk_fetch", [fetch(u) for u in urls])
         self.chunk_cache.put(fid, data)
+        self._record_chunk(fid, len(data), time.monotonic() - t0, "miss")
         return data
+
+    def _record_chunk(self, fid: str, nbytes: int, latency_s: float,
+                      tier: str):
+        """Workload analytics: every chunk fetch (cache hit or volume
+        round trip) heats the fid's sketch entry under the tenant the
+        QoS layer attributed (X-QoS-Tenant / collection)."""
+        try:
+            vid = int(fid.split(",", 1)[0])
+        except (ValueError, AttributeError):
+            vid = 0
+        self.access_recorder.record(
+            "chunk", collection=self.collection or "",
+            tenant=qos.current_tenant(), volume=vid, fid=fid,
+            nbytes=nbytes, latency_s=latency_s,
+            qos_class=qos.current_class(), cache_tier=tier)
 
     def _upload_chunk_tcp(self, url: str, fid: str, payload: bytes):
         """Write one chunk over the fast-path port; None to fall back
@@ -791,14 +819,20 @@ class FilerServer:
         keys = {v.fid: v.cipher_key for v in views}
         fids = list(keys)
         failed = threading.Event()
-        parent_span = tracing.current()  # pool threads lack the context
+        # pool threads lack the request thread's trace AND QoS context:
+        # hand both over explicitly so chunk fetches keep the caller's
+        # tenant attribution (access records, outbound QoS headers)
+        parent_span = tracing.current()
+        qos_cls, qos_tenant = qos.current_class(), qos.current_tenant()
 
         def fetch(fid: str) -> bytes:
             if failed.is_set():
                 raise RpcError("aborted: sibling chunk fetch failed", 500)
             try:
-                with tracing.span("filer.chunk_fetch", parent=parent_span,
-                                  tags={"fid": fid}):
+                with qos.qos_scope(qos_cls, qos_tenant), \
+                        tracing.span("filer.chunk_fetch",
+                                     parent=parent_span,
+                                     tags={"fid": fid}):
                     data = self._fetch_chunk(fid)
                 if keys[fid]:
                     # cache holds what the volume stores (ciphertext);
@@ -837,10 +871,14 @@ class FilerServer:
                     len(self._prefetching) >= 4:  # bounded look-ahead
                 return
             self._prefetching.add(nxt.fid)
+        qos_cls, qos_tenant = qos.current_class(), qos.current_tenant()
 
         def fetch():
             try:
-                self._fetch_chunk(nxt.fid)
+                # the read-ahead is caused by this reader: bill it to
+                # the same tenant the triggering request carried
+                with qos.qos_scope(qos_cls, qos_tenant):
+                    self._fetch_chunk(nxt.fid)
             except RpcError:
                 pass  # a miss here is only a lost optimisation
             finally:
@@ -908,10 +946,15 @@ class FilerServer:
         for i, v in enumerate(views):
             last_use[v.fid] = i
         window = max(1, prefetch_chunks())
+        # captured at generator start (inside the request's QoS scope);
+        # window fetches run on pool threads after the dispatch scope
+        # has been restored, so they need the pair pinned explicitly
+        qos_cls, qos_tenant = qos.current_class(), qos.current_tenant()
 
         def fetch(fid: str) -> bytes:
-            with tracing.span("filer.chunk_fetch", parent=parent_span,
-                              tags={"fid": fid}):
+            with qos.qos_scope(qos_cls, qos_tenant), \
+                    tracing.span("filer.chunk_fetch", parent=parent_span,
+                                 tags={"fid": fid}):
                 data = self._fetch_chunk(fid)
             if keys[fid]:
                 from ..util.cipher import decrypt

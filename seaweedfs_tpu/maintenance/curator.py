@@ -22,7 +22,8 @@ from ..util import glog
 from . import detectors
 from .jobs import (JOB_TYPES, LEASED, TYPE_SHARD_SPLIT,
                    TYPE_BALANCE, TYPE_DEEP_SCRUB,
-                   TYPE_EC_REBUILD, TYPE_SCALE_DRAIN, TYPE_SCALE_UP, Job)
+                   TYPE_EC_REBUILD, TYPE_SCALE_DRAIN, TYPE_SCALE_UP,
+                   TYPE_TIER_MOVE, Job)
 from .queue import JobQueue
 
 
@@ -214,6 +215,17 @@ class Curator:
             garbage_threshold=getattr(self.master, "garbage_threshold",
                                       0.3),
             vacuum_enabled=vacuum_on, alerts=alerts)
+        if detectors.heat_tier_enabled():
+            # heat-driven placement hints over the leader's merged
+            # access-sketch view (stats/access.py UsageAggregator)
+            usage = None
+            health = getattr(self.master, "health", None)
+            if health is not None:
+                try:
+                    usage = health.usage.usage()
+                except Exception:
+                    usage = None
+            specs.extend(detectors.scan_temperature(snap, usage))
         self.scans += 1
         ids = []
         cooldown = self.cooldown()
@@ -239,6 +251,12 @@ class Curator:
                         else events_mod.SCALE_DRAIN,
                         service="master", node=spec["type"],
                         detail=dict(spec["params"]))
+                elif spec["type"] == TYPE_TIER_MOVE:
+                    events_mod.emit(
+                        events_mod.TIER_MOVE, service="master",
+                        node=spec["type"],
+                        detail=dict(spec["params"],
+                                    volume=spec["volume"]))
                 else:
                     events_mod.emit(events_mod.JOB_ENQUEUED,
                                     service="master", node=spec["type"],

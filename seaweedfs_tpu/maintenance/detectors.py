@@ -17,7 +17,7 @@ from ..storage.erasure_coding import TOTAL_SHARDS_COUNT
 from .jobs import (TYPE_BALANCE, TYPE_DEEP_SCRUB, TYPE_EC_REBUILD,
                    TYPE_FIX_REPLICATION, TYPE_SCALE_DRAIN,
                    TYPE_SCALE_UP, TYPE_SHARD_MERGE, TYPE_SHARD_SPLIT,
-                   TYPE_VACUUM)
+                   TYPE_TIER_MOVE, TYPE_VACUUM)
 
 
 def _env_float(name: str, default: float) -> float:
@@ -240,6 +240,55 @@ def scan_scale(snap: dict, scale_enabled: Optional[bool] = None,
                             "occupancy": round(max(occs), 4),
                             "rps": round(mean_rps, 1)}}]
     return []
+
+
+def heat_tier_enabled() -> bool:
+    return os.environ.get("WEED_HEAT_TIER", "0") not in (
+        "0", "", "false", "no")
+
+
+def scan_temperature(snap: dict, usage: Optional[dict],
+                     enabled: Optional[bool] = None,
+                     cold_reads: Optional[float] = None,
+                     max_hints: Optional[int] = None) -> list[dict]:
+    """Heat-driven placement hints over the leader's merged usage view.
+
+    Opt-in via WEED_HEAT_TIER=1 (placement advice must never surprise
+    a cluster that didn't ask for it).  A volume whose decay-weighted
+    read count in the fleet sketch sits below WEED_HEAT_TIER_COLD_READS
+    while holding live data is *cold*: emit an advisory ``tier.move``
+    spec pointing at storage/tier.py's remote backends.  The decayed
+    sketch means a volume hot last week but idle now qualifies —
+    exactly the temperature signal ROADMAP item 3's cold-tier work
+    needs.  At most WEED_HEAT_TIER_MAX_HINTS hints per scan (coldest
+    first) so a freshly-enabled detector cannot flood the queue."""
+    if enabled is None:
+        enabled = heat_tier_enabled()
+    if not enabled or not usage:
+        return []
+    if cold_reads is None:
+        cold_reads = _env_float("WEED_HEAT_TIER_COLD_READS", 1.0)
+    if max_hints is None:
+        max_hints = int(_env_float("WEED_HEAT_TIER_MAX_HINTS", 4))
+    vol_reads = {str(k): float(v)
+                 for k, v in (usage.get("volumes") or {}).items()}
+    total_reads = float(usage.get("totals", {}).get("reads", 0) or 0)
+    if total_reads <= 0:
+        return []   # no traffic at all means no temperature signal
+    cold = []
+    for v in snap.get("volumes", []):
+        if v.get("size", 0) <= 0:
+            continue   # nothing to move
+        reads = vol_reads.get(str(v["id"]), 0.0)
+        if reads < cold_reads:
+            cold.append((reads, v))
+    cold.sort(key=lambda rv: (rv[0], rv[1]["id"]))
+    return [{"type": TYPE_TIER_MOVE, "volume": v["id"],
+             "collection": v["collection"],
+             "params": {"reads": round(reads, 3),
+                        "fleet_reads": round(total_reads, 1),
+                        "advisory": True, "dest": "cold"}}
+            for reads, v in cold[:max(0, max_hints)]]
 
 
 def scan_shard_scale(shards: dict,

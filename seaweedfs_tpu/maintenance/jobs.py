@@ -28,6 +28,10 @@ TYPE_SCALE_DRAIN = "scale.drain"
 # filer.resize through raft directly, never enqueued as worker jobs
 TYPE_SHARD_SPLIT = "filer.shard_split"
 TYPE_SHARD_MERGE = "filer.shard_merge"
+# advisory placement hint from the temperature detector: this volume
+# is cold enough for the remote tier (storage/tier.py); least urgent
+# of all — moving cold data is never time-critical
+TYPE_TIER_MOVE = "tier.move"
 
 PRIORITIES = {
     TYPE_EC_REBUILD: 0,
@@ -37,6 +41,7 @@ PRIORITIES = {
     TYPE_BALANCE: 4,
     TYPE_SCALE_UP: 5,
     TYPE_SCALE_DRAIN: 6,
+    TYPE_TIER_MOVE: 7,
 }
 JOB_TYPES = tuple(PRIORITIES)
 

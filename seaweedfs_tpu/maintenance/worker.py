@@ -22,7 +22,7 @@ from ..storage.erasure_coding import TOTAL_SHARDS_COUNT
 from ..util import glog
 from .jobs import (TYPE_BALANCE, TYPE_DEEP_SCRUB, TYPE_EC_REBUILD,
                    TYPE_FIX_REPLICATION, TYPE_SCALE_DRAIN,
-                   TYPE_SCALE_UP, TYPE_VACUUM)
+                   TYPE_SCALE_UP, TYPE_TIER_MOVE, TYPE_VACUUM)
 from .pacer import BytePacer
 
 
@@ -172,7 +172,8 @@ class MaintenanceWorker:
               TYPE_DEEP_SCRUB: self._exec_deep_scrub,
               TYPE_BALANCE: self._exec_balance,
               TYPE_SCALE_UP: self._exec_scale_up,
-              TYPE_SCALE_DRAIN: self._exec_scale_drain}.get(job["type"])
+              TYPE_SCALE_DRAIN: self._exec_scale_drain,
+              TYPE_TIER_MOVE: self._exec_tier_move}.get(job["type"])
         if fn is None:
             raise ValueError(f"unknown job type {job['type']!r}")
         return fn(job)
@@ -360,3 +361,13 @@ class MaintenanceWorker:
         call(server, "/admin/leave", {}, timeout=30)
         return {"server": server, "volume_moves": moves,
                 "ec_shard_moves": shard_moves}
+
+    def _exec_tier_move(self, job: dict) -> dict:
+        """Advisory for now: the temperature detector flagged this
+        volume as cold.  Surface the hint (journal + job report) so an
+        operator — or the future cold-tier mover (ROADMAP item 3) —
+        can act on it with storage/tier.py's tier_upload; the hint
+        itself performs no data movement."""
+        params = dict(job.get("params", {}))
+        return {"volume": job["volume"], "advisory": True,
+                "action": "none", "hint": params}

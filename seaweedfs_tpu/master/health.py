@@ -30,6 +30,7 @@ from ..maintenance import detectors
 from ..maintenance.jobs import (TYPE_DEEP_SCRUB, TYPE_EC_REBUILD,
                                 TYPE_FIX_REPLICATION)
 from ..rpc import policy
+from ..stats import access as access_mod
 from ..stats import events as events_mod
 from ..stats import metrics as _stats
 from ..stats import slo as slo_mod
@@ -63,6 +64,9 @@ class HealthPlane:
                                      journal=self.journal)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # workload analytics: one access summary per daemon, merged on
+        # demand behind GET /cluster/usage (stats/access.py)
+        self.usage = access_mod.UsageAggregator(now=self.now)
         self._up: Dict[str, int] = {}      # target -> last liveness
         self._evt_cursor: Dict[str, int] = {}   # target -> remote seq
         self._evt_skip: set = set()        # same-process targets
@@ -155,6 +159,23 @@ class HealthPlane:
             self._up[addr] = up
             if up:
                 self._pull_events(addr, budget)
+                if kind != "volume":
+                    # filer / S3 summaries come over the scrape loop;
+                    # volume servers' ride their heartbeat (below)
+                    self._pull_access(addr, budget)
+        with self.master.topo.lock:
+            beats = {url: dict(node.access)
+                     for url, node in self.master.topo.nodes.items()
+                     if getattr(node, "access", None)}
+        for url, summary in beats.items():
+            self.usage.ingest(url, summary)
+        # the hot-key check merges every part's sketches — do it every
+        # few rounds, not per-scrape (usage_view also checks on demand)
+        if self.rounds % 5 == 0:
+            try:
+                self.usage.maybe_emit_hot_key(node=self.master.address)
+            except Exception as e:
+                glog.warning(f"hot-key check failed: {e}")
         self._last_slo = self.slo.evaluate()
         self.rounds += 1
         busy = time.perf_counter() - t0
@@ -185,6 +206,20 @@ class HealthPlane:
             return
         self.journal.merge(resp.get("events") or [])
         self._evt_cursor[addr] = int(resp.get("seq") or 0)
+
+    def _pull_access(self, addr: str, budget: float):
+        """Fetch a non-heartbeating daemon's access-sketch summary
+        (GET /debug/access) into the usage aggregator.  Daemons
+        without the route (older builds, masters) are just skipped."""
+        try:
+            with policy.deadline_scope(timeout=budget):
+                resp = policy.call_policy(addr, "/debug/access",
+                                          timeout=budget, retries=0,
+                                          breaker=False)
+        except Exception:
+            return
+        if isinstance(resp, dict) and "hot" in resp:
+            self.usage.ingest(addr, resp)
 
     # -- alert push-downs ----------------------------------------------------
     def firing(self) -> List[str]:
@@ -264,7 +299,21 @@ class HealthPlane:
                 "rules": self._last_slo,
                 "firing": self.firing()}
 
+    def usage_view(self, req) -> dict:
+        try:
+            topk = int(req.param("topk", 0) or 0)
+        except (TypeError, ValueError):
+            topk = 0
+        usage = self.usage.usage(topk=topk or None)
+        try:
+            self.usage.maybe_emit_hot_key(usage=usage,
+                                          node=self.master.address)
+        except Exception as e:
+            glog.warning(f"hot-key check failed: {e}")
+        return usage
+
     def mount(self, server):
         server.add("GET", "/cluster/health", lambda r: self.health())
         server.add("GET", "/cluster/alerts", lambda r: self.alerts())
+        server.add("GET", "/cluster/usage", self.usage_view)
         events_mod.mount(server, self.journal)

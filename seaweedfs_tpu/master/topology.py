@@ -55,6 +55,9 @@ class DataNode:
         # load telemetry from the latest heartbeat (rps / occupancy /
         # draining), consumed by the curator's autoscale detectors
         self.telemetry: dict = {}
+        # access-sketch summary from the latest heartbeat, folded into
+        # the leader's UsageAggregator (stats/access.py)
+        self.access: dict = {}
 
     @property
     def url(self) -> str:
@@ -213,6 +216,7 @@ class Topology:
             node.max_volume_count = hb.get("max_volume_count",
                                            node.max_volume_count)
             node.telemetry = hb.get("telemetry") or {}
+            node.access = hb.get("access") or {}
             self.sequencer.set_max(hb.get("max_file_key", 0))
             from ..stats import metrics as stats
 

@@ -27,6 +27,7 @@ from ..filer.filer_store import NotFoundError
 from ..filer.server import FilerServer
 from .. import profiling, qos, tracing
 from ..rpc.http_rpc import Request, Response, RpcError, RpcServer
+from ..stats import access
 from ..stats import events as events_mod
 from ..stats import healthz
 from ..stats import metrics as stats
@@ -141,8 +142,11 @@ class S3ApiServer:
         # tenant key (WEED_QOS_S3_LIMIT; 0 = classify/count only)
         self.qos_gate = qos.AdmissionGate("s3",
                                           limit_env="WEED_QOS_S3_LIMIT")
+        # workload analytics sketches for this gateway's object traffic
+        self.access_recorder = access.AccessRecorder(node="s3")
         qos.mount(self.server, gate=self.qos_gate)
         events_mod.mount(self.server)
+        access.mount(self.server, self.access_recorder)
         healthz.mount_health(self.server, ready=self._ready_checks)
         self.server.default_route = self._handle
         self._stop_event = threading.Event()
@@ -718,7 +722,20 @@ class S3ApiServer:
             return self._delete_object(bucket, key)
         raise RpcError(f"unsupported object op {method}", 405)
 
+    def _record_access(self, op: str, bucket: str, key: str, nbytes: int,
+                       t0: float):
+        """Workload analytics at the S3 door: objects are keyed
+        bucket/key here (the volume layer tracks the same access by
+        fid); the tenant is whatever sigv4 identity _route attributed
+        to the QoS context."""
+        self.access_recorder.record(
+            op, collection=bucket, tenant=qos.current_tenant(),
+            fid=f"{bucket}/{key}", nbytes=nbytes,
+            latency_s=time.monotonic() - t0,
+            qos_class=qos.current_class())
+
     def _put_object(self, bucket: str, key: str, req: Request):
+        t0 = time.monotonic()
         extended = {f"x-amz-meta-{k[11:].lower()}": v
                     for k, v in req.headers.items()
                     if k.lower().startswith("x-amz-meta-")}
@@ -726,9 +743,11 @@ class S3ApiServer:
             self._object_path(bucket, key), req.body,
             mime=req.headers.get("Content-Type") or "",
             extended=extended)
+        self._record_access("write", bucket, key, len(req.body or b""), t0)
         return Response(b"", 200, headers={"ETag": f'"{entry.attr.md5}"'})
 
     def _get_object(self, bucket: str, key: str, req: Request, method: str):
+        t0 = time.monotonic()
         entry = self.filer.find_entry(self._object_path(bucket, key))
         if entry.is_directory:
             raise NotFoundError(key)
@@ -762,6 +781,9 @@ class S3ApiServer:
         if method == "HEAD":
             headers["Content-Length"] = str(length)
             return Response(b"", status, content_type, headers)
+        # record at first-byte time: every reply path below serves
+        # exactly `length` payload bytes
+        self._record_access("read", bucket, key, length, t0)
         # single-chunk objects resident in the disk cache tier go out
         # zero-copy via sendfile, same as the filer read path
         zero = self.filer_server._sendfile_read(
@@ -784,12 +806,14 @@ class S3ApiServer:
         return Response(body, status, content_type, headers)
 
     def _delete_object(self, bucket: str, key: str):
+        t0 = time.monotonic()
         try:
             self.filer.delete_entry(self._object_path(bucket, key))
         except NotFoundError:
             pass  # S3 delete is idempotent
         except ValueError as e:
             return _error_xml("InvalidRequest", str(e), 400)
+        self._record_access("delete", bucket, key, 0, t0)
         return Response(b"", 204)
 
     def _copy_object(self, bucket: str, key: str, req: Request):

@@ -49,6 +49,8 @@ DRAIN = "vs.drain"
 READONLY_DEMOTION = "vs.readonly"
 WORKER_RESPAWN = "worker.respawn"
 FAULTS_ACTIVE = "faults.active"
+HOT_KEY = "access.hotkey"
+TIER_MOVE = "tier.move"
 
 
 def _cap() -> int:

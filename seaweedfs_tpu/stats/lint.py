@@ -33,7 +33,27 @@ def default_dashboard_path() -> str:
     return os.path.join(here, "grafana", "grafana_seaweedfs_tpu.json")
 
 
+# Rows the shipped dashboard must keep, with family tokens each row's
+# panels must query — deleting a row (or renaming a family out from
+# under it) fails the lint, not just a human eyeball pass.  Applied
+# only to the repo's own dashboard; ad-hoc dashboards passed by path
+# are checked for dangling references only.
+PINNED_ROWS = {
+    "Workload analytics": (
+        "SeaweedFS_access_records_total",
+        "SeaweedFS_access_tracked_keys",
+        "SeaweedFS_access_sketch_bytes",
+        "SeaweedFS_usage_reads",
+        "SeaweedFS_usage_bytes",
+        "SeaweedFS_usage_distinct_keys",
+        "SeaweedFS_usage_hot_share",
+    ),
+}
+
+
 def lint_dashboard(path: Optional[str] = None) -> List[str]:
+    pin = path is None or \
+        os.path.abspath(path) == default_dashboard_path()
     path = path or default_dashboard_path()
     problems: List[str] = []
     try:
@@ -53,6 +73,17 @@ def lint_dashboard(path: Optional[str] = None) -> List[str]:
             if base not in registered and token not in registered:
                 problems.append(
                     f"panel {title!r} references unknown metric {token}")
+    if pin:
+        titles = {p.get("title") for p in panels
+                  if p.get("type") == "row"}
+        joined = "\n".join(e for _, e in exprs)
+        for row, families in PINNED_ROWS.items():
+            if row not in titles:
+                problems.append(f"pinned row {row!r} missing")
+            for fam in families:
+                if fam not in joined:
+                    problems.append(
+                        f"no panel queries pinned family {fam}")
     return problems
 
 

@@ -701,6 +701,60 @@ ClusterEventsCounter = REGISTRY.counter(
     ("kind",))
 
 
+# -- workload analytics plane (stats/access.py + stats/sketch.py): the
+# per-daemon access recorder's own health, and the leader's assembled
+# cluster usage view -----------------------------------------------------
+
+
+def _access_tracked_keys() -> float:
+    from . import access
+
+    return float(access.tracked_keys_total())
+
+
+def _access_sketch_bytes() -> float:
+    from . import access
+
+    return float(access.memory_bytes_total())
+
+
+AccessRecordsCounter = REGISTRY.counter(
+    "SeaweedFS_access_records_total",
+    "data-path accesses fed to this daemon's access recorder, by op "
+    "(read|write|delete|chunk)", ("op",))
+AccessTrackedKeysGauge = REGISTRY.gauge(
+    "SeaweedFS_access_tracked_keys",
+    "fids currently tracked by the hot-key Space-Saving sketch "
+    "(bounded by WEED_HEAT_MAX_KEYS)", fn=_access_tracked_keys)
+AccessSketchBytesGauge = REGISTRY.gauge(
+    "SeaweedFS_access_sketch_bytes",
+    "approximate resident footprint of this daemon's access sketches",
+    fn=_access_sketch_bytes)
+UsageReadsGauge = REGISTRY.gauge(
+    "SeaweedFS_usage_reads",
+    "decay-weighted fleet read ops in the leader's merged usage view")
+UsageWritesGauge = REGISTRY.gauge(
+    "SeaweedFS_usage_writes",
+    "decay-weighted fleet write ops in the leader's merged usage view")
+UsageBytesGauge = REGISTRY.gauge(
+    "SeaweedFS_usage_bytes",
+    "decay-weighted fleet bytes moved in the merged usage view, by "
+    "direction (read|write)", ("op",))
+UsageDistinctKeysGauge = REGISTRY.gauge(
+    "SeaweedFS_usage_distinct_keys",
+    "HyperLogLog distinct-fid estimate across all reporting daemons")
+UsageTenantsGauge = REGISTRY.gauge(
+    "SeaweedFS_usage_tenants",
+    "tenants present in the leader's merged usage view")
+UsageCollectionsGauge = REGISTRY.gauge(
+    "SeaweedFS_usage_collections",
+    "collections present in the leader's merged usage view")
+UsageHotShareGauge = REGISTRY.gauge(
+    "SeaweedFS_usage_hot_share",
+    "share of fleet reads hitting the single hottest fid (the "
+    "access.hotkey journal event fires past WEED_HEAT_HOT_SHARE)")
+
+
 # -- process self-metrics (the reference's Go runtime collectors:
 # prometheus.NewGoCollector/NewProcessCollector) -----------------------------
 _PROCESS_START = time.time()

@@ -33,6 +33,7 @@ from ..rpc.http_rpc import (FileSlice, Request, Response, RpcError,
                             stream_file)
 from ..util import faults
 from ..security import Guard, gen_write_jwt, token_from_request
+from ..stats import access
 from ..stats import events as events_mod
 from ..stats import healthz
 from ..stats import metrics as stats
@@ -57,6 +58,19 @@ from ..storage.volume import (CookieMismatchError, DeletedError,
 EC_SHARD_CACHE_TTL_ERROR = 11.0
 EC_SHARD_CACHE_TTL_INCOMPLETE = 7 * 60.0
 EC_SHARD_CACHE_TTL_HEALTHY = 37 * 60.0
+
+
+def _resp_len(resp) -> int:
+    """Bytes a handler reply carries (access accounting): buffered
+    bodies directly, streamed/sendfile bodies via Content-Length."""
+    body = getattr(resp, "body", resp)
+    if isinstance(body, (bytes, bytearray, memoryview)):
+        return len(body)
+    headers = getattr(resp, "headers", None) or {}
+    try:
+        return int(headers.get("Content-Length", 0) or 0)
+    except (TypeError, ValueError):
+        return 0
 
 
 class _EcBindingEntry:
@@ -224,6 +238,8 @@ class VolumeServer:
             "volume", limit_env="WEED_QOS_VS_LIMIT",
             fallback_env="WEED_VS_MAX_INFLIGHT",
             default_limit=max_inflight_requests)
+        # workload analytics sketches for this daemon's needle traffic
+        self.access_recorder = access.AccessRecorder(node="volume")
         self.enable_tcp = enable_tcp
         self._tcp_sock = None
         # tier backends must be registered before Store discovery so
@@ -607,6 +623,10 @@ class VolumeServer:
         self._sync_native_serving()
         hb = self.store.collect_heartbeat()
         hb["telemetry"] = self._telemetry()
+        if self.access_recorder.enabled:
+            # access sketches ride the beat the node already sends —
+            # the leader merges summaries, raw keys never leave here
+            hb["access"] = self.access_recorder.summary()
         targets = [self.master_address] + [
             m for m in self._seed_masters if m != self.master_address]
         # shared failover policy: per-master breakers skip a dead seed,
@@ -700,6 +720,7 @@ class VolumeServer:
         profiling.mount(s)
         qos.mount(s, gate=self.qos_gate)
         events_mod.mount(s)
+        access.mount(s, self.access_recorder)
         healthz.mount_health(s, ready=self._ready_checks)
         s.add("GET", "/ui", self._h_ui)
         s.default_route = self._handle_object
@@ -991,10 +1012,19 @@ class VolumeServer:
                 except PermissionError as e:
                     raise RpcError(str(e), 401)
             stats.VolumeServerRequestCounter.labels("read").inc()
-            with stats.VolumeServerRequestHistogram.labels("read").time():
-                with tracing.span("needle.read", tags={"fid": fid}):
-                    return self._read_object(
-                        vid, nid, cookie, method, req, fid)
+            t0 = time.monotonic()
+            nbytes = 0
+            try:
+                with stats.VolumeServerRequestHistogram.labels(
+                        "read").time():
+                    with tracing.span("needle.read", tags={"fid": fid}):
+                        resp = self._read_object(
+                            vid, nid, cookie, method, req, fid)
+                nbytes = _resp_len(resp)
+                return resp
+            finally:
+                self._record_access("read", vid, fid, nbytes,
+                                    time.monotonic() - t0)
         if method in ("POST", "PUT"):
             # JWT check before any byte is written
             # (volume_server_handlers_write.go:30-38)
@@ -1004,6 +1034,7 @@ class VolumeServer:
             if not self.upload_gate.acquire(n_bytes):
                 stats.VolumeServerThrottleRejects.labels("upload").inc()
                 raise RpcError("too many requests: upload limit", 429)
+            t0 = time.monotonic()
             try:
                 with stats.VolumeServerRequestHistogram.labels(
                         "write").time():
@@ -1013,12 +1044,31 @@ class VolumeServer:
                         return self._write_object(vid, nid, cookie, req)
             finally:
                 self.upload_gate.release(n_bytes)
+                self._record_access("write", vid, fid, n_bytes,
+                                    time.monotonic() - t0)
         if method == "DELETE":
             self._check_write_auth(req, fid)
             stats.VolumeServerRequestCounter.labels("delete").inc()
             with tracing.span("needle.delete", tags={"fid": fid}):
-                return self._delete_object(vid, nid, cookie, req)
+                resp = self._delete_object(vid, nid, cookie, req)
+            self._record_access("delete", vid, fid, 0, 0.0)
+            return resp
         raise RpcError(f"unsupported method {method}", 405)
+
+    def _record_access(self, op: str, vid: int, fid: str, nbytes: int,
+                       latency_s: float):
+        """Feed the workload analytics sketches (stats/access.py); the
+        QoS class/tenant were set from the request headers by dispatch,
+        so gateway-attributed tenants flow through unchanged."""
+        v = self.store.find_volume(vid)
+        coll = v.collection if v is not None else ""
+        if not coll:
+            ev = self.store.find_ec_volume(vid)
+            coll = getattr(ev, "collection", "") if ev is not None else ""
+        self.access_recorder.record(
+            op, collection=coll, tenant=qos.current_tenant(),
+            volume=vid, fid=fid, nbytes=nbytes,
+            latency_s=latency_s, qos_class=qos.current_class())
 
     def _check_write_auth(self, req: Request, fid: str):
         try:

@@ -243,10 +243,22 @@ class TestGrafanaDashboard:
                 "SeaweedFS_cluster_scrape_duty_ratio"):
             assert token in joined, \
                 f"no Cluster health panel queries {token}"
+        # the Workload analytics row queries the access/usage families
+        for token in (
+                "SeaweedFS_access_records_total",
+                "SeaweedFS_access_tracked_keys",
+                "SeaweedFS_access_sketch_bytes",
+                "SeaweedFS_usage_reads",
+                "SeaweedFS_usage_bytes",
+                "SeaweedFS_usage_distinct_keys",
+                "SeaweedFS_usage_hot_share"):
+            assert token in joined, \
+                f"no Workload analytics panel queries {token}"
         titles = [p.get("title") for p in dashboard["panels"]]
         assert "Inline EC" in titles
         assert "Gateway workers" in titles
         assert "Cluster health" in titles
+        assert "Workload analytics" in titles
 
     def test_lint_dashboards_clean(self):
         from seaweedfs_tpu.stats import lint

@@ -1171,6 +1171,47 @@ def _render_top(h, master):
     return lines
 
 
+def _render_usage(u):
+    """Workload-analytics frame of `weed top`: the hot-key / tenant
+    rollup from GET /cluster/usage (decayed sketch merge, so the
+    numbers are recent-traffic weighted, not lifetime totals)."""
+    lines = []
+    t = u.get("totals", {})
+    lines.append(
+        f"workload (last epochs, decayed): "
+        f"{t.get('reads', 0):.0f} reads / {t.get('writes', 0):.0f} writes, "
+        f"{t.get('bytes_read', 0) / 1e6:.1f}MB out / "
+        f"{t.get('bytes_written', 0) / 1e6:.1f}MB in, "
+        f"~{t.get('distinct_keys', 0)} distinct keys "
+        f"({len(u.get('nodes', []))} reporting daemons)")
+    top = u.get("top_keys", [])
+    if top:
+        lines.append("")
+        lines.append(f"{'HOT KEY':40s} {'READS':>9s} {'SHARE':>7s}")
+        for e in top[:10]:
+            lines.append(f"{e.get('fid', '?'):40s} "
+                         f"{e.get('reads', 0):9.0f} "
+                         f"{e.get('share', 0) * 100:6.1f}%")
+    tenants = u.get("tenants", {})
+    if tenants:
+        # ops/bytes come per-op from the usage view; the terminal view
+        # wants one scalar per tenant
+        def total(e, field):
+            return sum((e.get(field) or {}).values())
+
+        lines.append("")
+        lines.append(f"{'TENANT':24s} {'OPS':>9s} {'BYTES':>12s} "
+                     f"{'~KEYS':>7s}")
+        ranked = sorted(tenants.items(),
+                        key=lambda kv: (-total(kv[1], "bytes"), kv[0]))
+        for name, e in ranked[:10]:
+            lines.append(f"{name or '(none)':24s} "
+                         f"{total(e, 'ops'):9.0f} "
+                         f"{total(e, 'bytes'):12.0f} "
+                         f"{e.get('distinct_keys', 0):7d}")
+    return lines
+
+
 def cmd_top(args):
     """Live terminal view over GET /cluster/health (+ per-node readyz
     probes) — the cluster-wide answer to `kubectl get nodes`."""
@@ -1184,6 +1225,13 @@ def cmd_top(args):
             print(f"error: master {args.master} unreachable: {e}")
             sys.exit(1)
         lines = _render_top(h, args.master)
+        try:
+            u = call(args.master, "/cluster/usage", timeout=5)
+        except (RpcError, OSError):
+            u = None
+        if u and u.get("nodes"):
+            lines.append("")
+            lines.extend(_render_usage(u))
         if not args.once and sys.stdout.isatty():
             sys.stdout.write("\x1b[2J\x1b[H")
         print("\n".join(lines), flush=True)
